@@ -1,0 +1,31 @@
+"""kungfu_tpu — a TPU-native adaptive distributed training framework.
+
+A ground-up JAX/XLA re-design with the capabilities of KungFu
+(https://github.com/lsds/KungFu): synchronous SGD, synchronous model
+averaging, gossip pair-averaging, online training monitoring (gradient noise
+scale, variance, throughput), runtime-swappable collective strategies, and
+elastic cluster resizing — with the data plane lowered to XLA collectives
+(psum/ppermute/all_gather/reduce_scatter) over an ICI/DCN device mesh and
+zero NCCL/CUDA.
+
+Top-level API mirrors the reference's `kungfu.python` surface
+(srcs/python/kungfu/python/__init__.py:36-103): `current_rank`,
+`cluster_size`, `local_rank`, `run_barrier`, ... — see kungfu_tpu/api.py.
+"""
+
+__version__ = "0.1.0"
+
+from .api import (  # noqa: F401
+    init,
+    finalize,
+    current_rank,
+    current_cluster,
+    cluster_size,
+    current_local_rank,
+    current_local_size,
+    host_count,
+    detached,
+    uid,
+    run_barrier,
+    propose_new_size,
+)

@@ -1,0 +1,82 @@
+"""Top-level scalar API — the `kungfu.python` surface, TPU-native.
+
+Reference: srcs/python/kungfu/python/__init__.py:36-103 (current_rank,
+cluster_size, local_rank/size, detached, run_barrier, propose_new_size) built
+on ctypes into libkungfu.  Here they read the default Peer directly.
+
+Unlike the reference, init is lazy: importing kungfu_tpu does not start the
+peer (JAX initialization is expensive and test frameworks import eagerly);
+any API call or an explicit `init()` starts it.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from . import peer as _peer_mod
+from .peer import Peer, default_peer
+from .plan import Cluster
+
+
+def init(config=None) -> Peer:
+    """Start (or return) the default peer. Idempotent."""
+    if config is not None:
+        _peer_mod.finalize_default_peer()  # close any lazily-started peer first
+        p = Peer(config).start()
+        _peer_mod.set_default_peer(p)
+        import atexit
+
+        atexit.register(_peer_mod.finalize_default_peer)
+        return p
+    return default_peer()
+
+
+def finalize() -> None:
+    _peer_mod.finalize_default_peer()
+
+
+def current_rank() -> int:
+    return default_peer().rank
+
+
+def cluster_size() -> int:
+    return default_peer().size
+
+
+def current_local_rank() -> int:
+    return default_peer().local_rank
+
+
+def current_local_size() -> int:
+    return default_peer().local_size
+
+
+def host_count() -> int:
+    return default_peer().host_count
+
+
+def current_cluster() -> Cluster:
+    return default_peer().config.cluster()
+
+
+def detached() -> bool:
+    return default_peer().detached
+
+
+def uid() -> int:
+    return default_peer().uid()
+
+
+def run_barrier() -> None:
+    """Global barrier (reference python/__init__.py run_barrier)."""
+    default_peer().current_session().barrier()
+
+
+def propose_new_size(new_size: int) -> None:
+    """Rank 0 proposes a resize via the config server (legacy.go:18-37).
+
+    Implemented in kungfu_tpu.elastic; importing here lazily to keep the
+    core import light.
+    """
+    from .elastic import propose_new_size as _propose
+
+    _propose(default_peer(), new_size)

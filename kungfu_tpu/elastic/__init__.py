@@ -1,0 +1,4 @@
+"""Elastic training: config service, resize protocol, schedules, policies."""
+from .config_client import ConfigClient, propose_new_size
+
+__all__ = ["ConfigClient", "propose_new_size"]

@@ -1,0 +1,92 @@
+"""HTTP client for the elastic config service.
+
+Reference: workers GET/PUT the versioned Cluster JSON from the config server
+(srcs/go/kungfu/peer/peer.go:265 getClusterConfig, legacy.go:18-37
+ProposeNewSize -> HTTP PUT of the resized Cluster).  Pure stdlib HTTP — the
+control plane stays outside XLA.
+"""
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import Optional, Tuple
+
+from ..plan import Cluster
+from ..utils import get_logger
+
+log = get_logger("kungfu.elastic")
+
+
+class ConfigClient:
+    def __init__(self, url: str, timeout_s: float = 5.0):
+        if not url:
+            raise ValueError("config server URL is empty")
+        self.url = url.rstrip("/")
+        self.timeout_s = timeout_s
+
+    def get_cluster(self) -> Optional[Tuple[Cluster, int]]:
+        """GET current (cluster, version); None if cleared/404."""
+        try:
+            with urllib.request.urlopen(self.url, timeout=self.timeout_s) as r:
+                doc = json.loads(r.read().decode())
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise
+        return Cluster.from_json(doc["cluster"]), int(doc.get("version", 0))
+
+    def put_cluster(self, cluster: Cluster, version: Optional[int] = None) -> bool:
+        """PUT a new cluster config; server validates + bumps version.
+
+        Returns False if the server rejected it (e.g. cleared config,
+        reference configserver.go:60-88).
+        """
+        body = json.dumps({"cluster": cluster.to_json(), "version": version}).encode()
+        req = urllib.request.Request(
+            self.url, data=body, method="PUT",
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                return 200 <= r.status < 300
+        except urllib.error.HTTPError as e:
+            log.warning("config PUT rejected: %s", e)
+            return False
+
+    def clear(self) -> None:
+        req = urllib.request.Request(self.url, method="DELETE")
+        with urllib.request.urlopen(req, timeout=self.timeout_s):
+            pass
+
+    def wait_for_config(self, poll_s: float = 0.05, timeout_s: float = 120.0) -> Tuple[Cluster, int]:
+        t0 = time.monotonic()
+        while True:
+            got = self.get_cluster()
+            if got is not None:
+                return got
+            if time.monotonic() - t0 > timeout_s:
+                raise TimeoutError(f"no config at {self.url} after {timeout_s}s")
+            time.sleep(poll_s)
+
+
+def propose_new_size(peer, new_size: int) -> bool:
+    """Rank 0 proposes a resize: GET current, Cluster.resize, PUT back.
+
+    Reference Peer.ProposeNewSize (srcs/go/kungfu/peer/legacy.go:18-37):
+    only rank 0 acts; others no-op (all ranks observe the new config on
+    their next resize poll).
+    """
+    if peer.rank != 0:
+        return False
+    url = peer.config.config_server
+    if not url:
+        raise RuntimeError("propose_new_size requires KFT_CONFIG_SERVER")
+    client = ConfigClient(url)
+    got = client.get_cluster()
+    cluster, version = got if got is not None else (peer.config.cluster(), peer.cluster_version)
+    resized = cluster.resize(new_size)
+    ok = client.put_cluster(resized)
+    log.info("proposed resize %d -> %d: %s", cluster.size(), new_size, "ok" if ok else "rejected")
+    return ok

@@ -1,0 +1,21 @@
+from .collective import (
+    all_reduce,
+    psum_all_reduce,
+    rs_ag_all_reduce,
+    ring_all_reduce,
+    hierarchical_all_reduce,
+    broadcast,
+    all_gather,
+    reduce_scatter,
+    reduce,
+    barrier,
+    consensus,
+    group_all_reduce,
+    ppermute_pair_exchange,
+)
+
+__all__ = [
+    "all_reduce", "psum_all_reduce", "rs_ag_all_reduce", "ring_all_reduce",
+    "hierarchical_all_reduce", "broadcast", "all_gather", "reduce_scatter",
+    "reduce", "barrier", "consensus", "group_all_reduce", "ppermute_pair_exchange",
+]

@@ -1,0 +1,188 @@
+"""Peer — process membership and lifecycle.
+
+Re-design of the reference Peer (srcs/go/kungfu/peer/peer.go:27-48): a Peer
+owns this process's identity, the current Cluster document + version, and the
+current Session.  Where the reference Peer owns a TCP router/server, the TPU
+Peer owns the `jax.distributed` runtime: on a multi-host pod each worker
+process joins the coordination service, and the data plane is the compiled
+XLA program over the global mesh.
+
+Version fencing: the coordinator port is derived from the cluster version, so
+peers on a stale cluster config cannot rendezvous with the new one — the
+analog of the cluster-version token check on collective connections
+(srcs/go/rchannel/connection/connection.go:81-87).
+"""
+from __future__ import annotations
+
+import atexit
+import os
+from typing import Optional
+
+import jax
+
+from . import env as kfenv
+from .plan import Cluster, PeerID, PeerList, Strategy, make_mesh, make_hierarchical_mesh
+from .session import Session
+from .utils import get_logger, stall_detector
+
+log = get_logger("kungfu.peer")
+
+COORDINATOR_PORT_OFFSET = 20000
+
+
+class Peer:
+    def __init__(self, config: Optional[kfenv.Config] = None):
+        self.config = config if config is not None else kfenv.parse_config_from_env()
+        self.cluster_version = self.config.cluster_version
+        self.detached = False
+        self._session: Optional[Session] = None
+        self._started = False
+        self._dist_initialized = False
+
+    # -- identity (reference peer.go + python/__init__.py:36-103) ---------------------
+
+    @property
+    def self_id(self) -> PeerID:
+        return self.config.self_id
+
+    @property
+    def rank(self) -> int:
+        return self.config.rank
+
+    @property
+    def size(self) -> int:
+        return len(self.config.peers)
+
+    @property
+    def local_rank(self) -> int:
+        r = self.config.peers.local_rank(self.self_id)
+        return 0 if r is None else r
+
+    @property
+    def local_size(self) -> int:
+        return max(1, self.config.peers.local_size(self.self_id))
+
+    @property
+    def host_count(self) -> int:
+        return max(1, self.config.peers.host_count())
+
+    def uid(self) -> int:
+        """(version << 32) | rank, reference libkungfu-comm/main.go uid."""
+        return (self.cluster_version << 32) | self.rank
+
+    # -- lifecycle --------------------------------------------------------------------
+
+    def start(self) -> "Peer":
+        if self._started:
+            return self
+        if self.size > 1 and not self.config.single_machine:
+            self._init_distributed()
+        self._session = self._build_session()
+        self._started = True
+        log.info(
+            "peer up: rank %d/%d local %d/%d hosts %d version %d",
+            self.rank, self.size, self.local_rank, self.local_size,
+            self.host_count, self.cluster_version,
+        )
+        return self
+
+    def _coordinator_address(self) -> str:
+        root = self.config.peers[0]
+        port = root.port + COORDINATOR_PORT_OFFSET + self.cluster_version
+        return f"{root.host}:{port}"
+
+    def _init_distributed(self) -> None:
+        """Join the jax.distributed coordination service (multi-process).
+
+        One JAX process per worker; the coordinator is worker rank 0.  The
+        port encodes the cluster version (fencing, see module docstring).
+        """
+        addr = self._coordinator_address()
+        with stall_detector(f"jax.distributed.initialize({addr})", force=True):
+            jax.distributed.initialize(
+                coordinator_address=addr,
+                num_processes=self.size,
+                process_id=self.rank,
+            )
+        self._dist_initialized = True
+
+    def _build_session(self) -> Session:
+        # hierarchical (ici x dcn) mesh whenever there are multiple hosts AND
+        # multiple devices per host — the device count is what matters (one
+        # process per host owning several chips is the standard TPU shape)
+        devices_per_host = max(1, len(jax.devices()) // self.host_count)
+        if self.host_count > 1 and devices_per_host > 1:
+            mesh = make_hierarchical_mesh(self.host_count)
+        else:
+            mesh = make_mesh(dp=-1)
+        return Session(mesh=mesh, strategy=self.config.strategy, host_count=self.host_count)
+
+    def current_session(self) -> Session:
+        if not self._started:
+            self.start()
+        assert self._session is not None
+        return self._session
+
+    def close(self) -> None:
+        if self._dist_initialized:
+            try:
+                jax.distributed.shutdown()
+            except Exception as e:  # pragma: no cover
+                log.warning("distributed shutdown: %s", e)
+            self._dist_initialized = False
+        self._started = False
+        self._session = None
+
+    # -- elasticity hooks (full protocol in kungfu_tpu/elastic/) ----------------------
+
+    def update_cluster(self, cluster: Cluster, version: int) -> bool:
+        """Adopt a new cluster config; returns False if self was removed.
+
+        The reference equivalent is Peer.updateTo (peer/peer.go:144-166):
+        reset connections with the new token, rebuild the Session, barrier.
+        Here: tear down jax.distributed, adopt the new peer list, re-init
+        with the version-fenced coordinator, rebuild mesh+Session.
+        """
+        if cluster.workers.rank(self.self_id) is None:
+            self.detached = True
+            log.info("detached from cluster at version %d", version)
+            return False
+        self.close()
+        self.config = kfenv.Config(
+            self_id=self.self_id,
+            peers=cluster.workers,
+            runners=cluster.runners,
+            cluster_version=version,
+            strategy=self.config.strategy,
+            config_server=self.config.config_server,
+            parent=self.config.parent,
+            single_machine=self.config.single_machine,
+        )
+        self.cluster_version = version
+        self.start()
+        return True
+
+
+# -- module singleton (reference src/python/init.cpp:12-41 _default_peer) -------------
+
+_default_peer: Optional[Peer] = None
+
+
+def default_peer() -> Peer:
+    global _default_peer
+    if _default_peer is None:
+        _default_peer = Peer().start()
+        atexit.register(finalize_default_peer)
+    return _default_peer
+
+
+def set_default_peer(p: Optional[Peer]) -> None:
+    global _default_peer
+    _default_peer = p
+
+
+def finalize_default_peer() -> None:
+    global _default_peer
+    if _default_peer is not None:
+        _default_peer.close()
+        _default_peer = None

@@ -1,0 +1,42 @@
+"""Cluster/topology planning layer (reference: srcs/go/plan)."""
+from .peer import (
+    PeerID,
+    PeerList,
+    HostSpec,
+    HostList,
+    Cluster,
+    DEFAULT_RUNNER_PORT,
+    DEFAULT_WORKER_PORT_BASE,
+)
+from .graph import (
+    Graph,
+    gen_tree,
+    gen_binary_tree,
+    gen_star_bcast_graph,
+    gen_binary_tree_star,
+    gen_multi_binary_tree_star,
+    gen_circular_graph_pair,
+    gen_default_reduce_graph,
+    minimum_spanning_tree,
+)
+from .strategy import Strategy, Impl, DEFAULT_STRATEGY, resolve_auto, impl_of, strategy_graphs
+from .mesh import (
+    MeshSpec,
+    make_mesh,
+    make_hierarchical_mesh,
+    data_sharding,
+    replicated,
+    mesh_digest,
+    AXIS_ORDER,
+)
+
+__all__ = [
+    "PeerID", "PeerList", "HostSpec", "HostList", "Cluster",
+    "DEFAULT_RUNNER_PORT", "DEFAULT_WORKER_PORT_BASE",
+    "Graph", "gen_tree", "gen_binary_tree", "gen_star_bcast_graph",
+    "gen_binary_tree_star", "gen_multi_binary_tree_star",
+    "gen_circular_graph_pair", "gen_default_reduce_graph", "minimum_spanning_tree",
+    "Strategy", "Impl", "DEFAULT_STRATEGY", "resolve_auto", "impl_of", "strategy_graphs",
+    "MeshSpec", "make_mesh", "make_hierarchical_mesh", "data_sharding",
+    "replicated", "mesh_digest", "AXIS_ORDER",
+]

@@ -1,0 +1,263 @@
+"""Directed communication graphs and topology generators.
+
+Re-design of the reference topology math (srcs/go/plan/graph/graph.go and
+srcs/go/plan/topology.go).  On TPU the *intra-program* collective routing is
+XLA's job, but the graph algebra still matters for:
+
+  - the strategy abstraction (which collective *implementation* a step uses),
+  - hierarchical (ICI-then-DCN) grouping: star-within-host / tree-across-hosts
+    becomes two nested mesh axes,
+  - runtime topology swap (`set_tree`) parity and its consensus digest,
+  - minimum-spanning-tree from measured latencies (include/kungfu/mst.hpp).
+
+A graph pairs with its reverse: reduce along G, broadcast along reverse(G)
+(reference GenDefaultReduceGraph, topology.go:33-40).
+"""
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+@dataclass
+class Node:
+    rank: int
+    self_loop: bool = False
+    nexts: List[int] = field(default_factory=list)
+    prevs: List[int] = field(default_factory=list)
+
+
+class Graph:
+    """Digraph over ranks 0..n-1 with optional self-loops.
+
+    Self-loops mark aggregation roots in reduce graphs (reference
+    graph/graph.go:29-60).
+    """
+
+    def __init__(self, n: int):
+        self.nodes = [Node(i) for i in range(n)]
+
+    def __len__(self) -> int:
+        return len(self.nodes)
+
+    def add_edge(self, i: int, j: int) -> None:
+        if i == j:
+            self.nodes[i].self_loop = True
+            return
+        self.nodes[i].nexts.append(j)
+        self.nodes[j].prevs.append(i)
+
+    def nexts(self, i: int) -> List[int]:
+        return list(self.nodes[i].nexts)
+
+    def prevs(self, i: int) -> List[int]:
+        return list(self.nodes[i].prevs)
+
+    def is_self_loop(self, i: int) -> bool:
+        return self.nodes[i].self_loop
+
+    def reverse(self) -> "Graph":
+        g = Graph(len(self))
+        for nd in self.nodes:
+            if nd.self_loop:
+                g.nodes[nd.rank].self_loop = True
+            for j in nd.nexts:
+                g.add_edge(j, nd.rank)
+        return g
+
+    @classmethod
+    def from_forest_array(cls, father: Sequence[int]) -> "Graph":
+        """Father-array encoding: father[i] == i marks a root (self-loop).
+
+        Reference FromForestArray (graph/graph.go:96-126); used by the
+        `set_tree` runtime-topology-swap op.
+        """
+        n = len(father)
+        g = cls(n)
+        for i, f in enumerate(father):
+            if not (0 <= f < n):
+                raise ValueError(f"father[{i}]={f} out of range")
+            if f == i:
+                g.nodes[i].self_loop = True
+            else:
+                # edges point root-ward in the reduce graph: child -> father
+                g.add_edge(i, f)
+        return g
+
+    def to_forest_array(self) -> List[int]:
+        out = []
+        for nd in self.nodes:
+            if nd.nexts:
+                out.append(nd.nexts[0])
+            else:
+                out.append(nd.rank)
+        return out
+
+    def digest_bytes(self) -> bytes:
+        """Deterministic encoding for consensus (graph/graph.go:137-146)."""
+        parts = []
+        for nd in self.nodes:
+            parts.append(f"{nd.rank}:{int(nd.self_loop)}:{','.join(map(str, sorted(nd.nexts)))}")
+        return hashlib.sha256("|".join(parts).encode()).digest()
+
+    def edges(self) -> List[Tuple[int, int]]:
+        return [(nd.rank, j) for nd in self.nodes for j in nd.nexts]
+
+    def is_valid_tree(self, root: Optional[int] = None) -> bool:
+        """Broadcast-tree invariant: every non-root has exactly one prev."""
+        roots = [nd.rank for nd in self.nodes if nd.self_loop]
+        if root is not None and roots != [root]:
+            return False
+        if len(roots) != 1:
+            return False
+        r = roots[0]
+        seen = {r}
+        frontier = [r]
+        while frontier:
+            nxt = []
+            for i in frontier:
+                for j in self.nodes[i].nexts:
+                    if j in seen:
+                        return False
+                    seen.add(j)
+                    nxt.append(j)
+            frontier = nxt
+        return len(seen) == len(self)
+
+
+# --- generators (reference srcs/go/plan/topology.go) ---------------------------------
+
+
+def gen_tree(n: int) -> Graph:
+    """Flat star rooted at 0 (topology.go:17-31): bcast graph 0 -> all."""
+    g = Graph(n)
+    g.add_edge(0, 0)
+    for i in range(1, n):
+        g.add_edge(0, i)
+    return g
+
+
+def gen_star_bcast_graph(n: int, root: int = 0) -> Graph:
+    """Star rooted at `root` (topology.go:138-147)."""
+    g = Graph(n)
+    g.add_edge(root, root)
+    for i in range(n):
+        if i != root:
+            g.add_edge(root, i)
+    return g
+
+
+def gen_binary_tree(n: int) -> Graph:
+    """Binary bcast tree rooted at 0 with heap-index children (topology.go:42-56)."""
+    g = Graph(n)
+    if n == 0:
+        return g
+    g.add_edge(0, 0)
+    for i in range(n):
+        l, r = 2 * i + 1, 2 * i + 2
+        if l < n:
+            g.add_edge(i, l)
+        if r < n:
+            g.add_edge(i, r)
+    return g
+
+
+def gen_default_reduce_graph(bcast: Graph) -> Graph:
+    """Reverse the bcast tree and add self-loops everywhere (topology.go:33-40)."""
+    g = bcast.reverse()
+    for nd in g.nodes:
+        nd.self_loop = True
+    return g
+
+
+def gen_binary_tree_star(hosts: Sequence[Sequence[int]]) -> Graph:
+    """Star within each host + binary tree across local masters.
+
+    The reference default strategy (topology.go:103-136): rank lists grouped
+    by host; each host's first rank is the local master; masters form a
+    binary tree (heap order); members hang off their master.
+    Returns the broadcast graph.
+    """
+    n = sum(len(h) for h in hosts)
+    g = Graph(n)
+    masters = [h[0] for h in hosts if h]
+    if not masters:
+        return g
+    g.add_edge(masters[0], masters[0])
+    for i, m in enumerate(masters):
+        l, r = 2 * i + 1, 2 * i + 2
+        if l < len(masters):
+            g.add_edge(m, masters[l])
+        if r < len(masters):
+            g.add_edge(m, masters[r])
+    for h in hosts:
+        for x in h[1:]:
+            g.add_edge(h[0], x)
+    return g
+
+
+def gen_multi_binary_tree_star(hosts: Sequence[Sequence[int]]) -> List[Graph]:
+    """k rotated binary-tree-star graphs, one rooted per host (topology.go:107).
+
+    Multi-graph load spreading: chunk i uses graph i%k.
+    """
+    k = max(1, len([h for h in hosts if h]))
+    out = []
+    for r in range(k):
+        rotated = list(hosts[r:]) + list(hosts[:r])
+        out.append(gen_binary_tree_star(rotated))
+    return out
+
+
+def gen_circular_graph_pair(n: int, shift: int = 0) -> Tuple[Graph, Graph]:
+    """Ring reduce/bcast pair shifted by `shift` (topology.go:149-177).
+
+    Reduce graph: chain r0 -> r1 -> ... -> r_{n-1} (root at end, self-loops
+    everywhere for aggregation); bcast graph: chain from the root back.
+    """
+    order = [(shift + i) % n for i in range(n)]
+    reduce_g = Graph(n)
+    bcast_g = Graph(n)
+    for i in order:
+        reduce_g.nodes[i].self_loop = True
+    for a, b in zip(order, order[1:]):
+        reduce_g.add_edge(a, b)
+    root = order[-1]
+    bcast_g.add_edge(root, root)
+    for a, b in zip(reversed(order), list(reversed(order))[1:]):
+        bcast_g.add_edge(a, b)
+    return reduce_g, bcast_g
+
+
+def gen_clique_graph_pairs(n: int) -> List[Tuple[Graph, Graph]]:
+    """n star pairs, one rooted at each rank (CLIQUE strategy, strategy.go:145-154)."""
+    out = []
+    for r in range(n):
+        b = gen_star_bcast_graph(n, root=r)
+        out.append((gen_default_reduce_graph(b), b))
+    return out
+
+
+def minimum_spanning_tree(latency: Sequence[Sequence[float]]) -> List[int]:
+    """Prim's MST over a symmetric latency matrix -> father array.
+
+    Reference include/kungfu/mst.hpp:10-59 (used by the MinimumSpanningTree
+    op to derive a latency-optimal broadcast tree at runtime).
+    """
+    n = len(latency)
+    if n == 0:
+        return []
+    father = [0] * n
+    in_tree = [False] * n
+    best = [float("inf")] * n
+    best[0] = 0.0
+    father[0] = 0
+    for _ in range(n):
+        u = min((i for i in range(n) if not in_tree[i]), key=lambda i: best[i])
+        in_tree[u] = True
+        for v in range(n):
+            if not in_tree[v] and latency[u][v] < best[v]:
+                best[v] = latency[u][v]
+                father[v] = u
+    return father

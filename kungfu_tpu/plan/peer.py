@@ -1,0 +1,312 @@
+"""Peer / host / cluster topology data model.
+
+TPU-native re-design of the reference plan layer (srcs/go/plan/{id,peerlist,
+hostspec,cluster}.go).  A *peer* is one worker process controlling a set of
+TPU chips; the *cluster* document (runners + workers) is what the elastic
+config service stores and what membership consensus agrees on.
+
+Reference semantics preserved:
+  - PeerID = (host, port)            (srcs/go/plan/id.go:8)
+  - PeerList rank/local_rank/host_count/diff/disjoint
+                                      (srcs/go/plan/peerlist.go:40-187)
+  - HostSpec "ip:slots[:pubAddr]"     (srcs/go/plan/hostspec.go:28-216)
+  - Cluster validate/resize/grow-one-on-least-loaded-host
+                                      (srcs/go/plan/cluster.go:75-118)
+  - deterministic byte digest for consensus (srcs/go/plan/graph/graph.go:137-146)
+
+The TPU build keeps ports purely as process identity (the data plane is XLA
+over ICI/DCN, not TCP), but the control plane (config server, launcher,
+membership consensus) still speaks this document format.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+DEFAULT_RUNNER_PORT = 38080  # reference: srcs/go/plan/hostspec.go:126
+DEFAULT_WORKER_PORT_BASE = 10000  # reference: srcs/go/plan/hostspec.go:121
+DEFAULT_WORKER_PORT_LIMIT = 11000
+
+
+@dataclass(frozen=True, order=True)
+class PeerID:
+    """Identity of one worker process: (host, port).
+
+    The reference packs IPv4 into a uint32 (srcs/go/plan/id.go:8); we keep the
+    host as a string so hostnames and test aliases work, and derive stable
+    bytes for digests from the canonical "host:port" form.
+    """
+
+    host: str
+    port: int
+
+    def __str__(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    @classmethod
+    def parse(cls, s: str) -> "PeerID":
+        host, _, port = s.rpartition(":")
+        if not host or not port:
+            raise ValueError(f"invalid peer spec: {s!r}")
+        return cls(host=host, port=int(port))
+
+    def to_json(self) -> dict:
+        return {"host": self.host, "port": self.port}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "PeerID":
+        return cls(host=d["host"], port=int(d["port"]))
+
+    @property
+    def colocated_with(self):
+        return lambda other: other.host == self.host
+
+
+class PeerList(tuple):
+    """Ordered, immutable list of PeerIDs. Rank == index.
+
+    Mirrors srcs/go/plan/peerlist.go: Rank (peerlist.go:49), LocalRank
+    (index among same-host peers), HostCount, PartitionByHost, set algebra
+    Diff/Disjoint used by the elastic resize diffing.
+    """
+
+    def __new__(cls, peers: Iterable[PeerID] = ()):
+        return super().__new__(cls, tuple(peers))
+
+    def rank(self, p: PeerID) -> Optional[int]:
+        try:
+            return self.index(p)
+        except ValueError:
+            return None
+
+    def local_rank(self, p: PeerID) -> Optional[int]:
+        r = 0
+        for q in self:
+            if q == p:
+                return r
+            if q.host == p.host:
+                r += 1
+        return None
+
+    def local_size(self, p: PeerID) -> int:
+        return sum(1 for q in self if q.host == p.host)
+
+    def host_count(self) -> int:
+        return len({p.host for p in self})
+
+    def hosts(self) -> List[str]:
+        """Distinct hosts in first-appearance order."""
+        seen: Dict[str, None] = {}
+        for p in self:
+            seen.setdefault(p.host, None)
+        return list(seen)
+
+    def partition_by_host(self) -> Dict[str, "PeerList"]:
+        out: Dict[str, List[PeerID]] = {}
+        for p in self:
+            out.setdefault(p.host, []).append(p)
+        return {h: PeerList(v) for h, v in out.items()}
+
+    def local_masters(self) -> "PeerList":
+        """First peer of each host (the local root in hierarchical collectives)."""
+        seen: Dict[str, PeerID] = {}
+        for p in self:
+            seen.setdefault(p.host, p)
+        return PeerList(seen.values())
+
+    def diff(self, other: "PeerList") -> "PeerList":
+        """Peers in self but not in other (order preserved)."""
+        o = set(other)
+        return PeerList(p for p in self if p not in o)
+
+    def intersection(self, other: "PeerList") -> "PeerList":
+        o = set(other)
+        return PeerList(p for p in self if p in o)
+
+    def disjoint(self, other: "PeerList") -> bool:
+        return not set(self) & set(other)
+
+    def eq(self, other: "PeerList") -> bool:
+        return tuple(self) == tuple(other)
+
+    def bytes(self) -> bytes:
+        return ";".join(str(p) for p in self).encode()
+
+    def digest(self) -> str:
+        return hashlib.sha256(self.bytes()).hexdigest()[:16]
+
+    def to_json(self) -> list:
+        return [p.to_json() for p in self]
+
+    @classmethod
+    def from_json(cls, xs: list) -> "PeerList":
+        return cls(PeerID.from_json(x) for x in xs)
+
+    def __repr__(self) -> str:
+        return f"PeerList[{', '.join(str(p) for p in self)}]"
+
+
+@dataclass(frozen=True)
+class HostSpec:
+    """One host entry: "ip:slots[:pubAddr]" (srcs/go/plan/hostspec.go:28-77).
+
+    `slots` is the number of worker processes this host can run (on TPU, a
+    process typically owns all local chips, so slots is usually 1 per host —
+    but single-host multi-process CPU testing uses slots=N).
+    """
+
+    host: str
+    slots: int
+    pub_addr: str = ""
+
+    def __post_init__(self):
+        if self.slots < 0:
+            raise ValueError(f"negative slots: {self.slots}")
+        if not self.pub_addr:
+            object.__setattr__(self, "pub_addr", self.host)
+
+    @classmethod
+    def parse(cls, s: str) -> "HostSpec":
+        parts = s.split(":")
+        if len(parts) == 1:
+            return cls(host=parts[0], slots=1)
+        if len(parts) == 2:
+            return cls(host=parts[0], slots=int(parts[1]))
+        if len(parts) == 3:
+            return cls(host=parts[0], slots=int(parts[1]), pub_addr=parts[2])
+        raise ValueError(f"invalid host spec: {s!r}")
+
+    def __str__(self) -> str:
+        if self.pub_addr != self.host:
+            return f"{self.host}:{self.slots}:{self.pub_addr}"
+        return f"{self.host}:{self.slots}"
+
+
+class HostList(tuple):
+    """Comma-separated host specs: "ip1:4,ip2:4" (srcs/go/plan/hostspec.go:79-216)."""
+
+    def __new__(cls, specs: Iterable[HostSpec] = ()):
+        return super().__new__(cls, tuple(specs))
+
+    @classmethod
+    def parse(cls, s: str) -> "HostList":
+        s = s.strip()
+        if not s:
+            return cls()
+        return cls(HostSpec.parse(x) for x in s.split(",") if x)
+
+    def cap(self) -> int:
+        return sum(h.slots for h in self)
+
+    def gen_peer_list(
+        self,
+        np: int,
+        port_base: int = DEFAULT_WORKER_PORT_BASE,
+        port_limit: int = DEFAULT_WORKER_PORT_LIMIT,
+    ) -> PeerList:
+        """Host-major fill: host0 uses its slots first, then host1, ...
+
+        Matches the reference GenPeerList fill order and default worker port
+        range (srcs/go/plan/hostspec.go:121,199-216).
+        """
+        if np > self.cap():
+            raise ValueError(f"np={np} exceeds capacity {self.cap()}")
+        peers: List[PeerID] = []
+        for h in self:
+            for i in range(h.slots):
+                if len(peers) >= np:
+                    return PeerList(peers)
+                port = port_base + i
+                if port >= port_limit:
+                    raise ValueError("port range exhausted")
+                peers.append(PeerID(h.host, port))
+        return PeerList(peers)
+
+    def gen_runner_list(self, port: int = DEFAULT_RUNNER_PORT) -> PeerList:
+        return PeerList(PeerID(h.host, port) for h in self)
+
+    def __str__(self) -> str:
+        return ",".join(str(h) for h in self)
+
+
+@dataclass
+class Cluster:
+    """The elastic cluster document: runners (one per host) + workers.
+
+    This is the JSON blob the config server stores and PUT/GET versions of
+    (reference srcs/go/plan/cluster.go, configserver.go:42-110). Workers are
+    the ranked PeerList used to build the device mesh; runners are the
+    per-host supervisors that receive update notifications.
+    """
+
+    runners: PeerList
+    workers: PeerList
+
+    def validate(self) -> None:
+        # every worker's host must have a runner (cluster.go:75-87)
+        runner_hosts = {r.host for r in self.runners}
+        for w in self.workers:
+            if w.host not in runner_hosts:
+                raise ValueError(f"worker {w} has no runner on its host")
+        if len(set(self.workers)) != len(self.workers):
+            raise ValueError("duplicate workers")
+        if len(set(self.runners)) != len(self.runners):
+            raise ValueError("duplicate runners")
+
+    def size(self) -> int:
+        return len(self.workers)
+
+    def resize(self, new_size: int) -> "Cluster":
+        """Shrink from the tail / grow one-at-a-time on the least-loaded host.
+
+        Mirrors Cluster.Resize + growOne (srcs/go/plan/cluster.go:88-118).
+        """
+        if new_size < 0:
+            raise ValueError("negative size")
+        workers = list(self.workers)
+        if new_size <= len(workers):
+            workers = workers[:new_size]
+        else:
+            while len(workers) < new_size:
+                workers.append(self._grow_one(PeerList(workers)))
+        c = Cluster(runners=self.runners, workers=PeerList(workers))
+        c.validate()
+        return c
+
+    def _grow_one(self, workers: PeerList) -> PeerID:
+        # least-loaded runner host gets the next worker (cluster.go:107-118)
+        load = {r.host: 0 for r in self.runners}
+        used_ports: Dict[str, set] = {r.host: set() for r in self.runners}
+        for w in workers:
+            if w.host in load:
+                load[w.host] += 1
+                used_ports[w.host].add(w.port)
+        host = min(load, key=lambda h: (load[h], list(load).index(h)))
+        port = DEFAULT_WORKER_PORT_BASE
+        while port in used_ports[host]:
+            port += 1
+        return PeerID(host, port)
+
+    def bytes(self) -> bytes:
+        return json.dumps(self.to_json(), sort_keys=True).encode()
+
+    def digest(self) -> str:
+        return hashlib.sha256(self.bytes()).hexdigest()[:16]
+
+    def to_json(self) -> dict:
+        return {"runners": self.runners.to_json(), "workers": self.workers.to_json()}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "Cluster":
+        return cls(
+            runners=PeerList.from_json(d["runners"]),
+            workers=PeerList.from_json(d["workers"]),
+        )
+
+    @classmethod
+    def from_hostlist(cls, hl: HostList, np: int) -> "Cluster":
+        c = cls(runners=hl.gen_runner_list(), workers=hl.gen_peer_list(np))
+        c.validate()
+        return c

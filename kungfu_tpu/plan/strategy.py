@@ -1,0 +1,123 @@
+"""Collective strategies — runtime-selectable allreduce implementations.
+
+The reference enumerates message-routing topologies executed by its Go engine
+(srcs/go/kungfu/base/strategy.go:10-23, graphs built in
+srcs/go/kungfu/session/strategy.go:90-210).  Under XLA the single-program
+collective is compiled, so "strategy" becomes *which lowering* we ask for:
+
+  STAR / TREE / BINARY_TREE      -> plain `psum` (XLA picks the ICI algorithm)
+  RING                            -> explicit chunked ppermute ring
+                                     (ops/collective.py:ring_all_reduce)
+  CLIQUE / MULTI_*                -> reduce_scatter + all_gather phased
+                                     (bandwidth-optimal, spreads load like the
+                                     reference's multi-graph sharding)
+  BINARY_TREE_STAR / MULTI_BINARY_TREE_STAR
+                                  -> hierarchical two-level (ici axis then dcn
+                                     axis), the GenBinaryTreeStar analog
+  AUTO                            -> single host: psum; multi host: hierarchical
+                                     (reference strategy.go:165-174)
+
+Strategies are swappable between steps (each maps to a separately compiled
+step function; swap = run the other compiled program) — the analog of
+`SetGlobalStrategy` (session/adaptation.go:8-20).
+"""
+from __future__ import annotations
+
+import enum
+from typing import List, Sequence, Tuple
+
+from . import graph as G
+
+
+class Strategy(enum.Enum):
+    STAR = "STAR"
+    MULTI_STAR = "MULTI_STAR"
+    RING = "RING"
+    CLIQUE = "CLIQUE"
+    TREE = "TREE"
+    BINARY_TREE = "BINARY_TREE"
+    BINARY_TREE_STAR = "BINARY_TREE_STAR"  # reference default
+    MULTI_BINARY_TREE_STAR = "MULTI_BINARY_TREE_STAR"
+    AUTO = "AUTO"
+
+    @classmethod
+    def parse(cls, s: str) -> "Strategy":
+        try:
+            return cls[s.upper().replace("-", "_")]
+        except KeyError:
+            raise ValueError(f"unknown strategy {s!r}; one of {[m.name for m in cls]}")
+
+
+DEFAULT_STRATEGY = Strategy.BINARY_TREE_STAR
+
+
+def resolve_auto(strategy: Strategy, host_count: int) -> Strategy:
+    """AUTO -> STAR on one host else BINARY_TREE_STAR (strategy.go:165-174)."""
+    if strategy is not Strategy.AUTO:
+        return strategy
+    return Strategy.STAR if host_count <= 1 else Strategy.BINARY_TREE_STAR
+
+
+# The in-XLA implementation each strategy lowers to (see ops/collective.py).
+class Impl(enum.Enum):
+    PSUM = "psum"                    # one-shot XLA all-reduce
+    RS_AG = "reduce_scatter_all_gather"  # phased, bandwidth-optimal
+    RING = "ring_ppermute"           # explicit ring, chunked
+    HIERARCHICAL = "hierarchical"    # per-host then cross-host (ici x dcn)
+
+
+_IMPL_OF = {
+    Strategy.STAR: Impl.PSUM,
+    Strategy.TREE: Impl.PSUM,
+    Strategy.BINARY_TREE: Impl.PSUM,
+    Strategy.MULTI_STAR: Impl.RS_AG,
+    Strategy.CLIQUE: Impl.RS_AG,
+    Strategy.RING: Impl.RING,
+    Strategy.BINARY_TREE_STAR: Impl.HIERARCHICAL,
+    Strategy.MULTI_BINARY_TREE_STAR: Impl.HIERARCHICAL,
+}
+
+
+def impl_of(strategy: Strategy, host_count: int = 1) -> Impl:
+    s = resolve_auto(strategy, host_count)
+    impl = _IMPL_OF[s]
+    # hierarchical degenerates to flat psum on a single host
+    if impl is Impl.HIERARCHICAL and host_count <= 1:
+        return Impl.PSUM
+    return impl
+
+
+def strategy_graphs(
+    strategy: Strategy, hosts: Sequence[Sequence[int]]
+) -> List[Tuple[G.Graph, G.Graph]]:
+    """(reduceGraph, bcastGraph) pairs for a strategy — parity with the
+    reference graph builders (session/strategy.go:90-163); used for digests,
+    tests, and the DCN-level routing plan (not for intra-program ICI routing,
+    which XLA owns).
+    """
+    n = sum(len(h) for h in hosts)
+    s = resolve_auto(strategy, len([h for h in hosts if h]))
+    if s in (Strategy.STAR, Strategy.TREE):
+        b = G.gen_tree(n)
+        return [(G.gen_default_reduce_graph(b), b)]
+    if s is Strategy.BINARY_TREE:
+        b = G.gen_binary_tree(n)
+        return [(G.gen_default_reduce_graph(b), b)]
+    if s is Strategy.BINARY_TREE_STAR:
+        b = G.gen_binary_tree_star(hosts)
+        return [(G.gen_default_reduce_graph(b), b)]
+    if s is Strategy.MULTI_BINARY_TREE_STAR:
+        return [
+            (G.gen_default_reduce_graph(b), b)
+            for b in G.gen_multi_binary_tree_star(hosts)
+        ]
+    if s is Strategy.MULTI_STAR:
+        return [
+            (G.gen_default_reduce_graph(G.gen_star_bcast_graph(n, r)), G.gen_star_bcast_graph(n, r))
+            for r in range(min(n, len(hosts)))
+        ]
+    if s is Strategy.CLIQUE:
+        return G.gen_clique_graph_pairs(n)
+    if s is Strategy.RING:
+        return [G.gen_circular_graph_pair(n, shift=k) for k in range(min(n, 4))]
+    raise ValueError(f"unhandled strategy {s}")

@@ -1,0 +1,219 @@
+"""Session — the collective engine bound to one mesh + strategy.
+
+TPU re-design of the reference Session (srcs/go/kungfu/session/session.go:
+21-37): where the reference holds a PeerList plus reduce/bcast strategy
+graphs and executes message passing (runGraphs, session.go:218-286), this
+Session holds a `jax.sharding.Mesh` plus a Strategy and compiles collectives
+with XLA.  A strategy swap (`set_strategy`, the SetGlobalStrategy analog,
+session/adaptation.go:8-20) switches which compiled implementation later
+calls use — compilation caches make the swap cheap after first use.
+
+Value convention: a "per-peer tensor" is represented single-controller style
+as an array whose leading dim equals the number of participating devices,
+sharded over the session's data axes.  `all_reduce` returns the same shape
+with every slice equal to the reduction — matching the reference semantics
+where every peer ends with the reduced tensor.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.6
+    from jax import shard_map as _shard_map
+
+    def shard_map(f, mesh, in_specs, out_specs):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _old_shard_map
+
+    def shard_map(f, mesh, in_specs, out_specs):
+        return _old_shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+from .ops import collective as C
+from .plan import Strategy, Impl, impl_of, make_mesh
+from .utils import get_logger, stall_detector
+
+log = get_logger("kungfu.session")
+
+
+class OpStats:
+    """Per-named-op throughput accounting (reference session/strategy.go:22-56).
+
+    The first call per op name is excluded from throughput: under XLA it pays
+    trace+compile cost and would swamp the interference signal.
+    """
+
+    def __init__(self):
+        self.calls: Dict[str, List[Tuple[int, float]]] = {}
+        self._warmed: set = set()
+
+    def record(self, name: str, nbytes: int, seconds: float) -> None:
+        if name not in self._warmed:
+            self._warmed.add(name)
+            return
+        self.calls.setdefault(name, []).append((nbytes, seconds))
+
+    def throughput(self, name: Optional[str] = None) -> float:
+        """Bytes/sec over recorded calls (all ops if name is None)."""
+        items = (
+            self.calls.get(name, [])
+            if name is not None
+            else [x for v in self.calls.values() for x in v]
+        )
+        total_b = sum(b for b, _ in items)
+        total_s = sum(s for _, s in items)
+        return total_b / total_s if total_s > 0 else 0.0
+
+    def reset(self) -> None:
+        self.calls.clear()
+
+
+class Session:
+    """Collective session over a device mesh.
+
+    Args:
+      mesh: the device mesh; default = 1-D "dp" mesh over all local devices.
+      strategy: initial collective strategy (AUTO resolves by host count).
+      host_count: number of hosts backing the mesh (drives AUTO + hierarchical).
+    """
+
+    def __init__(
+        self,
+        mesh: Optional[Mesh] = None,
+        strategy: Strategy = Strategy.AUTO,
+        host_count: int = 1,
+    ):
+        self.mesh = mesh if mesh is not None else make_mesh(dp=-1)
+        self.strategy = strategy
+        self.host_count = host_count
+        self.stats = OpStats()
+        self._fns: Dict[Any, Callable] = {}
+        names = self.mesh.axis_names
+        self._hierarchical_axes = ("ici", "dcn") if ("ici" in names and "dcn" in names) else None
+        self._axes: Tuple[str, ...] = tuple(names)
+
+    # -- properties -------------------------------------------------------------------
+
+    @property
+    def size(self) -> int:
+        return int(np.prod([self.mesh.shape[a] for a in self._axes]))
+
+    def set_strategy(self, strategy: Strategy) -> None:
+        """Runtime strategy swap (SetGlobalStrategy analog)."""
+        log.info("strategy swap: %s -> %s", self.strategy.name, strategy.name)
+        self.strategy = strategy
+
+    def _impl(self, strategy: Optional[Strategy]) -> Impl:
+        s = strategy if strategy is not None else self.strategy
+        impl = impl_of(s, self.host_count)
+        if impl is Impl.HIERARCHICAL and self._hierarchical_axes is None:
+            impl = Impl.RS_AG  # no ici/dcn split on this mesh
+        if impl is Impl.RING and len(self._axes) != 1:
+            impl = Impl.RS_AG  # explicit ring needs a single data axis
+        return impl
+
+    # -- compiled collective builders -------------------------------------------------
+
+    def _compiled(self, kind: str, op: str, impl: Impl, **kw) -> Callable:
+        key = (kind, op, impl, tuple(sorted(kw.items())))
+        fn = self._fns.get(key)
+        if fn is None:
+            fn = self._build(kind, op, impl, **kw)
+            self._fns[key] = fn
+        return fn
+
+    def _build(self, kind: str, op: str, impl: Impl, **kw) -> Callable:
+        axes = self._axes
+        axis = axes if len(axes) > 1 else axes[0]
+        spec = P(axes)
+
+        def reduce_impl(y):
+            if impl is Impl.HIERARCHICAL:
+                return C.hierarchical_all_reduce(y, "ici", "dcn", op)
+            if impl is Impl.RING:
+                return C.ring_all_reduce(y, axes[0], op)
+            if impl is Impl.RS_AG:
+                return C.rs_ag_all_reduce(y, axis, op)
+            return C.all_reduce(y, axis, op)
+
+        if kind == "all_reduce":
+            def body(x):
+                return reduce_impl(jnp.squeeze(x, 0))[None]
+        elif kind == "reduce":
+            root = kw["root"]
+            def body(x):
+                return C.reduce(jnp.squeeze(x, 0), axis, root=root, op=op)[None]
+        elif kind == "broadcast":
+            root = kw["root"]
+            def body(x):
+                return C.broadcast(jnp.squeeze(x, 0), axis, root=root)[None]
+        elif kind == "all_gather":
+            def body(x):
+                return C.all_gather(jnp.squeeze(x, 0), axis)[None]
+        elif kind == "barrier":
+            def body(x):
+                return C.barrier(axis)[None]
+        elif kind == "consensus":
+            def body(x):
+                return C.consensus(jnp.squeeze(x, 0), axis)[None]
+        else:
+            raise ValueError(kind)
+
+        return jax.jit(shard_map(body, self.mesh, in_specs=spec, out_specs=spec))
+
+    # -- public collective API (reference session/{allreduce,allgather,session}.go) ---
+
+    def _run(self, kind: str, x: jax.Array, op: str = "sum", name: str = "",
+             strategy: Optional[Strategy] = None, **kw) -> jax.Array:
+        x = jnp.asarray(x)
+        if x.shape[0] != self.size:
+            raise ValueError(
+                f"leading dim {x.shape[0]} != session size {self.size}; "
+                "per-peer tensors are stacked on dim 0"
+            )
+        impl = self._impl(strategy)
+        fn = self._compiled(kind, op, impl, **kw)
+        t0 = time.perf_counter()
+        with stall_detector(name or kind):
+            out = fn(x)
+            out.block_until_ready()
+        self.stats.record(name or kind, x.nbytes, time.perf_counter() - t0)
+        return out
+
+    def all_reduce(self, x, op: str = "sum", name: str = "", strategy=None):
+        return self._run("all_reduce", x, op=op, name=name, strategy=strategy)
+
+    def group_all_reduce(self, xs: Sequence, op: str = "sum", name: str = ""):
+        return [self.all_reduce(x, op=op, name=f"{name}/{i}") for i, x in enumerate(xs)]
+
+    def reduce(self, x, root: int = 0, op: str = "sum", name: str = ""):
+        return self._run("reduce", x, op=op, name=name, root=root)
+
+    def broadcast(self, x, root: int = 0, name: str = ""):
+        return self._run("broadcast", x, name=name, root=root)
+
+    def all_gather(self, x, name: str = ""):
+        return self._run("all_gather", x, name=name)
+
+    def barrier(self) -> None:
+        x = jnp.zeros((self.size, 1), jnp.int32)
+        self._run("barrier", x, name="barrier")
+
+    def consensus(self, x, name: str = "") -> bool:
+        """True iff all peers hold identical values (session/session.go:120-151)."""
+        out = self._run("consensus", x, name=name or "consensus")
+        return bool(np.asarray(out).all())
+
+    # -- monitoring (reference session/monitoring.go, adaptiveStrategies.go) ----------
+
+    def calc_stats(self) -> Dict[str, float]:
+        return {name: self.stats.throughput(name) for name in self.stats.calls}
+
+    def throughput(self) -> float:
+        return self.stats.throughput()

@@ -1,0 +1,5 @@
+from .log import get_logger, log
+from .stall import stall_detector
+from .ema import EMA
+
+__all__ = ["get_logger", "log", "stall_detector", "EMA"]

@@ -1,0 +1,48 @@
+"""Stall detector — watchdog around collective entry points.
+
+Reference: srcs/go/utils/stalldetector.go:14-46 + KUNGFU_CONFIG_ENABLE_STALL_
+DETECTION wrapping every cgo op (libkungfu-comm/main.go:163-179).  A ticker
+warns every `period` seconds until the wrapped operation completes; on TPU
+this catches hung collectives (e.g. one process missing from a multi-host
+program) which otherwise block silently inside XLA.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+
+from .log import get_logger
+
+log = get_logger("kungfu.stall")
+
+ENABLED_ENV = "KFT_CONFIG_ENABLE_STALL_DETECTION"
+DEFAULT_PERIOD_S = 3.0
+
+
+def enabled() -> bool:
+    return os.environ.get(ENABLED_ENV, "").lower() in ("1", "true", "yes")
+
+
+@contextlib.contextmanager
+def stall_detector(name: str, period_s: float = DEFAULT_PERIOD_S, force: bool = False):
+    """Warn '<name> stalled for N s' every period until the block exits."""
+    if not (force or enabled()):
+        yield
+        return
+    done = threading.Event()
+    t0 = time.monotonic()
+
+    def watch():
+        k = 1
+        while not done.wait(period_s):
+            log.warning("%s stalled for %.0f s", name, time.monotonic() - t0)
+            k += 1
+
+    th = threading.Thread(target=watch, daemon=True, name=f"stall-{name}")
+    th.start()
+    try:
+        yield
+    finally:
+        done.set()

@@ -1,0 +1,17 @@
+"""Test config: 8 virtual CPU devices, mirroring the reference's
+multi-node-on-one-machine strategy (SURVEY.md §4).
+
+Must configure before any backend is initialized.  Note the TPU tunnel's
+sitecustomize forces jax_platforms="axon,cpu" via jax.config, so setting the
+JAX_PLATFORMS env var alone is not enough — we override through jax.config.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,212 @@
+"""Plan-layer tests mirroring the reference Go tests
+(srcs/go/plan/{topology,hostspec}_test.go, kungfu/runner/peerspec_test.go)."""
+import pytest
+
+from kungfu_tpu.plan import (
+    Cluster,
+    Graph,
+    HostList,
+    HostSpec,
+    PeerID,
+    PeerList,
+    Strategy,
+    gen_binary_tree,
+    gen_binary_tree_star,
+    gen_circular_graph_pair,
+    gen_default_reduce_graph,
+    gen_multi_binary_tree_star,
+    gen_star_bcast_graph,
+    gen_tree,
+    minimum_spanning_tree,
+    impl_of,
+    resolve_auto,
+    strategy_graphs,
+    Impl,
+)
+
+
+def peers(*specs):
+    return PeerList(PeerID.parse(s) for s in specs)
+
+
+class TestPeerID:
+    def test_parse_roundtrip(self):
+        p = PeerID.parse("10.0.0.1:38080")
+        assert p.host == "10.0.0.1" and p.port == 38080
+        assert str(p) == "10.0.0.1:38080"
+
+    def test_parse_invalid(self):
+        with pytest.raises(ValueError):
+            PeerID.parse("nocolon")
+
+    def test_json_roundtrip(self):
+        p = PeerID("a", 1)
+        assert PeerID.from_json(p.to_json()) == p
+
+
+class TestPeerList:
+    def test_rank_local_rank(self):
+        pl = peers("h1:10000", "h1:10001", "h2:10000", "h2:10001")
+        assert pl.rank(PeerID("h2", 10000)) == 2
+        assert pl.local_rank(PeerID("h2", 10001)) == 1
+        assert pl.local_size(PeerID("h1", 10000)) == 2
+        assert pl.host_count() == 2
+        assert pl.rank(PeerID("zz", 1)) is None
+
+    def test_local_masters(self):
+        pl = peers("h1:10000", "h1:10001", "h2:10000")
+        assert list(pl.local_masters()) == [PeerID("h1", 10000), PeerID("h2", 10000)]
+
+    def test_diff_disjoint(self):
+        a = peers("h1:1", "h1:2", "h2:1")
+        b = peers("h1:2", "h3:1")
+        assert list(a.diff(b)) == [PeerID("h1", 1), PeerID("h2", 1)]
+        assert not a.disjoint(b)
+        assert a.disjoint(peers("h9:9"))
+
+    def test_digest_stable(self):
+        a = peers("h1:1", "h2:2")
+        b = peers("h1:1", "h2:2")
+        assert a.digest() == b.digest()
+        assert a.digest() != peers("h2:2", "h1:1").digest()  # order matters: ranks
+
+
+class TestHostList:
+    def test_parse(self):
+        hl = HostList.parse("192.168.1.1:4,192.168.1.2:2:pub.example.com")
+        assert hl.cap() == 6
+        assert hl[1].pub_addr == "pub.example.com"
+        assert str(hl[0]) == "192.168.1.1:4"
+
+    def test_gen_peer_list_host_major(self):
+        hl = HostList.parse("h1:2,h2:2")
+        pl = hl.gen_peer_list(3)
+        assert [str(p) for p in pl] == ["h1:10000", "h1:10001", "h2:10000"]
+
+    def test_gen_peer_list_overflow(self):
+        with pytest.raises(ValueError):
+            HostList.parse("h1:1").gen_peer_list(2)
+
+    def test_runner_list(self):
+        hl = HostList.parse("h1:2,h2:2")
+        assert [str(p) for p in hl.gen_runner_list()] == ["h1:38080", "h2:38080"]
+
+
+class TestCluster:
+    def mk(self, np=4):
+        return Cluster.from_hostlist(HostList.parse("h1:4,h2:4"), np)
+
+    def test_validate(self):
+        c = self.mk()
+        c.validate()
+        bad = Cluster(runners=peers("h1:38080"), workers=peers("h9:1"))
+        with pytest.raises(ValueError):
+            bad.validate()
+
+    def test_resize_shrink_is_prefix(self):
+        c = self.mk(4)
+        c2 = c.resize(2)
+        assert list(c2.workers) == list(c.workers)[:2]
+
+    def test_resize_grow_least_loaded(self):
+        c = self.mk(4)  # all 4 on h1
+        c2 = c.resize(5)
+        assert c2.workers[-1].host == "h2"  # least-loaded host gets growth
+        assert c2.size() == 5
+
+    def test_resize_grow_avoids_port_collision(self):
+        c = self.mk(5)  # h1 x4 + h2 x1
+        c2 = c.resize(7)
+        assert len(set(c2.workers)) == 7
+
+    def test_json_digest_roundtrip(self):
+        c = self.mk()
+        c2 = Cluster.from_json(c.to_json())
+        assert c2.digest() == c.digest()
+
+
+class TestGraph:
+    def test_forest_array_roundtrip(self):
+        father = [0, 0, 0, 1, 1]
+        g = Graph.from_forest_array(father)
+        assert g.is_self_loop(0)
+        assert not g.is_self_loop(3)
+        assert sorted(g.edges()) == [(1, 0), (2, 0), (3, 1), (4, 1)]
+
+    def test_reverse(self):
+        g = gen_tree(4)  # 0 -> 1,2,3
+        r = g.reverse()
+        assert sorted(r.edges()) == [(1, 0), (2, 0), (3, 0)]
+        assert r.is_self_loop(0)
+
+    def test_binary_tree_valid(self):
+        for n in (1, 2, 3, 7, 8, 15):
+            g = gen_binary_tree(n)
+            assert g.is_valid_tree(root=0), n
+
+    def test_star_valid(self):
+        for root in range(4):
+            g = gen_star_bcast_graph(4, root)
+            assert g.is_valid_tree(root=root)
+
+    def test_binary_tree_star(self):
+        hosts = [[0, 1, 2, 3], [4, 5, 6, 7]]
+        g = gen_binary_tree_star(hosts)
+        assert g.is_valid_tree(root=0)
+        # members hang off local masters
+        assert set(g.nexts(0)) >= {1, 2, 3}
+        assert set(g.nexts(4)) == {5, 6, 7}
+
+    def test_multi_binary_tree_star_k_graphs(self):
+        hosts = [[0, 1], [2, 3], [4, 5]]
+        gs = gen_multi_binary_tree_star(hosts)
+        assert len(gs) == 3
+        roots = [next(nd.rank for nd in g.nodes if nd.self_loop) for g in gs]
+        assert len(set(roots)) == 3  # distinct roots spread load
+
+    def test_circular_pair(self):
+        rg, bg = gen_circular_graph_pair(4)
+        assert all(rg.is_self_loop(i) for i in range(4))  # aggregation everywhere
+        assert bg.is_valid_tree()
+
+    def test_digest_deterministic(self):
+        assert gen_tree(5).digest_bytes() == gen_tree(5).digest_bytes()
+        assert gen_tree(5).digest_bytes() != gen_binary_tree(5).digest_bytes()
+
+    def test_mst(self):
+        #  0 -1- 1 -1- 2 ; 0-2 cost 10
+        lat = [[0, 1, 10], [1, 0, 1], [10, 1, 0]]
+        father = minimum_spanning_tree(lat)
+        g = Graph.from_forest_array(father)
+        # MST avoids the 0-2 edge
+        assert (0, 2) not in g.edges() and (2, 0) not in g.edges()
+        assert g.reverse().is_valid_tree() or g.is_valid_tree()
+
+
+class TestStrategy:
+    def test_parse(self):
+        assert Strategy.parse("binary-tree-star") is Strategy.BINARY_TREE_STAR
+        with pytest.raises(ValueError):
+            Strategy.parse("nope")
+
+    def test_auto_resolution(self):
+        assert resolve_auto(Strategy.AUTO, 1) is Strategy.STAR
+        assert resolve_auto(Strategy.AUTO, 4) is Strategy.BINARY_TREE_STAR
+        assert resolve_auto(Strategy.RING, 4) is Strategy.RING
+
+    def test_impl_mapping(self):
+        assert impl_of(Strategy.STAR) is Impl.PSUM
+        assert impl_of(Strategy.RING) is Impl.RING
+        assert impl_of(Strategy.CLIQUE) is Impl.RS_AG
+        assert impl_of(Strategy.BINARY_TREE_STAR, host_count=4) is Impl.HIERARCHICAL
+        assert impl_of(Strategy.BINARY_TREE_STAR, host_count=1) is Impl.PSUM
+
+    def test_strategy_graphs_cover_all_ranks(self):
+        hosts = [[0, 1, 2, 3], [4, 5, 6, 7]]
+        for s in Strategy:
+            if s is Strategy.AUTO:
+                continue
+            pairs = strategy_graphs(s, hosts)
+            assert pairs, s
+            for rg, bg in pairs:
+                assert len(rg) == 8 and len(bg) == 8
