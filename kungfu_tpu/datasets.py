@@ -1,0 +1,101 @@
+"""Datasets: idx-format loaders + synthetic fallbacks + elastic adaptor.
+
+Reference: srcs/python/kungfu/tensorflow/v1/helpers/{mnist,cifar,imagenet}.py
+(idx-format loaders) and the elastic BaseDatasetAdaptor
+(v1/datasets/adaptor.py:4-33: skip -> batch -> shard driven by named state).
+
+This environment has zero egress, so `synthetic_mnist` generates a
+deterministic linearly-separable classification problem with MNIST shapes —
+convergence tests still mean something (accuracy rises above chance only if
+the whole train loop works).  `load_mnist_idx` reads the standard idx files
+if a local copy exists.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from dataclasses import dataclass
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+
+def synthetic_mnist(
+    n: int = 8192, num_classes: int = 10, seed: int = 42, noise: float = 0.35
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Deterministic 28x28 classification data: class templates + noise."""
+    rng = np.random.RandomState(seed)
+    templates = rng.randn(num_classes, 28 * 28).astype(np.float32)
+    labels = rng.randint(0, num_classes, size=n)
+    images = templates[labels] + noise * rng.randn(n, 28 * 28).astype(np.float32)
+    return images.reshape(n, 28, 28, 1).astype(np.float32), labels.astype(np.int32)
+
+
+def load_mnist_idx(data_dir: str) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Read train-images-idx3-ubyte(.gz) if present; else None."""
+
+    def _open(path):
+        return gzip.open(path, "rb") if path.endswith(".gz") else open(path, "rb")
+
+    for images_name in ("train-images-idx3-ubyte", "train-images-idx3-ubyte.gz"):
+        ip = os.path.join(data_dir, images_name)
+        lp = ip.replace("images-idx3", "labels-idx1")
+        if not (os.path.exists(ip) and os.path.exists(lp)):
+            continue
+        with _open(ip) as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            images = np.frombuffer(f.read(), np.uint8).reshape(n, rows, cols, 1)
+        with _open(lp) as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            labels = np.frombuffer(f.read(), np.uint8).astype(np.int32)
+        return images.astype(np.float32) / 255.0, labels
+    return None
+
+
+def mnist(data_dir: str = "./data") -> Tuple[np.ndarray, np.ndarray]:
+    got = load_mnist_idx(data_dir)
+    return got if got is not None else synthetic_mnist()
+
+
+@dataclass
+class ElasticDataAdaptor:
+    """skip -> shard -> batch, resumable by global sample offset.
+
+    Reference BaseDatasetAdaptor (v1/datasets/adaptor.py:4-33): after an
+    elastic resize, training resumes from the allreduce-max'd trained-sample
+    count; each worker then reads its rank-strided shard.
+    """
+
+    images: np.ndarray
+    labels: np.ndarray
+    batch_size: int  # per-worker batch
+    rank: int = 0
+    size: int = 1
+    offset: int = 0  # global samples already consumed
+    seed: int = 0
+
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        n = len(self.images)
+        global_batch = self.batch_size * self.size
+        usable = (n // global_batch) * global_batch  # whole batches per epoch
+        if usable == 0:
+            raise ValueError(f"dataset ({n}) smaller than global batch ({global_batch})")
+        while True:
+            # epoch/pos derived from the global offset, and the permutation
+            # seeded per-epoch — a resumed iterator (same offset, any worker)
+            # continues the exact same sample stream; if the global batch
+            # changed across a resize, resume is approximate (offset rounds
+            # into the new epoch geometry), matching the reference adaptor's
+            # skip-based semantics (v1/datasets/adaptor.py:4-33)
+            epoch = self.offset // usable
+            pos = self.offset % usable
+            pos -= pos % global_batch  # re-align after a batch-geometry change
+            if pos + global_batch > usable:
+                epoch += 1
+                pos = 0
+                self.offset = epoch * usable
+            perm = np.random.RandomState((self.seed + epoch) & 0x7FFFFFFF).permutation(n)
+            idx = perm[pos + self.rank * self.batch_size : pos + (self.rank + 1) * self.batch_size]
+            yield self.images[idx], self.labels[idx]
+            self.offset += global_batch

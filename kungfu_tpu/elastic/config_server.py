@@ -1,0 +1,174 @@
+"""Elastic config service — HTTP store of one versioned Cluster document.
+
+Reference: srcs/go/kungfu/elastic/configserver/configserver.go:42-110 and the
+standalone binary (cmd/kungfu-config-server/kungfu-config-server.go:27-67):
+GET returns the current cluster (404 if cleared), PUT validates and bumps the
+version (rejected while cleared), POST installs/resets, DELETE clears; /stop
+shuts the server down.  Embeddable in the launcher (the reference's
+builtin-config-server) or standalone:
+
+    python -m kungfu_tpu.elastic.config_server -port 9100 [-init hostfile-json]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+from ..plan import Cluster
+from ..utils import get_logger
+
+log = get_logger("kungfu.configserver")
+
+
+class _State:
+    def __init__(self, init: Optional[Cluster] = None):
+        self.lock = threading.Lock()
+        self.cluster: Optional[Cluster] = init
+        self.version = 0
+        self.cleared = False
+
+    def get(self) -> Optional[Tuple[Cluster, int]]:
+        with self.lock:
+            if self.cluster is None:
+                return None
+            return self.cluster, self.version
+
+    def put(self, c: Cluster) -> Tuple[bool, str]:
+        try:
+            c.validate()
+        except ValueError as e:
+            return False, f"invalid cluster: {e}"
+        with self.lock:
+            if self.cleared:
+                # reference rejects PUT after clear until POST re-inits
+                return False, "config was cleared"
+            if self.cluster is not None and c.bytes() == self.cluster.bytes():
+                return True, "unchanged"
+            self.cluster = c
+            self.version += 1
+            log.info("config updated to version %d (%d workers)", self.version, c.size())
+            return True, "ok"
+
+    def post(self, c: Cluster) -> Tuple[bool, str]:
+        try:
+            c.validate()
+        except ValueError as e:
+            return False, f"invalid cluster: {e}"
+        with self.lock:
+            self.cluster = c
+            self.cleared = False
+            self.version += 1
+            return True, "ok"
+
+    def delete(self) -> None:
+        with self.lock:
+            self.cluster = None
+            self.cleared = True
+
+
+class ConfigServer:
+    """Threaded config server; use .start()/.stop() embedded, or serve_forever."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 9100,
+                 init: Optional[Cluster] = None):
+        self.state = _State(init)
+        state = self.state
+        stop_cb = self.stop
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet
+                log.debug(fmt, *args)
+
+            def _send(self, code: int, body: bytes = b"", ctype="application/json"):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path.startswith("/stop"):
+                    self._send(200, b"{}")
+                    threading.Thread(target=stop_cb, daemon=True).start()
+                    return
+                got = state.get()
+                if got is None:
+                    self._send(404, b'{"error": "no config"}')
+                    return
+                cluster, version = got
+                body = json.dumps({"cluster": cluster.to_json(), "version": version}).encode()
+                self._send(200, body)
+
+            def _read_cluster(self) -> Optional[Cluster]:
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    doc = json.loads(self.rfile.read(n).decode())
+                    payload = doc.get("cluster", doc)
+                    return Cluster.from_json(payload)
+                except Exception as e:
+                    self._send(400, json.dumps({"error": str(e)}).encode())
+                    return None
+
+            def do_PUT(self):
+                c = self._read_cluster()
+                if c is None:
+                    return
+                ok, msg = state.put(c)
+                self._send(200 if ok else 409, json.dumps({"msg": msg}).encode())
+
+            def do_POST(self):
+                c = self._read_cluster()
+                if c is None:
+                    return
+                ok, msg = state.post(c)
+                self._send(200 if ok else 409, json.dumps({"msg": msg}).encode())
+
+            def do_DELETE(self):
+                state.delete()
+                self._send(200, b"{}")
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}/config"
+
+    def start(self) -> "ConfigServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        log.info("config server at %s", self.url)
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        if self._thread:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser("kungfu-tpu config server")
+    ap.add_argument("-port", type=int, default=9100)
+    ap.add_argument("-host", default="0.0.0.0")
+    ap.add_argument("-init", default="", help="path to initial cluster JSON")
+    args = ap.parse_args(argv)
+    init = None
+    if args.init:
+        with open(args.init) as f:
+            init = Cluster.from_json(json.load(f))
+    srv = ConfigServer(args.host, args.port, init)
+    log.info("serving on %s", srv.url)
+    try:
+        srv._httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+"""Variable initialization sync — BroadcastGlobalVariables, TPU-native.
+
+Reference: srcs/python/kungfu/tensorflow/initializer/__init__.py:13-99
+(BroadcastGlobalVariablesOp/Hook/Callback, broadcast_variables for tape
+mode): after local init, rank 0's variables are broadcast so all workers
+start identical.
+
+On TPU two cases:
+  - single-controller (one process, jit over the mesh): params created once
+    and replicated by sharding — nothing to sync; `broadcast_params` is a
+    cheap no-op safety net that also *verifies* replication.
+  - multi-controller (one process per host): each process must hold the same
+    params.  Deterministic seeding normally guarantees it; after an elastic
+    resize, survivors broadcast to joiners via an in-program broadcast from
+    global rank 0 (see elastic/trainer.py).
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from .ops import collective as C
+
+
+def broadcast_params(params: Any, axis_name="dp", root: int = 0):
+    """In-SPMD params broadcast (use inside shard_map): every replica gets root's."""
+    return jax.tree.map(lambda p: C.broadcast(p, axis_name, root=root), params)
+
+
+def sync_check(params: Any, axis_name="dp") -> jax.Array:
+    """True iff params are identical across replicas (in-SPMD consensus)."""
+    ok = jnp.bool_(True)
+    for p in jax.tree.leaves(params):
+        ok = jnp.logical_and(ok, C.consensus(p, axis_name))
+    return ok

@@ -1,0 +1,82 @@
+"""Fake models: gradient-size lists for collective benchmarking without ML.
+
+Reference: tests/go/fakemodel/ (resnet50-imagenet.go, vgg16-imagenet.go,
+bert.go, slp-mnist.go; registry fakemodel.go:12-17) — synthetic per-tensor
+gradient sizes that exercise the full communication stack with realistic
+message-size distributions.  Rather than hard-coding the reference's lists,
+sizes are *generated*: CNN lists from the actual Flax models' parameter
+trees, the BERT list analytically from the architecture.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List
+
+import numpy as np
+import jax
+
+
+def _sizes_from_flax(model, input_shape) -> List[int]:
+    import jax.numpy as jnp
+
+    params = jax.eval_shape(
+        lambda k: model.init(k, jnp.zeros(input_shape, jnp.float32), train=False),
+        jax.random.PRNGKey(0),
+    )["params"]
+    return [int(np.prod(x.shape)) for x in jax.tree.leaves(params)]
+
+
+@functools.lru_cache(maxsize=None)
+def slp_mnist() -> tuple:
+    return (784 * 10, 10)  # weight + bias
+
+
+@functools.lru_cache(maxsize=None)
+def resnet50_imagenet() -> tuple:
+    from .resnet import ResNet50
+
+    return tuple(_sizes_from_flax(ResNet50(), (1, 224, 224, 3)))
+
+
+@functools.lru_cache(maxsize=None)
+def vgg16_imagenet() -> tuple:
+    from .vgg import VGG16
+
+    return tuple(_sizes_from_flax(VGG16(), (1, 224, 224, 3)))
+
+
+@functools.lru_cache(maxsize=None)
+def bert_base() -> tuple:
+    """BERT-base grad sizes, generated analytically (L=12, H=768, A=12, V=30522)."""
+    L, H, I, V, P, T = 12, 768, 3072, 30522, 512, 2
+    sizes: List[int] = [V * H, P * H, T * H, H, H]  # embeddings + ln
+    for _ in range(L):
+        sizes += [H * H, H] * 4          # q,k,v,out projections + biases
+        sizes += [H, H]                  # attention ln
+        sizes += [H * I, I, I * H, H]    # ffn in/out
+        sizes += [H, H]                  # output ln
+    sizes += [H * H, H, H, H]            # pooler + final ln
+    return tuple(sizes)
+
+
+REGISTRY: Dict[str, callable] = {
+    "slp-mnist": slp_mnist,
+    "resnet50-imagenet": resnet50_imagenet,
+    "vgg16-imagenet": vgg16_imagenet,
+    "bert-base": bert_base,
+}
+
+
+def get_sizes(name: str) -> List[int]:
+    if name not in REGISTRY:
+        raise ValueError(f"unknown fake model {name!r}; one of {sorted(REGISTRY)}")
+    return list(REGISTRY[name]())
+
+
+def fake_gradients(name: str, dtype=np.float32, seed: int = 0) -> List[np.ndarray]:
+    rng = np.random.RandomState(seed)
+    return [rng.randn(s).astype(dtype) for s in get_sizes(name)]
+
+
+def total_bytes(name: str, dtype=np.float32) -> int:
+    return sum(get_sizes(name)) * np.dtype(dtype).itemsize

@@ -1,0 +1,49 @@
+"""Single-layer perceptron + small MLP for MNIST-shaped data.
+
+Reference: the MNIST SLP used throughout the reference's CI as the first
+end-to-end milestone (tests/python/integration/test_mnist_slp.py and
+examples/tf2_mnist_gradient_tape.py analog).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+
+class SLP(nn.Module):
+    """784 -> 10 softmax, the reference's slp-mnist model."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+class MLP(nn.Module):
+    """Small MLP (mnist-mlp in the reference examples)."""
+
+    hidden: Tuple[int, ...] = (128, 64)
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1))
+        for h in self.hidden:
+            x = nn.relu(nn.Dense(h)(x))
+        return nn.Dense(self.num_classes)(x)
+
+
+def softmax_cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean CE over the batch; labels are int class ids."""
+    logp = jax.nn.log_softmax(logits)
+    ll = jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+    return -jnp.mean(ll)
+
+
+def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    return jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))

@@ -1,0 +1,42 @@
+"""Distributed optimizer algebra (reference: kungfu/tensorflow/optimizers/).
+
+optax-native API:
+    synchronous_sgd, synchronous_averaging, pair_averaging, adaptive_sgd,
+    gradient_noise_scale, gradient_variance, all_reduce_gradients
+
+Reference-named aliases (for users migrating from KungFu):
+    SynchronousSGDOptimizer            -> synchronous_sgd
+    SynchronousAveragingOptimizer      -> synchronous_averaging
+    PairAveragingOptimizer             -> pair_averaging
+    AdaptiveSGDOptimizer               -> adaptive_sgd
+    MonitorGradientNoiseScaleOptimizer -> gradient_noise_scale
+"""
+from .sync import all_reduce_gradients, synchronous_sgd, synchronous_averaging, SMAState
+from .gossip import pair_averaging, GossipState
+from .adaptive import adaptive_sgd, AdaptiveSGDState
+from .monitor import (
+    gradient_noise_scale,
+    gradient_variance,
+    get_noise_scale,
+    get_gradient_variance,
+    NoiseScaleState,
+    GradVarianceState,
+)
+
+# reference-style names (kungfu.tensorflow.optimizers.*)
+SynchronousSGDOptimizer = synchronous_sgd
+SynchronousAveragingOptimizer = synchronous_averaging
+PairAveragingOptimizer = pair_averaging
+AdaptiveSGDOptimizer = adaptive_sgd
+MonitorGradientNoiseScaleOptimizer = gradient_noise_scale
+MonitorGradientVarianceOptimizer = gradient_variance
+
+__all__ = [
+    "all_reduce_gradients", "synchronous_sgd", "synchronous_averaging",
+    "pair_averaging", "adaptive_sgd", "gradient_noise_scale", "gradient_variance",
+    "get_noise_scale", "get_gradient_variance",
+    "SMAState", "GossipState", "AdaptiveSGDState", "NoiseScaleState", "GradVarianceState",
+    "SynchronousSGDOptimizer", "SynchronousAveragingOptimizer",
+    "PairAveragingOptimizer", "AdaptiveSGDOptimizer",
+    "MonitorGradientNoiseScaleOptimizer", "MonitorGradientVarianceOptimizer",
+]

@@ -1,0 +1,73 @@
+"""AdaptiveSGDOptimizer — SMA early, S-SGD late, broadcast at the switch.
+
+Reference: srcs/python/kungfu/tensorflow/optimizers/ada_sgd.py:27-84.  The
+reference runs SMA (loose consensus, good for early exploration) until a
+configured step, then broadcasts rank 0's model to everyone (AdaSGDHook) and
+continues with synchronous SGD (tight consensus).  Here the phase switch is a
+`lax.cond` inside the compiled step — no hook, no separate graph.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Union, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+import optax
+
+from ..ops import collective as C
+
+AxisName = Union[str, Tuple[str, ...]]
+
+
+class AdaptiveSGDState(NamedTuple):
+    step: jax.Array
+    inner: optax.OptState
+
+
+def adaptive_sgd(
+    inner: optax.GradientTransformation,
+    switch_step: int,
+    axis_name: AxisName = "dp",
+    alpha: float = 0.1,
+) -> optax.GradientTransformation:
+    """SMA for step < switch_step, S-SGD after; rank-0 broadcast at the switch."""
+
+    def init_fn(params):
+        return AdaptiveSGDState(step=jnp.zeros((), jnp.int32), inner=inner.init(params))
+
+    def update_fn(updates, state, params):
+        if params is None:
+            raise ValueError("adaptive_sgd requires params")
+
+        def sma_branch(args):
+            g, istate, p = args
+            u, s = inner.update(g, istate, p)
+            avg = jax.tree.map(lambda x: lax.pmean(x, axis_name), p)
+            u = jax.tree.map(lambda ui, pi, av: ui + alpha * (av - pi), u, p, avg)
+            return u, s
+
+        def ssgd_branch(args):
+            g, istate, p = args
+            g = jax.tree.map(lambda x: lax.pmean(x, axis_name), g)
+            u, s = inner.update(g, istate, p)
+            # pmean makes this branch's outputs replicated; mark them varying
+            # so both cond branches have identical vma types (JAX >= 0.7)
+            return jax.tree.map(lambda x: lax.pcast(x, axis_name, to="varying"), (u, s))
+
+        u, inner_state = lax.cond(
+            state.step < switch_step, sma_branch, ssgd_branch,
+            (updates, state.inner, params),
+        )
+
+        # at the switch step, snap every replica to rank 0's model
+        # (AdaSGDHook broadcast, ada_sgd.py:61-84)
+        def sync(u_):
+            return jax.tree.map(
+                lambda ui, p: ui + (C.broadcast(p, axis_name, root=0) - p), u_, params
+            )
+
+        u = lax.cond(state.step == switch_step, sync, lambda u_: u_, u)
+        return u, AdaptiveSGDState(step=state.step + 1, inner=inner_state)
+
+    return optax.GradientTransformation(init_fn, update_fn)

@@ -1,0 +1,104 @@
+"""Gossip (pair-averaging) optimizer — AD-PSGD re-expressed for SPMD.
+
+Reference: PairAveragingOptimizer (srcs/python/kungfu/tensorflow/optimizers/
+async_sgd.py:73-140): each worker picks a random peer, *pulls* that peer's
+model from its p2p blob store (rchannel/handler/p2p.go), averages halves, and
+applies its local gradients.  The pull is asynchronous and directed: the
+requester averages, the target does not.
+
+True async pull has no XLA analog (documented deviation, SURVEY.md §7): under
+SPMD every exchange must be a compiled collective.  The faithful re-design is
+*directed ring gossip with a per-step randomized shift*:
+
+    partner_i = (i - s_t) mod n        s_t drawn from a shift set S
+    v_i <- (v_i + v_{partner_i}) / 2   (directed: i pulls, partner unaffected
+                                        by i's pull — exactly the reference's
+                                        requester-averages semantics)
+
+`lax.ppermute` needs static permutations, so s_t is selected by `lax.switch`
+over S compiled branches.  S defaults to the powers of two < n — hypercube
+gossip, whose mixing time O(log n) beats uniform-random pair gossip — plus
+shift 1.  All workers draw s_t from the same synchronized PRNG key, which
+replaces the reference's tf.random peer selector (async_sgd.py:73).
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+import optax
+
+
+class GossipState(NamedTuple):
+    inner: optax.OptState
+    key: jax.Array
+    step: jax.Array
+
+
+def _shift_set(n: int) -> Tuple[int, ...]:
+    """Powers of two < n (hypercube schedule), always including 1."""
+    s, k = [], 1
+    while k < n:
+        s.append(k)
+        k *= 2
+    return tuple(s) if s else (0,)
+
+
+def pair_averaging(
+    inner: optax.GradientTransformation,
+    axis_name: str = "dp",
+    axis_size: Optional[int] = None,
+    shifts: Optional[Sequence[int]] = None,
+    selector: str = "random",  # "random" | "roundrobin" (async_sgd peer selectors)
+    seed: int = 0,
+) -> optax.GradientTransformation:
+    """PairAveragingOptimizer: directed randomized gossip + local gradients.
+
+    Must run under shard_map with `axis_name` in scope.  `axis_size` (the
+    data-parallel world size) must be given when it cannot be inferred before
+    trace time; it is needed to build the static shift permutations.
+    """
+
+    def init_fn(params):
+        return GossipState(
+            inner=inner.init(params),
+            key=jax.random.PRNGKey(seed),
+            step=jnp.zeros((), jnp.int32),
+        )
+
+    def update_fn(updates, state, params):
+        if params is None:
+            raise ValueError("pair_averaging requires params")
+        n = axis_size if axis_size is not None else lax.axis_size(axis_name)
+        ss = tuple(shifts) if shifts is not None else _shift_set(n)
+
+        def pull(shift: int):
+            perm = [((i + shift) % n, i) for i in range(n)]  # i receives from i+shift
+
+            def f(p):
+                other = lax.ppermute(p, axis_name, perm)
+                return (p + other) * 0.5
+
+            return f
+
+        branches = [lambda t, s=s: jax.tree.map(pull(s), t) for s in ss]
+
+        key, sub = jax.random.split(state.key)
+        if n <= 1 or ss == (0,):
+            mixed = params
+        elif selector == "roundrobin":
+            idx = state.step % len(ss)
+            mixed = lax.switch(idx, branches, params)
+        else:
+            idx = jax.random.randint(sub, (), 0, len(ss))
+            mixed = lax.switch(idx, branches, params)
+
+        # apply local grads on top of the mixed model (async_sgd.py:127-140);
+        # emit everything as one optax update: (mixed - params) + inner(grads)
+        u, inner_state = inner.update(updates, state.inner, mixed)
+        u = jax.tree.map(lambda ui, m, p: ui + (m - p), u, mixed, params)
+        return u, GossipState(inner=inner_state, key=key, step=state.step + 1)
+
+    return optax.GradientTransformation(init_fn, update_fn)

@@ -75,6 +75,12 @@ class Peer:
     def start(self) -> "Peer":
         if self._started:
             return self
+        # launcher-forced backend (e.g. cpu for multi-process tests); must be
+        # applied via jax.config because the TPU tunnel's sitecustomize
+        # overrides the JAX_PLATFORMS env var
+        plat = os.environ.get("KFT_PLATFORM")
+        if plat:
+            jax.config.update("jax_platforms", plat)
         if self.size > 1 and not self.config.single_machine:
             self._init_distributed()
         self._session = self._build_session()

@@ -1,0 +1,4 @@
+from .job import Job, Proc, ChipPool
+from .launcher import ProcRunner, WatchRunner, simple_run
+
+__all__ = ["Job", "Proc", "ChipPool", "ProcRunner", "WatchRunner", "simple_run"]

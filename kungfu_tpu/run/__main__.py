@@ -1,0 +1,116 @@
+"""kungfu-tpu-run CLI — `python -m kungfu_tpu.run -np 4 python train.py`.
+
+Flag set mirrors the reference launcher (srcs/go/kungfu/runner/flags.go:28-110
+and cmd/kungfu-run/app/kungfu-run.go:18-112): -np, -H, -strategy, -w (watch),
+-k (keep), -config-server, -builtin-config-server, -logdir, -q, -timeout,
+-self/-nic discovery; TPU additions: -platform, -devices-per-worker,
+-chips-per-host.
+"""
+from __future__ import annotations
+
+import argparse
+import socket
+import sys
+
+from ..elastic.config_client import ConfigClient
+from ..elastic.config_server import ConfigServer
+from ..plan import Cluster, HostList, Strategy
+from ..utils import get_logger
+from .job import Job
+from .launcher import WatchRunner, simple_run
+
+log = get_logger("kungfu.run")
+
+
+def infer_self_ip(hostlist: HostList) -> str:
+    """Pick our address from the host list (runner/discovery.go:18-58 analog)."""
+    candidates = {h.host for h in hostlist}
+    if "127.0.0.1" in candidates or "localhost" in candidates:
+        return "127.0.0.1" if "127.0.0.1" in candidates else "localhost"
+    names = {socket.gethostname(), socket.getfqdn()}
+    try:
+        names.add(socket.gethostbyname(socket.gethostname()))
+    except OSError:
+        pass
+    for c in candidates:
+        if c in names:
+            return c
+    return sorted(candidates)[0]
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        "kungfu-tpu-run", description="launch distributed kungfu_tpu workers"
+    )
+    ap.add_argument("-np", type=int, default=1, help="total number of workers")
+    ap.add_argument("-H", dest="hosts", default="", help="host list ip:slots[:pub],...")
+    ap.add_argument("-self", dest="self_host", default="", help="this host's address")
+    ap.add_argument("-strategy", default="AUTO", help="allreduce strategy")
+    ap.add_argument("-w", dest="watch", action="store_true", help="watch (elastic) mode")
+    ap.add_argument("-k", dest="keep", action="store_true", help="keep job on worker failure")
+    ap.add_argument("-config-server", dest="config_server", default="")
+    ap.add_argument(
+        "-builtin-config-server", dest="builtin_cs", action="store_true",
+        help="embed a config server in this runner (reference builtin-config-server)",
+    )
+    ap.add_argument("-port", type=int, default=9100, help="builtin config server port")
+    ap.add_argument("-logdir", default="")
+    ap.add_argument("-q", dest="quiet", action="store_true")
+    ap.add_argument("-timeout", type=float, default=0.0, help="watch-mode timeout seconds")
+    ap.add_argument("-platform", default="", help="force worker JAX platform (e.g. cpu)")
+    ap.add_argument(
+        "-devices-per-worker", dest="devices_per_worker", type=int, default=1,
+        help="virtual devices per worker on cpu platform",
+    )
+    ap.add_argument(
+        "-chips-per-host", dest="chips_per_host", type=int, default=0,
+        help="manage TPU_VISIBLE_CHIPS slots per host",
+    )
+    ap.add_argument("prog", nargs=argparse.REMAINDER, help="worker command")
+    args = ap.parse_args(argv)
+
+    if not args.prog:
+        ap.error("missing worker command")
+    prog = args.prog
+    if prog and prog[0] == "--":
+        prog = prog[1:]
+
+    hosts = HostList.parse(args.hosts) if args.hosts else HostList.parse(f"127.0.0.1:{args.np}")
+    cluster = Cluster.from_hostlist(hosts, args.np)
+    self_host = args.self_host or infer_self_ip(hosts)
+
+    cs = None
+    config_url = args.config_server
+    if args.builtin_cs or (args.watch and not config_url):
+        cs = ConfigServer(port=args.port, init=cluster).start()
+        config_url = cs.url
+
+    job = Job(
+        prog=prog[0],
+        args=prog[1:],
+        strategy=Strategy.parse(args.strategy),
+        config_server=config_url,
+        platform=args.platform,
+        devices_per_worker=args.devices_per_worker,
+        chips_per_host=args.chips_per_host,
+    )
+
+    try:
+        if args.watch:
+            client = ConfigClient(config_url)
+            runner = WatchRunner(
+                job, self_host, client, logdir=args.logdir, quiet=args.quiet, keep=args.keep
+            )
+            rc = runner.run(initial=cluster, timeout_s=args.timeout)
+        else:
+            rc = simple_run(
+                job, cluster, self_host, logdir=args.logdir, quiet=args.quiet, keep=args.keep
+            )
+    finally:
+        if cs is not None:
+            cs.stop()
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,86 @@
+"""Worker process construction: env injection + device slot assignment.
+
+Reference: srcs/go/kungfu/job/{job,gpu_resource,cuda_visible_device}.go —
+Job.NewProc builds each worker's env (KUNGFU_* contract + CUDA_VISIBLE_DEVICES
+from a GPUPool).  TPU equivalent: the KFT_* contract (kungfu_tpu/env.py) plus
+TPU chip slots via TPU_VISIBLE_CHIPS (or virtual CPU devices for testing).
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+from typing import Dict, List, Optional
+
+from ..env import worker_env
+from ..plan import Cluster, PeerID, Strategy
+
+
+class ChipPool:
+    """Smallest-free-id device slot allocator (reference gpu_resource.go:10-45)."""
+
+    def __init__(self, n: int):
+        self._free = list(range(n))
+
+    def get(self) -> Optional[int]:
+        return self._free.pop(0) if self._free else None
+
+    def put(self, i: int) -> None:
+        if i >= 0:
+            self._free.append(i)
+            self._free.sort()
+
+
+@dataclasses.dataclass
+class Proc:
+    name: str
+    args: List[str]
+    env: Dict[str, str]
+    peer: PeerID
+    chip: int = -1
+
+
+@dataclasses.dataclass
+class Job:
+    prog: str
+    args: List[str]
+    strategy: Strategy
+    config_server: str = ""
+    platform: str = ""  # "" = inherit; "cpu" forces CPU backend in workers
+    devices_per_worker: int = 1
+    chips_per_host: int = 0  # 0 = don't manage chip visibility
+
+    def new_proc(self, peer: PeerID, chip: int, cluster: Cluster, version: int,
+                 parent: Optional[PeerID] = None) -> Proc:
+        env = dict(os.environ)
+        env.update(
+            worker_env(
+                self_id=peer,
+                cluster=cluster,
+                version=version,
+                strategy=self.strategy,
+                parent=parent,
+                config_server=self.config_server,
+            )
+        )
+        if self.platform:
+            env["KFT_PLATFORM"] = self.platform
+            if self.platform == "cpu":
+                flags = env.get("XLA_FLAGS", "")
+                if "xla_force_host_platform_device_count" not in flags:
+                    env["XLA_FLAGS"] = (
+                        flags + f" --xla_force_host_platform_device_count={self.devices_per_worker}"
+                    ).strip()
+        if self.chips_per_host > 0 and chip >= 0:
+            # reference sets CUDA_VISIBLE_DEVICES (cuda_visible_device.go:17-33),
+            # respecting a pre-set visible list; same contract for TPU chips
+            pre = env.get("TPU_VISIBLE_CHIPS")
+            if pre:
+                visible = pre.split(",")
+                env["TPU_VISIBLE_CHIPS"] = visible[chip % len(visible)]
+            else:
+                env["TPU_VISIBLE_CHIPS"] = str(chip)
+        args = [self.prog] + list(self.args)
+        return Proc(
+            name=f"{cluster.workers.rank(peer)}", args=args, env=env, peer=peer, chip=chip
+        )

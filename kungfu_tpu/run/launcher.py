@@ -1,0 +1,223 @@
+"""Process supervisor — the kungfu-run equivalent.
+
+Reference: srcs/go/kungfu/runner/{simple,watch}.go + utils/runner/local:
+static mode spawns every local worker in parallel and tees their output with
+per-rank prefixes; watch mode additionally polls the elastic config service
+and creates/kills workers as the cluster document changes (the reference gets
+pushed Stage updates over its TCP control channel; polling the config server
+is the deliberate HTTP-only re-design — workers PUT, runners GET).
+"""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..elastic.config_client import ConfigClient
+from ..plan import Cluster, PeerID
+from ..utils import get_logger
+from .job import ChipPool, Job, Proc
+
+log = get_logger("kungfu.run")
+
+_COLORS = [36, 32, 33, 35, 34, 31]  # cyan green yellow magenta blue red
+
+
+class ProcRunner:
+    """One worker subprocess with output pumping (utils/runner/local/local.go)."""
+
+    def __init__(self, proc: Proc, logdir: str = "", quiet: bool = False):
+        self.proc = proc
+        self.logdir = logdir
+        self.quiet = quiet
+        self.popen: Optional[subprocess.Popen] = None
+        self._pump: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        stdout = subprocess.PIPE
+        self.popen = subprocess.Popen(
+            self.proc.args,
+            env=self.proc.env,
+            stdout=stdout,
+            stderr=subprocess.STDOUT,
+            text=True,
+            bufsize=1,
+        )
+        logfile = None
+        if self.logdir:
+            os.makedirs(self.logdir, exist_ok=True)
+            logfile = open(os.path.join(self.logdir, f"worker-{self.proc.name}.log"), "w")
+        color = _COLORS[int(self.proc.name) % len(_COLORS)] if self.proc.name.isdigit() else 37
+        prefix = f"\x1b[{color}m[{self.proc.name}]\x1b[0m " if sys.stdout.isatty() else f"[{self.proc.name}] "
+
+        def pump():
+            assert self.popen and self.popen.stdout
+            for line in self.popen.stdout:
+                if logfile:
+                    logfile.write(line)
+                    logfile.flush()
+                if not self.quiet:
+                    sys.stdout.write(prefix + line)
+                    sys.stdout.flush()
+            if logfile:
+                logfile.close()
+
+        self._pump = threading.Thread(target=pump, daemon=True)
+        self._pump.start()
+
+    def wait(self) -> int:
+        assert self.popen
+        rc = self.popen.wait()
+        if self._pump:
+            self._pump.join(timeout=5)
+        return rc
+
+    def terminate(self, grace_s: float = 5.0) -> None:
+        if self.popen and self.popen.poll() is None:
+            self.popen.terminate()
+            try:
+                self.popen.wait(timeout=grace_s)
+            except subprocess.TimeoutExpired:
+                self.popen.kill()
+                self.popen.wait()
+
+
+def simple_run(job: Job, cluster: Cluster, self_host: str, version: int = 0,
+               logdir: str = "", quiet: bool = False, keep: bool = False) -> int:
+    """Static mode (runner/simple.go:13-21): spawn all local workers, wait.
+
+    On any worker failure, kill the rest (unless keep) and return its code.
+    """
+    local = [p for p in cluster.workers if p.host == self_host]
+    pool = ChipPool(job.chips_per_host) if job.chips_per_host else None
+    runners: List[ProcRunner] = []
+    for peer in local:
+        chip = pool.get() if pool else -1
+        proc = job.new_proc(peer, chip if chip is not None else -1, cluster, version)
+        r = ProcRunner(proc, logdir=logdir, quiet=quiet)
+        r.start()
+        runners.append(r)
+    log.info("spawned %d/%d workers on %s", len(local), cluster.size(), self_host)
+
+    failed = 0
+    pending = list(runners)
+    try:
+        while pending:
+            for r in list(pending):
+                rc = r.popen.poll() if r.popen else None
+                if rc is None:
+                    continue
+                r.wait()  # joins the output pump: don't lose tail lines
+                pending.remove(r)
+                if rc != 0:
+                    failed = failed or rc
+                    log.error("worker %s exited with %d", r.proc.name, rc)
+                    if not keep:  # fail fast: kill the rest (watch.go:144-149)
+                        for other in pending:
+                            other.terminate()
+                        pending = []
+            time.sleep(0.05)
+    except KeyboardInterrupt:
+        for r in runners:
+            r.terminate()
+        return 130
+    return failed
+
+
+class WatchRunner:
+    """Watch mode (runner/watch.go:42-135): reconcile local procs against the
+    config service's cluster document as its version advances."""
+
+    def __init__(self, job: Job, self_host: str, client: ConfigClient,
+                 logdir: str = "", quiet: bool = False, keep: bool = False,
+                 poll_s: float = 0.5):
+        self.job = job
+        self.self_host = self_host
+        self.client = client
+        self.logdir = logdir
+        self.quiet = quiet
+        self.keep = keep
+        self.poll_s = poll_s
+        self.current: Dict[PeerID, ProcRunner] = {}
+        self.pool: Optional[ChipPool] = (
+            ChipPool(job.chips_per_host) if job.chips_per_host else None
+        )
+        self.version = -1
+        self._chip_of: Dict[PeerID, int] = {}
+
+    def _spawn(self, peer: PeerID, cluster: Cluster, version: int) -> None:
+        chip = self.pool.get() if self.pool else -1
+        proc = self.job.new_proc(peer, chip if chip is not None else -1, cluster, version)
+        r = ProcRunner(proc, logdir=self.logdir, quiet=self.quiet)
+        r.start()
+        self.current[peer] = r
+        self._chip_of[peer] = chip if chip is not None else -1
+        log.info("[v%d] + worker %s", version, peer)
+
+    def _kill(self, peer: PeerID) -> None:
+        r = self.current.pop(peer, None)
+        if r is not None:
+            r.terminate()
+            if self.pool:
+                self.pool.put(self._chip_of.pop(peer, -1))
+            log.info("- worker %s", peer)
+
+    def reconcile(self, cluster: Cluster, version: int) -> None:
+        """Diff old/new local workers; kill removed, spawn added (watch.go:64-83)."""
+        want = {p for p in cluster.workers if p.host == self.self_host}
+        have = set(self.current)
+        for peer in sorted(have - want):
+            self._kill(peer)
+        for peer in sorted(want - have):
+            self._spawn(peer, cluster, version)
+        self.version = version
+
+    def run(self, initial: Optional[Cluster] = None, timeout_s: float = 0.0) -> int:
+        if initial is not None:
+            self.reconcile(initial, 0)
+        t0 = time.monotonic()
+        try:
+            while True:
+                try:
+                    got = self.client.get_cluster()
+                except OSError as e:  # transient config-server outage
+                    log.warning("config server unreachable: %s", e)
+                    got = None
+                if got is not None:
+                    cluster, version = got
+                    if version > self.version:
+                        self.reconcile(cluster, version)
+                # collect finished procs
+                for peer, r in list(self.current.items()):
+                    rc = r.popen.poll() if r.popen else None
+                    if rc is not None:
+                        r.wait()  # joins the output pump: don't lose tail lines
+                        del self.current[peer]
+                        if self.pool:
+                            self.pool.put(self._chip_of.pop(peer, -1))
+                        if rc != 0 and not self.keep:
+                            log.error("worker %s failed (%d); stopping job", peer, rc)
+                            self.shutdown()
+                            return rc
+                if not self.current and self.version >= 0:
+                    log.info("all workers exited")
+                    return 0
+                if timeout_s and time.monotonic() - t0 > timeout_s:
+                    log.error("watch timeout after %.0fs", timeout_s)
+                    self.shutdown()
+                    return 124
+                time.sleep(self.poll_s)
+        except KeyboardInterrupt:
+            self.shutdown()
+            return 130
+        except Exception:
+            self.shutdown()  # never leave workers orphaned
+            raise
+
+    def shutdown(self) -> None:
+        for peer in list(self.current):
+            self._kill(peer)

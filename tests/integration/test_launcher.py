@@ -1,0 +1,101 @@
+"""Launcher + config server integration tests (reference: scripts/tests/
+run-integration-tests.sh's np sweep + configserver tests), single machine."""
+import json
+import os
+import subprocess
+import sys
+import urllib.request
+
+import pytest
+
+from kungfu_tpu.elastic.config_client import ConfigClient
+from kungfu_tpu.elastic.config_server import ConfigServer
+from kungfu_tpu.plan import Cluster, HostList
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_launcher(args, timeout=300):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # workers must not inherit the test process's virtual-device flags
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    return subprocess.run(
+        [sys.executable, "-m", "kungfu_tpu.run"] + args,
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO,
+    )
+
+
+class TestConfigServer:
+    def test_lifecycle(self):
+        c0 = Cluster.from_hostlist(HostList.parse("127.0.0.1:4"), 2)
+        srv = ConfigServer(port=0, init=c0).start()
+        try:
+            client = ConfigClient(srv.url)
+            cluster, version = client.get_cluster()
+            assert cluster.size() == 2
+
+            ok = client.put_cluster(cluster.resize(3))
+            assert ok
+            cluster2, version2 = client.get_cluster()
+            assert cluster2.size() == 3 and version2 == version + 1
+
+            # idempotent PUT does not bump version (configserver.go dedup)
+            assert client.put_cluster(cluster2)
+            _, version3 = client.get_cluster()
+            assert version3 == version2
+
+            client.clear()
+            assert client.get_cluster() is None
+            # PUT after clear is rejected (reference behavior)
+            assert not client.put_cluster(cluster2)
+        finally:
+            srv.stop()
+
+    def test_put_invalid_rejected(self):
+        srv = ConfigServer(port=0).start()
+        try:
+            req = urllib.request.Request(
+                srv.url, data=b'{"cluster": {"runners": [], "workers": [{"host": "x", "port": 1}]}}',
+                method="PUT", headers={"Content-Type": "application/json"},
+            )
+            try:
+                with urllib.request.urlopen(req) as r:
+                    code = r.status
+            except urllib.error.HTTPError as e:
+                code = e.code
+            assert code == 409
+        finally:
+            srv.stop()
+
+
+@pytest.mark.slow
+class TestLauncherE2E:
+    @pytest.mark.parametrize("np_", [1, 2, 4])
+    def test_mnist_np(self, np_):
+        r = run_launcher(
+            ["-np", str(np_), "-platform", "cpu", "--", sys.executable,
+             "examples/mnist_slp.py", "--steps", "30"]
+        )
+        assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+        results = [l for l in r.stdout.splitlines() if "RESULT:" in l]
+        assert len(results) == np_
+        for line in results:
+            acc = float(line.split("acc=")[1].split()[0])
+            assert acc > 0.8, line
+
+    def test_worker_failure_kills_job(self):
+        r = run_launcher(
+            ["-np", "2", "--", sys.executable, "-c",
+             "import os,sys,time; sys.exit(3 if os.environ['KFT_SELF_SPEC'].endswith('10001') else (time.sleep(60) or 0))"],
+            timeout=60,
+        )
+        assert r.returncode == 3
+
+    def test_strategy_env_forwarded(self):
+        r = run_launcher(
+            ["-np", "1", "-strategy", "RING", "--", sys.executable, "-c",
+             "import os; print('STRAT=' + os.environ['KFT_ALLREDUCE_STRATEGY'])"],
+        )
+        assert "STRAT=RING" in r.stdout

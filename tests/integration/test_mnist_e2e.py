@@ -1,0 +1,100 @@
+"""End-to-end MNIST SLP training on the 8-device CPU mesh — the reference's
+first CI milestone (tests/python/integration/test_mnist_slp.py,
+examples/tf2_mnist_gradient_tape.py) for every optimizer family."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from kungfu_tpu.datasets import synthetic_mnist, ElasticDataAdaptor
+from kungfu_tpu.models.slp import SLP, softmax_cross_entropy, accuracy
+from kungfu_tpu.optimizers import (
+    synchronous_sgd,
+    synchronous_averaging,
+    pair_averaging,
+    adaptive_sgd,
+    gradient_noise_scale,
+    get_noise_scale,
+)
+from kungfu_tpu.train import DataParallelTrainer, TrainState
+
+BATCH = 16  # per replica
+STEPS = 60
+
+
+@pytest.fixture(scope="module")
+def data():
+    return synthetic_mnist(n=4096, noise=0.5)
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = SLP()
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))["params"]
+    return model, params
+
+
+def make_loss(model):
+    def loss_fn(params, batch):
+        images, labels = batch
+        logits = model.apply({"params": params}, images)
+        return softmax_cross_entropy(logits, labels)
+
+    return loss_fn
+
+
+def final_accuracy(model, params, data):
+    images, labels = data
+    logits = model.apply({"params": params}, images[:1024])
+    return float(accuracy(logits, labels[:1024]))
+
+
+def batches(data, n_replicas):
+    it = iter(ElasticDataAdaptor(data[0], data[1], batch_size=BATCH * n_replicas))
+    return it
+
+
+@pytest.mark.parametrize(
+    "name,make_tx,per_replica",
+    [
+        ("s-sgd", lambda: synchronous_sgd(optax.sgd(0.1)), False),
+        ("sma", lambda: synchronous_averaging(optax.sgd(0.1), alpha=0.1), True),
+        ("gossip", lambda: pair_averaging(optax.sgd(0.1), axis_size=8), True),
+        ("ada", lambda: adaptive_sgd(optax.sgd(0.1), switch_step=30), True),
+    ],
+)
+def test_optimizer_trains_mnist(data, model_and_params, name, make_tx, per_replica):
+    model, params = model_and_params
+    trainer = DataParallelTrainer(
+        make_loss(model), make_tx(), per_replica_params=per_replica
+    )
+    state = trainer.init(params)
+    it = batches(data, trainer.world)
+    state, metrics = trainer.fit(state, it, steps=STEPS, log_every=0)
+    acc = final_accuracy(model, trainer.eval_params(state), data)
+    assert acc > 0.8, f"{name}: accuracy {acc} too low (chance=0.1)"
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_ssgd_with_noise_scale_monitor(data, model_and_params):
+    model, params = model_and_params
+    tx = gradient_noise_scale(
+        synchronous_sgd(optax.sgd(0.1)), local_batch_size=BATCH, axis_size=8
+    )
+    trainer = DataParallelTrainer(make_loss(model), tx)
+    state = trainer.init(params)
+    it = batches(data, trainer.world)
+    state, _ = trainer.fit(state, it, steps=20, log_every=0)
+    gns = float(get_noise_scale(state.opt_state))
+    assert np.isfinite(gns)
+
+
+def test_throughput_metric(data, model_and_params):
+    model, params = model_and_params
+    trainer = DataParallelTrainer(make_loss(model), synchronous_sgd(optax.sgd(0.1)))
+    state = trainer.init(params)
+    it = batches(data, trainer.world)
+    _, metrics = trainer.fit(state, it, steps=10, log_every=0)
+    assert metrics["samples_per_sec"] > 0
