@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Headline benchmark: ResNet-50 S-SGD training throughput, images/sec/chip.
+
+Matches the reference's headline number (README.md:203-213: ResNet-50
+synchronous training throughput; harness
+srcs/python/kungfu/tensorflow/v1/benchmarks/__main__.py).  Runs the real
+compiled SPMD train step (synchronous_sgd over the device mesh — on one chip
+the psum is the identity, on N chips it rides ICI) in bfloat16.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": R}
+
+vs_baseline: ratio to 380 images/sec/chip — the published ResNet-50 v1.5
+fp32 throughput of one V100 in the Horovod-era stacks the reference
+benchmarked against (its own numbers are plot-only, BASELINE.md).
+"""
+import json
+import os
+import sys
+import time
+
+BASELINE_IMG_PER_SEC_PER_CHIP = 380.0
+
+
+def main():
+    batch_per_chip = int(os.environ.get("KFT_BENCH_BATCH", "128"))
+    steps = int(os.environ.get("KFT_BENCH_STEPS", "30"))
+    warmup = int(os.environ.get("KFT_BENCH_WARMUP", "5"))
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from kungfu_tpu.models.resnet import ResNet50
+    from kungfu_tpu.models.slp import softmax_cross_entropy
+    from kungfu_tpu.optimizers import synchronous_sgd
+    from kungfu_tpu.train import DataParallelTrainer
+
+    n_chips = len(jax.devices())
+    global_batch = batch_per_chip * n_chips
+
+    model = ResNet50(num_classes=1000)
+
+    def loss_fn(params, batch):
+        images, labels = batch
+        variables = {"params": params, "batch_stats": batch_stats}
+        logits, _ = model.apply(
+            variables, images, train=True, mutable=["batch_stats"]
+        )
+        return softmax_cross_entropy(logits, labels)
+
+    rng = jax.random.PRNGKey(0)
+    variables = model.init(rng, jnp.zeros((1, 224, 224, 3), jnp.float32), train=False)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    tx = synchronous_sgd(optax.sgd(0.1, momentum=0.9))
+    trainer = DataParallelTrainer(loss_fn, tx)
+    state = trainer.init(params)
+
+    rng_np = np.random.RandomState(0)
+    images = rng_np.randn(global_batch, 224, 224, 3).astype(np.float32)
+    labels = rng_np.randint(0, 1000, size=global_batch).astype(np.int32)
+    batch = trainer.shard_batch((images, labels))
+
+    def sync(m):
+        # force a real device->host scalar fetch: on tunneled/remote backends
+        # (axon) block_until_ready returns before execution finishes
+        return float(np.asarray(m["loss"]))
+
+    for _ in range(warmup):
+        state, metrics = trainer.train_step(state, batch)
+    sync(metrics)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = trainer.train_step(state, batch)
+    sync(metrics)
+    dt = time.perf_counter() - t0
+
+    img_per_sec = steps * global_batch / dt
+    per_chip = img_per_sec / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_train_images_per_sec_per_chip",
+                "value": round(per_chip, 2),
+                "unit": "images/sec/chip",
+                "vs_baseline": round(per_chip / BASELINE_IMG_PER_SEC_PER_CHIP, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -98,7 +98,11 @@ class DataParallelTrainer:
 
     @property
     def world(self) -> int:
-        return self.mesh.shape[self.axis_name]
+        axes = self.axis_name if isinstance(self.axis_name, tuple) else (self.axis_name,)
+        n = 1
+        for a in axes:
+            n *= self.mesh.shape[a]
+        return n
 
     # -- step construction ------------------------------------------------------------
 
@@ -195,7 +199,10 @@ class DataParallelTrainer:
             state, metrics = self.train_step(state, batch)
             if log_every and (i + 1) % log_every == 0:
                 log.info("step %d loss %.4f", state.step, float(metrics["loss"]))
-        jax.block_until_ready(state.params)
+        if metrics:
+            # scalar fetch, not block_until_ready: remote-tunneled backends
+            # (axon) return from block_until_ready before execution finishes
+            float(np.asarray(metrics["loss"]))
         dt = time.perf_counter() - t0
         metrics = dict(metrics)
         metrics["samples_per_sec"] = samples / dt
