@@ -1,0 +1,90 @@
+"""Elastic MNIST training — the resize-mid-training drill.
+
+Reference: tests/python/integration/test_tensorflow_resize.py:31-79 (schedule
+of cluster sizes, resize asserted mid-run, detached workers exit) under
+kungfu-run watch mode.  Run:
+
+    python -m kungfu_tpu.run -w -np 2 -platform cpu -- \
+        python examples/elastic_mnist.py --schedule 2:20,3:20,2:10 --total-samples 6400
+
+Each surviving worker prints `RESULT: ... resizes=N`; detached workers print
+`DETACHED: ...` and exit 0.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from kungfu_tpu.elastic.trainer import ElasticConfig, run_elastic
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--total-samples", type=int, default=6400)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--schedule", default="", help="size:steps,... resize schedule")
+    ap.add_argument("--check-every", type=int, default=2)
+    args = ap.parse_args()
+
+    def make_loss():
+        import jax
+
+        from kungfu_tpu.models.slp import SLP, softmax_cross_entropy
+
+        model = SLP()
+
+        def loss_fn(params, batch):
+            images, labels = batch
+            return softmax_cross_entropy(model.apply({"params": params}, images), labels)
+
+        return loss_fn
+
+    def init_params():
+        import jax
+        import jax.numpy as jnp
+
+        from kungfu_tpu.models.slp import SLP
+
+        return SLP().init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))["params"]
+
+    def make_tx():
+        import optax
+
+        from kungfu_tpu.optimizers import synchronous_sgd
+
+        return synchronous_sgd(optax.sgd(args.lr))
+
+    def make_data(rank, size, offset):
+        import jax
+
+        from kungfu_tpu.datasets import ElasticDataAdaptor, synthetic_mnist
+
+        images, labels = synthetic_mnist(n=4096, noise=0.5)
+        return iter(
+            ElasticDataAdaptor(
+                images, labels,
+                batch_size=args.batch_size * jax.local_device_count(),
+                rank=rank, size=size, offset=offset,
+            )
+        )
+
+    out = run_elastic(
+        make_loss, init_params, make_tx, make_data,
+        ElasticConfig(
+            total_samples=args.total_samples,
+            batch_size=args.batch_size,
+            schedule=args.schedule,
+            check_every=args.check_every,
+        ),
+    )
+    print(
+        f"RESULT: loss={out['loss']:.4f} trained={out['trained_samples']} "
+        f"resizes={out['resizes']} final_size={out['final_size']}",
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

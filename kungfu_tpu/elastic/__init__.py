@@ -1,4 +1,10 @@
 """Elastic training: config service, resize protocol, schedules, policies."""
 from .config_client import ConfigClient, propose_new_size
+from .config_server import ConfigServer
+from .schedule import StepBasedSchedule
+from .trainer import ElasticConfig, run_elastic
 
-__all__ = ["ConfigClient", "propose_new_size"]
+__all__ = [
+    "ConfigClient", "ConfigServer", "propose_new_size",
+    "StepBasedSchedule", "ElasticConfig", "run_elastic",
+]

@@ -1,0 +1,316 @@
+"""Elastic training loop — resize the cluster mid-training.
+
+TPU re-design of the reference's signature flow (SURVEY.md §3.5; reference
+peer/peer.go:227-263, experimental/hook/elastic.py:51-118):
+
+  reference                             this module
+  ---------                             -----------
+  worker GETs config server             same (HTTP, elastic/config_client.py)
+  BytesConsensus over own TCP           version consensus over the CURRENT
+  collectives until all agree           mesh (compiled pmin/pmax) until agree
+  notify runners via Control conns      runners poll the config server
+  token-fenced reconnect + barrier      jax.distributed re-init at a
+                                        version-derived coordinator port (the
+                                        rendezvous IS the barrier; stale peers
+                                        cannot reach the new port = fencing)
+  allreduce-max trained samples +       one compiled sync program: pmax of the
+  BroadcastGlobalVariables              offset + broadcast params/opt_state
+                                        from global rank 0
+
+The hard constraint (SURVEY.md §7 "hard parts"): jax.distributed is static,
+so a resize means snapshot-to-host -> backend teardown -> re-init -> re-place.
+Survivors keep their state; joiners enter with fresh init and receive rank
+0's state in the sync program.  Worker 0 survives any shrink (Cluster.resize
+keeps a prefix — the reference's "new root must be old worker" guard,
+peer.go:211-222, holds by construction).
+"""
+from __future__ import annotations
+
+import dataclasses
+import sys
+import time
+from typing import Any, Callable, Dict, Iterator, Optional, Tuple
+
+import numpy as np
+
+from ..utils import get_logger, stall_detector
+from .config_client import ConfigClient
+from .schedule import StepBasedSchedule
+
+log = get_logger("kungfu.elastic")
+
+
+@dataclasses.dataclass
+class ElasticConfig:
+    total_samples: int
+    batch_size: int  # per replica (device)
+    schedule: str = ""  # "size:steps,..." -> rank 0 proposes resizes
+    check_every: int = 5  # steps between config polls (resize latency knob)
+    per_replica: bool = False
+    consensus_timeout_s: float = 60.0
+
+
+class _MeshPrograms:
+    """Compiled helper programs bound to the current mesh."""
+
+    def __init__(self, trainer):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        try:
+            from jax import shard_map
+        except ImportError:  # pragma: no cover
+            from jax.experimental.shard_map import shard_map
+
+        from ..ops import collective as C
+
+        self.trainer = trainer
+        mesh = trainer.mesh
+        axes = trainer.axis_name if isinstance(trainer.axis_name, tuple) else (trainer.axis_name,)
+        axis = axes if len(axes) > 1 else axes[0]
+        stacked = P(axes)
+
+        def minmax(x):
+            y = jnp.squeeze(x, 0)
+            return jnp.stack([lax.pmin(y, axis), lax.pmax(y, axis)])[None]
+
+        self._minmax = jax.jit(
+            shard_map(minmax, mesh=mesh, in_specs=stacked, out_specs=stacked)
+        )
+
+        def sync(offset, tree):
+            off = lax.pmax(jnp.squeeze(offset, 0), axis)
+            out = jax.tree.map(
+                lambda p: C.broadcast(jnp.squeeze(p, 0), axis, root=0)[None], tree
+            )
+            return off[None], out
+
+        self._sync = jax.jit(
+            shard_map(sync, mesh=mesh, in_specs=(stacked, stacked), out_specs=(stacked, stacked))
+        )
+
+        def collapse(tree):  # stacked (identical rows) -> replicated
+            return jax.tree.map(
+                lambda p: lax.pmean(jnp.squeeze(p, 0), axis), tree
+            )
+
+        self._collapse = jax.jit(
+            shard_map(collapse, mesh=mesh, in_specs=stacked, out_specs=P())
+        )
+
+        self._mesh = mesh
+        self._axes = axes
+        self._stacked_sharding = NamedSharding(mesh, stacked)
+
+    def _stack_local(self, value: np.ndarray):
+        """Every process contributes its copy for each of its local devices."""
+        import jax
+
+        n_local = jax.local_device_count()
+        tiled = np.broadcast_to(value[None], (n_local,) + value.shape)
+        if jax.process_count() == 1:
+            world = len(jax.devices())
+            full = np.broadcast_to(value[None], (world,) + value.shape)
+            return jax.device_put(full, self._stacked_sharding)
+        return jax.make_array_from_process_local_data(self._stacked_sharding, tiled)
+
+    def agree_vec(self, values: Tuple[int, ...], timeout_s: float = 60.0,
+                  refresh: Optional[Callable[[], Tuple[int, ...]]] = None) -> Tuple[int, ...]:
+        """Block until every participant reports the same int vector.
+
+        The BytesConsensus retry loop (peer.go:245-254) over the current
+        mesh: elementwise pmin/pmax until they agree.  `refresh` re-reads the
+        local values between attempts.  Values must fit int32 (pass digests
+        masked to 31 bits).
+        """
+        t0 = time.monotonic()
+        v = tuple(values)
+        while True:
+            arr = self._stack_local(np.asarray(v, np.int32))
+            out = np.asarray(self._minmax(arr).addressable_shards[0].data)
+            lo, hi = out[0, 0], out[0, 1]
+            if (lo == hi).all():
+                return tuple(int(x) for x in lo)
+            if time.monotonic() - t0 > timeout_s:
+                raise TimeoutError(f"no consensus: min={lo} max={hi}")
+            time.sleep(0.05)
+            if refresh is not None:
+                v = tuple(refresh())
+
+    def agree_int(self, value: int, timeout_s: float = 60.0,
+                  refresh: Optional[Callable[[], int]] = None) -> int:
+        r = None if refresh is None else (lambda: (refresh(),))
+        return self.agree_vec((value,), timeout_s, r)[0]
+
+    def sync_state(self, counters: Tuple[int, ...], host_tree: Any) -> Tuple[Tuple[int, ...], Any]:
+        """pmax the progress counters + broadcast state from global rank 0.
+
+        counters: monotonic ints (trained-sample offset, step count, ...).
+        host_tree: pytree of numpy arrays (this process's state).  Returns
+        (synced counters, device state in the trainer's param layout).
+        """
+        import jax
+
+        off = self._stack_local(np.asarray(list(counters), np.int64))
+        stacked = jax.tree.map(self._stack_local, host_tree)
+        off_out, tree_out = self._sync(off, stacked)
+        # rows are identical post-pmax; read this process's local shard
+        row = np.asarray(off_out.addressable_shards[0].data).reshape(-1)
+        counters_new = tuple(int(x) for x in row)
+        if self.trainer.per_replica:
+            return counters_new, tree_out
+        return counters_new, self._collapse(tree_out)
+
+
+def _snapshot(tree) -> Any:
+    import jax
+
+    return jax.tree.map(lambda x: np.asarray(x), tree)
+
+
+def _snapshot_local_replica(tree) -> Any:
+    from ..train import first_local_replica
+
+    return first_local_replica(tree)
+
+
+def _teardown_backend() -> None:
+    import jax
+    import jax._src.xla_bridge as xb
+
+    try:
+        jax.distributed.shutdown()
+    except Exception as e:  # pragma: no cover
+        log.warning("distributed shutdown: %s", e)
+    jax.clear_caches()
+    xb._clear_backends()
+
+
+def run_elastic(
+    make_loss: Callable[[], Callable],
+    init_params: Callable[[], Any],
+    make_tx: Callable[[], Any],
+    make_data: Callable[[int, int, int], Iterator],
+    cfg: ElasticConfig,
+) -> Dict[str, Any]:
+    """Elastic data-parallel training under the launcher (watch mode).
+
+    Args:
+      make_loss: () -> loss_fn(params, batch) (rebuilt after each remesh).
+      init_params: () -> params pytree; deterministic across processes.
+      make_tx: () -> optax transform using axis name "dp".
+      make_data: (rank, size, offset_samples) -> iterator of LOCAL batches.
+      cfg: ElasticConfig.
+
+    Returns final metrics dict (on workers that survive to the end).
+    """
+    import kungfu_tpu
+    from ..train import DataParallelTrainer, TrainState
+
+    peer = kungfu_tpu.init()
+    client = ConfigClient(peer.config.config_server) if peer.config.config_server else None
+    schedule = StepBasedSchedule(cfg.schedule)
+    resizes = 0
+
+    def build():
+        from ..plan import make_mesh
+
+        trainer = DataParallelTrainer(
+            make_loss(), make_tx(), mesh=make_mesh(dp=-1),
+            per_replica_params=cfg.per_replica,
+        )
+        return trainer, _MeshPrograms(trainer)
+
+    trainer, programs = build()
+    state = trainer.init(init_params())
+    offset = 0
+
+    def snap(state):
+        if cfg.per_replica:
+            return (
+                _snapshot_local_replica(state.params),
+                _snapshot_local_replica(state.opt_state),
+            )
+        return _snapshot(state.params), _snapshot(state.opt_state)
+
+    step = 0  # monotonic optimizer-step count (survives resizes via sync)
+
+    # initial sync: identical at version 0, but a worker joining an already-
+    # running cluster (spawned at version N) gets real state here
+    sp, so = snap(state)
+    (offset, step), synced = programs.sync_state((offset, step), {"params": sp, "opt": so})
+    state.params, state.opt_state = synced["params"], synced["opt"]
+    data = make_data(peer.rank, peer.size, offset)
+    # the sync IS this step's rendezvous: nobody re-checks at this step, so
+    # every participant's next collective is the train step (joiners and
+    # survivors must issue identical collective sequences on the new mesh)
+    skip_check_at = step
+
+    t_start = time.time()
+    metrics: Dict[str, Any] = {"loss": np.float32(np.nan)}
+    while offset < cfg.total_samples:
+        # -- schedule-driven proposal (rank 0, reference hooks/elastic.py:14-88)
+        if client is not None and schedule and peer.rank == 0:
+            want = schedule.size_at(step)
+            if want is not None and want != peer.size:
+                from .config_client import propose_new_size
+
+                propose_new_size(peer, want)
+
+        # -- resize check (every check_every steps)
+        if client is not None and step % cfg.check_every == 0 and step != skip_check_at:
+            last_got: Dict[str, Any] = {}
+
+            def observe() -> Tuple[int, int]:
+                """(version, 31-bit doc digest) — consensus is on BOTH, the
+                reference's consensus-on-cluster-bytes semantics: all workers
+                are guaranteed to hold the *same document*, not just the same
+                version number, before anyone acts."""
+                got = client.get_cluster()
+                if got is None:
+                    return peer.cluster_version, 0
+                last_got["cluster"], last_got["version"] = got
+                digest = int(got[0].digest()[:7], 16) & 0x7FFFFFFF
+                return got[1], digest
+
+            version, _ = programs.agree_vec(
+                observe(), timeout_s=cfg.consensus_timeout_s, refresh=observe
+            )
+            if version > peer.cluster_version:
+                if last_got.get("version") == version:
+                    cluster = last_got["cluster"]
+                    log.info("resizing to version %d: %d workers", version, cluster.size())
+                    snap_params, snap_opt = snap(state)
+                    _teardown_backend()
+                    if not peer.update_cluster(cluster, version):
+                        print(f"DETACHED: rank left cluster at version {version}", flush=True)
+                        sys.exit(0)
+                    trainer, programs = build()
+                    (offset, step), synced = programs.sync_state(
+                        (offset, step), {"params": snap_params, "opt": snap_opt}
+                    )
+                    state = TrainState(synced["params"], synced["opt"], step)
+                    data = make_data(peer.rank, peer.size, offset)
+                    skip_check_at = step
+                    resizes += 1
+                else:  # unreachable given digest consensus; log if it ever is
+                    log.warning("agreed version %d but no matching doc cached", version)
+
+        batch = trainer.shard_batch(next(data))
+        state, metrics = trainer.train_step(state, batch)
+        offset += cfg.batch_size * trainer.world
+        step += 1
+
+    loss = float(np.asarray(metrics["loss"]))
+    dt = time.time() - t_start
+    return {
+        "loss": loss,
+        "trained_samples": offset,
+        "resizes": resizes,
+        "final_size": peer.size,
+        "seconds": dt,
+        "state": state,
+        "trainer": trainer,
+    }

@@ -61,6 +61,23 @@ def _put_local_shard(x, sharding: NamedSharding):
     return jax.make_array_from_process_local_data(sharding, np.asarray(x))
 
 
+def first_local_replica(tree):
+    """Host copy of each leaf's FIRST locally-addressable replica row.
+
+    Per-replica leaves are (world, ...) sharded on dim 0; the first
+    addressable shard is (1, ...) on this process — readable even when the
+    global array spans other processes' devices.
+    """
+
+    def first(x):
+        shards = getattr(x, "addressable_shards", None)
+        if shards:
+            return np.asarray(shards[0].data)[0]
+        return np.asarray(x)[0]
+
+    return jax.tree.map(first, tree)
+
+
 @dataclasses.dataclass
 class TrainState:
     params: Any
@@ -178,9 +195,20 @@ class DataParallelTrainer:
         return TrainState(params, opt_state, state.step + 1), metrics
 
     def eval_params(self, state: TrainState, replica: int = 0) -> Any:
-        """Materialize one replica's params (for eval/checkpoint)."""
+        """Materialize one replica's params (for eval/checkpoint).
+
+        Multi-controller: returns this process's first LOCAL replica (the
+        global row may not be addressable here).
+        """
         if not self.per_replica:
             return state.params
+        if jax.process_count() > 1:
+            if replica != 0:
+                raise ValueError(
+                    "multi-controller eval_params can only read this "
+                    "process's first local replica (pass replica=0)"
+                )
+            return jax.tree.map(jnp.asarray, first_local_replica(state.params))
         return jax.tree.map(lambda x: x[replica], state.params)
 
     def fit(

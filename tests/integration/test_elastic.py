@@ -1,0 +1,58 @@
+"""Elastic resize integration test — the reference's
+test_tensorflow_resize.py:31-79 analog, via the launcher's watch mode."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+from kungfu_tpu.elastic.schedule import StepBasedSchedule
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class TestSchedule:
+    def test_parse_and_lookup(self):
+        s = StepBasedSchedule("2:10,3:20,1:5")
+        assert s.total_steps == 35
+        assert s.size_at(0) == 2
+        assert s.size_at(9) == 2
+        assert s.size_at(10) == 3
+        assert s.size_at(29) == 3
+        assert s.size_at(30) == 1
+        assert s.size_at(34) == 1
+        assert s.size_at(35) is None
+
+    def test_empty(self):
+        s = StepBasedSchedule("")
+        assert not s and s.size_at(0) is None
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            StepBasedSchedule("0:5")
+
+
+@pytest.mark.slow
+class TestElasticE2E:
+    def test_resize_grow_shrink(self):
+        """2 -> 3 -> 2 workers mid-training; detached worker exits cleanly."""
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env.pop("XLA_FLAGS", None)
+        env.pop("JAX_PLATFORMS", None)
+        r = subprocess.run(
+            [sys.executable, "-m", "kungfu_tpu.run", "-w", "-np", "2",
+             "-platform", "cpu", "--", sys.executable, "examples/elastic_mnist.py",
+             "--schedule", "2:14,3:14,2:100", "--total-samples", "4480",
+             "--check-every", "2"],
+            capture_output=True, text=True, timeout=420, env=env, cwd=REPO,
+        )
+        out = r.stdout
+        assert r.returncode == 0, out[-3000:] + r.stderr[-2000:]
+        results = [l for l in out.splitlines() if "RESULT:" in l]
+        detached = [l for l in out.splitlines() if "DETACHED:" in l]
+        assert len(results) == 2, out[-3000:]  # the two final workers
+        assert len(detached) == 1, out[-3000:]  # the shrunk-away worker
+        for line in results:
+            assert "resizes=2" in line, line
+            assert "trained=4480" in line, line
