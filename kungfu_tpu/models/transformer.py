@@ -1,0 +1,202 @@
+"""Flagship decoder-only transformer LM — TP/SP/DP-shardable, ring-attention
+capable, optional MoE layers.
+
+The reference is model-agnostic DP (it ships no transformer); this is the
+TPU-first flagship exercising every parallelism axis the framework offers:
+
+  dp/fsdp  batch via the trainer (data axis)
+  tp       Megatron-style column/row-parallel QKV/MLP via logical axes
+           ("heads", "mlp", "vocab" -> tp); XLA inserts the psums
+  sp       ring attention over the "sp" axis (parallel/ring_attention.py) —
+           the sequence never materializes on one chip
+  ep       MoE blocks with expert-parallel all_to_all (parallel/moe.py)
+
+Params carry flax logical-axis metadata; map them onto a mesh with
+parallel/sharding.py's rules.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+from flax.linen import spmd as flax_spmd
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..parallel.ring_attention import full_attention, ring_attention
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 8
+    n_heads: int = 8
+    d_ff: int = 2048
+    max_len: int = 2048
+    dtype: Any = jnp.bfloat16
+    attention: str = "full"  # "full" | "ring"
+    causal: bool = True
+    # MoE: every `moe_every`-th block uses experts (0 = dense model)
+    n_experts: int = 0
+    moe_every: int = 2
+    capacity_factor: float = 1.25
+    # mesh is needed only for attention="ring" (shard_map region)
+    mesh: Optional[Mesh] = None
+    sp_axis: str = "sp"
+
+    def __post_init__(self):
+        assert self.d_model % self.n_heads == 0
+
+
+def _dense(features, name, kernel_axes, dtype):
+    return nn.Dense(
+        features,
+        use_bias=False,
+        dtype=dtype,
+        name=name,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.normal(stddev=0.02), kernel_axes
+        ),
+    )
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        H, D = cfg.n_heads, cfg.d_model // cfg.n_heads
+        B, L, _ = x.shape
+        qkv_axes = ("embed", "heads")
+        q = _dense(cfg.d_model, "q", qkv_axes, cfg.dtype)(x).reshape(B, L, H, D)
+        k = _dense(cfg.d_model, "k", qkv_axes, cfg.dtype)(x).reshape(B, L, H, D)
+        v = _dense(cfg.d_model, "v", qkv_axes, cfg.dtype)(x).reshape(B, L, H, D)
+        q = flax_spmd.with_logical_constraint(q, ("batch", "seq", "heads", "kv"))
+        k = flax_spmd.with_logical_constraint(k, ("batch", "seq", "heads", "kv"))
+        v = flax_spmd.with_logical_constraint(v, ("batch", "seq", "heads", "kv"))
+
+        if cfg.attention == "ring" and cfg.mesh is not None and cfg.sp_axis in cfg.mesh.axis_names:
+            names = cfg.mesh.axis_names
+            # keep batch on dp and heads on tp inside the manual region —
+            # omitting them would all-gather those dims onto every device
+            spec = P(
+                "dp" if "dp" in names else None,
+                cfg.sp_axis,
+                "tp" if "tp" in names else None,
+                None,
+            )
+            attn = _shard_map(
+                partial(ring_attention, axis_name=cfg.sp_axis, causal=cfg.causal),
+                mesh=cfg.mesh,
+                in_specs=(spec, spec, spec),
+                out_specs=spec,
+            )
+            o = attn(q, k, v)
+        else:
+            o = full_attention(q, k, v, causal=cfg.causal)
+
+        o = o.reshape(B, L, cfg.d_model)
+        return _dense(cfg.d_model, "out", ("heads", "embed"), cfg.dtype)(o)
+
+
+class MLP(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        h = _dense(cfg.d_ff, "in", ("embed", "mlp"), cfg.dtype)(x)
+        h = nn.gelu(h)
+        h = flax_spmd.with_logical_constraint(h, ("batch", "seq", "mlp"))
+        return _dense(cfg.d_model, "out", ("mlp", "embed"), cfg.dtype)(h)
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+    use_moe: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        ln = partial(nn.LayerNorm, dtype=jnp.float32, use_bias=False,
+                     scale_init=nn.with_logical_partitioning(nn.initializers.ones, ("embed",)))
+        x = x + Attention(cfg, name="attn")(ln(name="ln1")(x))
+        if self.use_moe:
+            from ..parallel.moe import MoEMLP
+
+            x = x + MoEMLP(cfg, name="moe")(ln(name="ln2")(x))
+        else:
+            x = x + MLP(cfg, name="mlp")(ln(name="ln2")(x))
+        return flax_spmd.with_logical_constraint(x, ("batch", "seq", "embed"))
+
+
+class TransformerLM(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        B, L = tokens.shape
+        emb = nn.Embed(
+            cfg.vocab_size, cfg.d_model, dtype=cfg.dtype, name="embed",
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), ("vocab", "embed")
+            ),
+        )
+        pos = self.param(
+            "pos_embed",
+            nn.with_logical_partitioning(nn.initializers.normal(stddev=0.02), ("seq", "embed")),
+            (cfg.max_len, cfg.d_model),
+            jnp.float32,
+        )
+        x = emb(tokens) + pos[None, :L].astype(cfg.dtype)
+        x = flax_spmd.with_logical_constraint(x, ("batch", "seq", "embed"))
+        for i in range(cfg.n_layers):
+            use_moe = cfg.n_experts > 0 and (i % cfg.moe_every == cfg.moe_every - 1)
+            x = Block(cfg, use_moe=use_moe, name=f"block_{i}")(x)
+        x = nn.LayerNorm(dtype=jnp.float32, use_bias=False, name="ln_f",
+                         scale_init=nn.with_logical_partitioning(nn.initializers.ones, ("embed",)))(x)
+        logits = _dense(cfg.vocab_size, "lm_head", ("embed", "vocab"), jnp.float32)(x)
+        return logits
+
+
+def lm_loss(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """Next-token cross entropy, mean over all positions."""
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32))
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def lm_loss_with_aux(
+    model: TransformerLM, params, tokens: jax.Array, aux_weight: float = 0.01
+) -> jax.Array:
+    """LM loss + Switch load-balancing auxiliary loss (required for MoE
+    configs — without it the router collapses onto one expert)."""
+    logits, state = model.apply({"params": params}, tokens, mutable=["intermediates"])
+    loss = lm_loss(logits, tokens)
+    aux = jnp.zeros((), jnp.float32)
+    for path, leaves in _iter_sown(state.get("intermediates", {})):
+        if path.endswith("moe_aux_loss"):
+            aux = aux + sum(jnp.asarray(l, jnp.float32) for l in leaves)
+    return loss + aux_weight * aux
+
+
+def _iter_sown(tree, prefix=""):
+    out = []
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out += _iter_sown(v, f"{prefix}/{k}")
+    else:
+        out.append((prefix, tree))
+    return out

@@ -1,0 +1,12 @@
+"""Parallelism beyond DP: TP sharding rules, SP ring attention, PP, EP MoE."""
+from .ring_attention import ring_attention, full_attention
+from .sharding import DEFAULT_RULES, rules_for_mesh, param_shardings, logical_constraint
+from .pp import pipeline_apply, stack_stage_params
+from .moe import MoEMLP
+
+__all__ = [
+    "ring_attention", "full_attention",
+    "DEFAULT_RULES", "rules_for_mesh", "param_shardings", "logical_constraint",
+    "pipeline_apply", "stack_stage_params",
+    "MoEMLP",
+]

@@ -1,0 +1,96 @@
+"""Pipeline parallelism — GPipe schedule as a differentiable shard_map scan.
+
+Absent from the reference (DP-only).  TPU-first design: each device on the
+"pp" mesh axis holds ONE stage's parameters (stage-stacked leading dim,
+sharded over pp).  A `lax.scan` runs M + S - 1 ticks; every tick each stage
+applies itself to its current activation and the result rotates one hop along
+the ring (`ppermute` on ICI neighbors).  Stage 0 injects microbatch t at tick
+t; the last stage's outputs are collected tick by tick.  Because the schedule
+is pure lax ops, `jax.grad` through it yields the reverse (backward) pipeline
+automatically — no hand-written 1F1B needed; bubbles cost M+S-1 vs the ideal
+M ticks, amortized by more microbatches.
+
+Shapes (global): stage_params leaves [S, ...] sharded P("pp"); x [M, mb, ...]
+replicated; out [M, mb, ...] replicated.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    x: jax.Array,
+    mesh: Mesh,
+    axis_name: str = "pp",
+) -> jax.Array:
+    """Run x through S = mesh.shape[axis_name] pipelined stages.
+
+    stage_fn(params_i, h) -> h': one stage's computation; h and h' must have
+    identical shape/dtype (the activation that flows through the pipe).
+    stage_params: pytree, leaves stacked [S, ...] (stage i's slice on dim 0).
+    x: [M, mb, ...] microbatches.
+    """
+    S = mesh.shape[axis_name]
+    M = x.shape[0]
+
+    def inner(params, xs):
+        params = jax.tree.map(lambda p: jnp.squeeze(p, 0), params)
+        stage = lax.axis_index(axis_name)
+        perm = [(i, (i + 1) % S) for i in range(S)]
+        mb_shape = xs.shape[1:]
+        h0 = lax.pcast(jnp.zeros(mb_shape, xs.dtype), axis_name, to="varying")
+        out0 = lax.pcast(jnp.zeros((M,) + mb_shape, xs.dtype), axis_name, to="varying")
+
+        def tick(carry, t):
+            h, out = carry
+            # stage 0 picks up microbatch t (zeros once the feed is exhausted)
+            feed = lax.dynamic_index_in_dim(xs, jnp.minimum(t, M - 1), 0, keepdims=False)
+            feed = jnp.where(t < M, feed, jnp.zeros_like(feed))
+            h = jnp.where(stage == 0, feed, h)
+            h = stage_fn(params, h)
+            # last stage emits microbatch t - (S-1) at this tick
+            emit_t = t - (S - 1)
+            is_emit = jnp.logical_and(stage == S - 1, emit_t >= 0)
+            out = lax.cond(
+                is_emit,
+                lambda o: lax.dynamic_update_index_in_dim(
+                    o, h, jnp.maximum(emit_t, 0), 0
+                ),
+                lambda o: o,
+                out,
+            )
+            h = lax.ppermute(h, axis_name, perm)
+            return (h, out), None
+
+        (h, out), _ = lax.scan(tick, (h0, out0), jnp.arange(M + S - 1))
+        # every device returns the out buffer; only the one rotated FROM the
+        # last stage is populated — psum after masking selects it
+        contrib = jnp.where(stage == S - 1, out, jnp.zeros_like(out))
+        return lax.psum(contrib, axis_name)[None]
+
+    fn = _shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(P(axis_name), P()),
+        out_specs=P(axis_name),
+    )
+    # out is [S, M, mb, ...] with identical rows (psum); take row 0
+    return fn(stage_params, x)[0]
+
+
+def stack_stage_params(params_list) -> Any:
+    """Stack per-stage pytrees into the [S, ...] layout pipeline_apply wants."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *params_list)

@@ -1,0 +1,112 @@
+"""Ring attention — sequence/context parallelism over a mesh axis.
+
+The reference has NO long-context support (SURVEY.md §5: no ring attention,
+no sequence parallelism anywhere in the tree); this module is the TPU-native
+capability the reference lacks, built the way the hardware wants it: the
+sequence is sharded over the `sp` mesh axis, K/V blocks rotate around the
+ring with `lax.ppermute` (neighbor hops ride ICI), and each device folds one
+block per hop into a flash-style online-softmax accumulator (fp32), so the
+full sequence never materializes on any chip.  Peak memory per chip is
+O(L/n), compute overlaps communication hop by hop (XLA pipelines the
+ppermute with the einsums).
+
+Use under shard_map with q/k/v sharded on the sequence dim:
+
+    out = shard_map(lambda q, k, v: ring_attention(q, k, v, axis_name="sp"),
+                    mesh, in_specs=P(None, "sp", None, None), ...)
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, m, l, o, q_off, k_off, causal: bool, scale: float):
+    """Fold one K/V block into the online-softmax accumulator.
+
+    q: [B, Lq, H, D]   k,v: [B, Lk, H, D]
+    m,l: [B, H, Lq]    o: [B, Lq, H, D] (fp32)
+    q_off/k_off: absolute position offsets of the q and k blocks.
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s * scale
+    if causal:
+        q_pos = q_off + jnp.arange(q.shape[1])
+        k_pos = k_off + jnp.arange(k.shape[1])
+        mask = q_pos[:, None] >= k_pos[None, :]  # [Lq, Lk]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    m_blk = jnp.max(s, axis=-1)  # [B, H, Lq]
+    m_new = jnp.maximum(m, m_blk)
+    p = jnp.exp(s - m_new[..., None])  # [B, H, Lq, Lk]
+    corr = jnp.exp(m - m_new)  # [B, H, Lq]
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    o_new = o * corr.transpose(0, 2, 1)[..., None] + pv
+    return m_new, l_new, o_new
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = "sp",
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Exact attention over a sequence sharded on `axis_name`.
+
+    Shapes (per device): q, k, v: [B, L_chunk, H, D]; returns [B, L_chunk, H, D]
+    in q's dtype.  Must be called inside shard_map with `axis_name` in scope.
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    B, Lc, H, D = q.shape
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    q_off = idx * Lc
+
+    # derive accumulators from q so they inherit q's varying-axes type (the
+    # shard_map region may be manual over dp/tp as well as the sp ring axis)
+    o0 = jnp.zeros_like(q, jnp.float32)
+    zhl = o0[:, :, :, 0].transpose(0, 2, 1)  # [B, H, Lc] zeros
+    m0 = zhl + NEG_INF
+    l0 = zhl
+
+    if n == 1:
+        m, l, o = _block_attn(q, k, v, m0, l0, o0, q_off, 0, causal, scale)
+        return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def hop(carry, s):
+        k_cur, v_cur, m, l, o = carry
+        # the block currently held arrived from device (idx - s) mod n
+        k_off = ((idx - s) % n) * Lc
+        m, l, o = _block_attn(q, k_cur, v_cur, m, l, o, q_off, k_off, causal, scale)
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (k_nxt, v_nxt, m, l, o), None
+
+    # n-1 rotated hops, then fold the final block without a wasted rotation
+    (k_f, v_f, m, l, o), _ = lax.scan(hop, (k, v, m0, l0, o0), jnp.arange(n - 1))
+    k_off_last = ((idx - (n - 1)) % n) * Lc
+    m, l, o = _block_attn(q, k_f, v_f, m, l, o, q_off, k_off_last, causal, scale)
+    l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows (padding) stay 0
+    return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+
+
+def full_attention(q, k, v, causal: bool = True, scale: Optional[float] = None):
+    """Single-device reference implementation (for tests and small models)."""
+    B, L, H, D = q.shape
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((L, L), bool))
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)

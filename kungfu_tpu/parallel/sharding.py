@@ -1,0 +1,56 @@
+"""Logical-axis sharding rules — the TP/SP/EP wiring for pjit models.
+
+The scaling-book recipe: annotate params/activations with *logical* axis
+names, map logical names to mesh axes with one rules table, and let XLA
+insert the collectives (the entire Megatron-style TP comm pattern — psum
+after row-parallel matmuls, all-gather where needed — falls out of the
+sharding propagation).  This replaces nothing in the reference (it is
+DP-only); it is the TPU-first capability layer.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import flax.linen as nn
+from flax.linen import spmd as flax_spmd
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# logical axis -> mesh axis. None = replicated.
+DEFAULT_RULES: Tuple[Tuple[str, Optional[str]], ...] = (
+    ("batch", "dp"),
+    ("seq", "sp"),
+    ("embed", None),
+    ("heads", "tp"),
+    ("kv", None),
+    ("mlp", "tp"),
+    ("vocab", "tp"),
+    ("expert", "ep"),
+    ("stage", "pp"),
+)
+
+
+def rules_for_mesh(mesh: Mesh, rules=DEFAULT_RULES) -> Tuple[Tuple[str, Optional[str]], ...]:
+    """Drop rules whose mesh axis does not exist (e.g. no 'ep' axis)."""
+    names = set(mesh.axis_names)
+    return tuple((l, m if (m in names) else None) for l, m in rules)
+
+
+def logical_constraint(x, names: Sequence[Optional[str]], mesh: Optional[Mesh] = None, rules=None):
+    """with_sharding_constraint by logical names (no-op outside a mesh)."""
+    if mesh is None or not mesh.axis_names:
+        return x
+    return flax_spmd.with_logical_constraint(x, tuple(names))
+
+
+def param_shardings(mesh: Mesh, abstract_params: Any, rules=None) -> Any:
+    """NamedShardings for a flax param tree annotated with logical axes."""
+    rules = rules if rules is not None else rules_for_mesh(mesh)
+    specs = nn.get_partition_spec(abstract_params)
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, flax_spmd.logical_to_mesh_axes(s, rules))
+        if isinstance(s, P)
+        else NamedSharding(mesh, P()),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
