@@ -1,0 +1,204 @@
+// Threaded prefetching batch loader.
+//
+// Native counterpart of the reference's input pipeline role (the reference
+// leans on TF's C++ dataset runtime via tf.data + idx loaders in
+// srcs/python/kungfu/tensorflow/v1/helpers/*.py; its elastic adaptor
+// (v1/datasets/adaptor.py:4-33) does skip -> shard -> batch).  JAX has no
+// native input pipeline, so this supplies one: the dataset lives in host
+// RAM (numpy arrays from Python), and C++ worker threads do the shuffled
+// gather into contiguous pinned-size batch buffers ahead of the consumer —
+// feeding the TPU without Python in the hot loop.
+//
+// Semantics (matches the elastic adaptor):
+//   * per-epoch deterministic shuffle from (seed, epoch) — every shard sees
+//     the same permutation, then takes a rank-strided slice, so resharding
+//     after an elastic resize is just changing (rank, size),
+//   * remainder samples of each epoch's shard are dropped (static shapes
+//     for XLA),
+//   * batches are delivered in deterministic order via a reorder window.
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// splitmix64 — small, seedable, and identical in kungfu_tpu/native.py's
+// numpy fallback so tests can compare native vs fallback streams bit-exactly.
+inline uint64_t splitmix64(uint64_t& s) {
+    uint64_t z = (s += 0x9e3779b97f4a7c15ull);
+    z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ull;
+    z = (z ^ (z >> 27)) * 0x94d049bb133111ebull;
+    return z ^ (z >> 31);
+}
+
+void shuffled_perm(uint64_t seed, uint64_t epoch, int64_t n, std::vector<int64_t>& out) {
+    out.resize(n);
+    for (int64_t i = 0; i < n; ++i) out[i] = i;
+    uint64_t s = seed * 0x9e3779b97f4a7c15ull + epoch + 1;
+    for (int64_t i = n - 1; i > 0; --i) {  // Fisher-Yates
+        int64_t j = (int64_t)(splitmix64(s) % (uint64_t)(i + 1));
+        std::swap(out[i], out[j]);
+    }
+}
+
+struct Batch {
+    std::vector<uint8_t> data;
+    std::vector<uint8_t> labels;
+};
+
+struct Loader {
+    const uint8_t* data;
+    const uint8_t* labels;
+    int64_t n, sample_bytes, label_bytes, batch;
+    uint64_t seed;
+    std::atomic<int> shard_rank, shard_size;
+    int queue_cap;
+
+    std::vector<std::thread> workers;
+    std::mutex mu;
+    std::condition_variable cv_put, cv_get;
+    std::map<uint64_t, Batch> ready;          // seq -> batch (reorder window)
+    uint64_t next_seq = 0;                    // consumer cursor
+    std::atomic<uint64_t> claim_seq{0};       // producer cursor
+    std::atomic<bool> stop{false};
+
+    // epoch plan shared by workers, rebuilt lazily per epoch
+    std::mutex plan_mu;
+    uint64_t plan_epoch = ~0ull;
+    std::vector<int64_t> plan;                // this shard's sample indices
+
+    int64_t steps_per_epoch() const {
+        int r = shard_rank.load(), s = shard_size.load();
+        int64_t shard_n = n / s + ((n % s) > r ? 1 : 0);
+        return shard_n / batch;
+    }
+
+    void gather(uint64_t seq, Batch& out) {
+        // map the global sequence number to (epoch, step) lazily; an
+        // elastic reshard changes steps_per_epoch, so recompute each call
+        int64_t spe = steps_per_epoch();
+        if (spe == 0) spe = 1;
+        uint64_t epoch = seq / (uint64_t)spe;
+        int64_t step = (int64_t)(seq % (uint64_t)spe);
+        out.data.resize((size_t)(batch * sample_bytes));
+        out.labels.resize((size_t)(batch * label_bytes));
+        // snapshot this batch's indices under the lock, memcpy outside it:
+        // the copies dominate, and serializing them would defeat the worker
+        // pool.  The lock spans plan build + index read so workers near an
+        // epoch boundary never read a plan rebuilt for a different epoch.
+        std::vector<int64_t> idxs((size_t)batch);
+        {
+            std::lock_guard<std::mutex> lk(plan_mu);
+            if (plan_epoch != epoch) {
+                std::vector<int64_t> perm;
+                shuffled_perm(seed, epoch, n, perm);
+                int r = shard_rank.load(), s = shard_size.load();
+                plan.clear();
+                for (int64_t i = r; i < n; i += s) plan.push_back(perm[i]);
+                plan_epoch = epoch;
+            }
+            if (plan.empty()) plan.push_back(0);
+            for (int64_t b = 0; b < batch; ++b)
+                idxs[(size_t)b] = plan[(size_t)((step * batch + b) % (int64_t)plan.size())];
+        }
+        for (int64_t b = 0; b < batch; ++b) {
+            int64_t idx = idxs[(size_t)b];
+            std::memcpy(out.data.data() + b * sample_bytes,
+                        data + idx * sample_bytes, (size_t)sample_bytes);
+            std::memcpy(out.labels.data() + b * label_bytes,
+                        labels + idx * label_bytes, (size_t)label_bytes);
+        }
+    }
+
+    void worker() {
+        while (!stop.load()) {
+            uint64_t seq = claim_seq.fetch_add(1);
+            Batch b;
+            gather(seq, b);
+            std::unique_lock<std::mutex> lk(mu);
+            cv_put.wait(lk, [&] {
+                return stop.load() || (seq < next_seq + (uint64_t)queue_cap);
+            });
+            if (stop.load()) return;
+            ready.emplace(seq, std::move(b));
+            cv_get.notify_all();
+        }
+    }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* kft_loader_create(const void* data, const void* labels, int64_t n,
+                        int64_t sample_bytes, int64_t label_bytes,
+                        int64_t batch, uint64_t seed, int shard_rank,
+                        int shard_size, int threads, int queue_cap) {
+    if (n <= 0 || batch <= 0 || shard_size <= 0 || threads <= 0) return nullptr;
+    auto* L = new Loader();
+    L->data = (const uint8_t*)data;
+    L->labels = (const uint8_t*)labels;
+    L->n = n;
+    L->sample_bytes = sample_bytes;
+    L->label_bytes = label_bytes;
+    L->batch = batch;
+    L->seed = seed;
+    L->shard_rank = shard_rank;
+    L->shard_size = shard_size;
+    L->queue_cap = queue_cap > 0 ? queue_cap : 4;
+    for (int i = 0; i < threads; ++i)
+        L->workers.emplace_back([L] { L->worker(); });
+    return L;
+}
+
+// Blocking: copies the next batch (deterministic order) into caller buffers.
+int kft_loader_next(void* handle, void* out_data, void* out_labels) {
+    auto* L = (Loader*)handle;
+    std::unique_lock<std::mutex> lk(L->mu);
+    L->cv_get.wait(lk, [&] { return L->stop.load() || L->ready.count(L->next_seq); });
+    if (L->stop.load()) return -1;
+    auto it = L->ready.find(L->next_seq);
+    Batch b = std::move(it->second);
+    L->ready.erase(it);
+    L->next_seq++;
+    L->cv_put.notify_all();
+    lk.unlock();
+    std::memcpy(out_data, b.data.data(), b.data.size());
+    std::memcpy(out_labels, b.labels.data(), b.labels.size());
+    return 0;
+}
+
+int64_t kft_loader_steps_per_epoch(void* handle) {
+    return ((Loader*)handle)->steps_per_epoch();
+}
+
+// Elastic reshard: after a cluster resize the same loader continues with a
+// new (rank, size) — mirrors the reference adaptor's shard-by-variables.
+int kft_loader_reshard(void* handle, int shard_rank, int shard_size) {
+    auto* L = (Loader*)handle;
+    if (shard_size <= 0 || shard_rank < 0 || shard_rank >= shard_size) return -1;
+    std::lock_guard<std::mutex> lk(L->plan_mu);
+    L->shard_rank = shard_rank;
+    L->shard_size = shard_size;
+    L->plan_epoch = ~0ull;  // force plan rebuild
+    return 0;
+}
+
+void kft_loader_destroy(void* handle) {
+    auto* L = (Loader*)handle;
+    L->stop = true;
+    {
+        std::lock_guard<std::mutex> lk(L->mu);
+        L->cv_put.notify_all();
+        L->cv_get.notify_all();
+    }
+    for (auto& t : L->workers) t.join();
+    delete L;
+}
+
+}  // extern "C"

@@ -1,0 +1,164 @@
+// Host-side elementwise binary reduction kernel.
+//
+// TPU-native counterpart of the reference's std_transform_2
+// (srcs/cpp/src/kungfu.cpp + include/kungfu/op.h): the C kernel that the
+// runtime calls to aggregate two buffers during host-side (control-plane /
+// blob-store) reductions.  On TPU the *data plane* reductions are XLA
+// collectives; this kernel only serves host paths: the p2p versioned blob
+// store (gossip model averaging) and any DCN-side staging.
+//
+// y <- y OP x, elementwise over n elements.  Compiled -O3; the loops are
+// written so g++ auto-vectorizes them (checked with -fopt-info-vec).
+#include <cstdint>
+#include <cstddef>
+#include <cstring>
+
+namespace {
+
+enum Op : int { OP_SUM = 0, OP_MIN = 1, OP_MAX = 2, OP_PROD = 3 };
+
+// dtype codes mirror kungfu_tpu/native.py (reference dtype.go:7-27 pattern)
+enum Dtype : int {
+    DT_U8 = 0, DT_I8 = 1, DT_U16 = 2, DT_I16 = 3,
+    DT_U32 = 4, DT_I32 = 5, DT_U64 = 6, DT_I64 = 7,
+    DT_F32 = 8, DT_F64 = 9, DT_F16 = 10, DT_BF16 = 11,
+};
+
+template <typename T> inline T op_sum(T a, T b) { return a + b; }
+template <typename T> inline T op_min(T a, T b) { return a < b ? a : b; }
+template <typename T> inline T op_max(T a, T b) { return a > b ? a : b; }
+template <typename T> inline T op_prod(T a, T b) { return a * b; }
+
+template <typename T, T (*F)(T, T)>
+void apply(T* y, const T* x, int64_t n) {
+    for (int64_t i = 0; i < n; ++i) { y[i] = F(y[i], x[i]); }
+}
+
+template <typename T>
+int dispatch_op(T* y, const T* x, int64_t n, int op) {
+    switch (op) {
+        case OP_SUM:  apply<T, op_sum<T>>(y, x, n);  return 0;
+        case OP_MIN:  apply<T, op_min<T>>(y, x, n);  return 0;
+        case OP_MAX:  apply<T, op_max<T>>(y, x, n);  return 0;
+        case OP_PROD: apply<T, op_prod<T>>(y, x, n); return 0;
+    }
+    return -1;
+}
+
+// f16/bf16: widen to float, reduce, narrow.  Bit-exact with numpy's
+// float16/bfloat16 semantics for sum/min/max within one rounding step.
+inline float half_to_float(uint16_t h) {
+    uint32_t sign = (uint32_t)(h >> 15) << 31;
+    uint32_t exp = (h >> 10) & 0x1f;
+    uint32_t man = h & 0x3ff;
+    uint32_t bits;
+    if (exp == 0) {
+        if (man == 0) { bits = sign; }
+        else {  // subnormal
+            exp = 127 - 15 + 1;
+            while (!(man & 0x400)) { man <<= 1; --exp; }
+            man &= 0x3ff;
+            bits = sign | (exp << 23) | (man << 13);
+        }
+    } else if (exp == 0x1f) {
+        bits = sign | 0x7f800000u | (man << 13);
+    } else {
+        bits = sign | ((exp - 15 + 127) << 23) | (man << 13);
+    }
+    float f;
+    std::memcpy(&f, &bits, 4);
+    return f;
+}
+
+inline uint16_t float_to_half(float f) {
+    uint32_t bits;
+    std::memcpy(&bits, &f, 4);
+    uint32_t sign = (bits >> 16) & 0x8000u;
+    int32_t exp = (int32_t)((bits >> 23) & 0xff) - 127 + 15;
+    uint32_t man = bits & 0x7fffffu;
+    if (exp >= 0x1f) return (uint16_t)(sign | 0x7c00u | (((bits >> 23) & 0xff) == 0xff && man ? 0x200 : 0));
+    if (exp <= 0) {
+        if (exp < -10) return (uint16_t)sign;
+        man |= 0x800000u;
+        uint32_t shift = (uint32_t)(14 - exp);
+        uint32_t half_man = man >> shift;
+        uint32_t rem = man & ((1u << shift) - 1);
+        if (rem > (1u << (shift - 1)) || (rem == (1u << (shift - 1)) && (half_man & 1))) half_man++;
+        return (uint16_t)(sign | half_man);
+    }
+    uint32_t half_man = man >> 13;
+    uint32_t rem = man & 0x1fffu;
+    uint16_t h = (uint16_t)(sign | ((uint32_t)exp << 10) | half_man);
+    if (rem > 0x1000u || (rem == 0x1000u && (h & 1))) h++;
+    return h;
+}
+
+inline float bf16_to_float(uint16_t h) {
+    uint32_t bits = (uint32_t)h << 16;
+    float f;
+    std::memcpy(&f, &bits, 4);
+    return f;
+}
+
+inline uint16_t float_to_bf16(float f) {
+    uint32_t bits;
+    std::memcpy(&bits, &f, 4);
+    if ((bits & 0x7fffffffu) > 0x7f800000u)  // NaN: rounding must not carry into Inf
+        return (uint16_t)((bits >> 16) | 0x0040u);  // quiet it, keep sign
+    // round-to-nearest-even
+    uint32_t rounded = bits + 0x7fffu + ((bits >> 16) & 1);
+    return (uint16_t)(rounded >> 16);
+}
+
+template <float (*Load)(uint16_t), uint16_t (*Store)(float)>
+int dispatch_16(uint16_t* y, const uint16_t* x, int64_t n, int op) {
+    for (int64_t i = 0; i < n; ++i) {
+        float a = Load(y[i]), b = Load(x[i]), r;
+        switch (op) {
+            case OP_SUM:  r = a + b; break;
+            case OP_MIN:  r = a < b ? a : b; break;
+            case OP_MAX:  r = a > b ? a : b; break;
+            case OP_PROD: r = a * b; break;
+            default: return -1;
+        }
+        y[i] = Store(r);
+    }
+    return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// y <- y OP x.  Returns 0 on success, -1 on bad op/dtype.
+int kft_transform2(void* y, const void* x, int64_t n, int dtype, int op) {
+    switch (dtype) {
+        case DT_U8:  return dispatch_op((uint8_t*)y, (const uint8_t*)x, n, op);
+        case DT_I8:  return dispatch_op((int8_t*)y, (const int8_t*)x, n, op);
+        case DT_U16: return dispatch_op((uint16_t*)y, (const uint16_t*)x, n, op);
+        case DT_I16: return dispatch_op((int16_t*)y, (const int16_t*)x, n, op);
+        case DT_U32: return dispatch_op((uint32_t*)y, (const uint32_t*)x, n, op);
+        case DT_I32: return dispatch_op((int32_t*)y, (const int32_t*)x, n, op);
+        case DT_U64: return dispatch_op((uint64_t*)y, (const uint64_t*)x, n, op);
+        case DT_I64: return dispatch_op((int64_t*)y, (const int64_t*)x, n, op);
+        case DT_F32: return dispatch_op((float*)y, (const float*)x, n, op);
+        case DT_F64: return dispatch_op((double*)y, (const double*)x, n, op);
+        case DT_F16:
+            return dispatch_16<half_to_float, float_to_half>(
+                (uint16_t*)y, (const uint16_t*)x, n, op);
+        case DT_BF16:
+            return dispatch_16<bf16_to_float, float_to_bf16>(
+                (uint16_t*)y, (const uint16_t*)x, n, op);
+    }
+    return -1;
+}
+
+// y <- (y + x) * 0.5 over float32 — the gossip blob-averaging hot path
+// (reference async_sgd.py:127: assign v = 0.5(v + other_v), done on the
+// fused flat model buffer).
+int kft_average_f32(float* y, const float* x, int64_t n) {
+    for (int64_t i = 0; i < n; ++i) { y[i] = 0.5f * (y[i] + x[i]); }
+    return 0;
+}
+
+}  // extern "C"

@@ -28,4 +28,6 @@ from .api import (  # noqa: F401
     uid,
     run_barrier,
     propose_new_size,
+    save_variable,
+    request_variable,
 )

@@ -71,6 +71,16 @@ def run_barrier() -> None:
     default_peer().current_session().barrier()
 
 
+def save_variable(name: str, arr, version: str = "") -> None:
+    """Publish a blob in this peer's p2p store (reference ops/local.py save_variable)."""
+    default_peer().save(name, arr, version=version)
+
+
+def request_variable(target_rank: int, name: str, version: str = ""):
+    """Pull a blob from another peer's store (reference ops/p2p.py request_variable)."""
+    return default_peer().request(target_rank, name, version=version)
+
+
 def propose_new_size(new_size: int) -> None:
     """Rank 0 proposes a resize via the config server (legacy.go:18-37).
 

@@ -1,0 +1,316 @@
+"""ctypes bindings to the native host library (csrc/ -> libkungfu_host.so).
+
+The reference splits work the same way: Go orchestrates, C++ does the host
+math (std_transform_2, srcs/cpp/src/kungfu.cpp) and the framework runtime
+does IO.  Here Python orchestrates, XLA owns the device data plane, and this
+library owns the host-side hot loops:
+
+  * ``transform2`` — elementwise y <- y OP x (SUM/MIN/MAX/PROD) used by the
+    p2p blob store to aggregate models without round-tripping through JAX,
+  * ``average_f32`` — the gossip model-average kernel,
+  * ``BatchLoader`` — threaded shuffled-gather input pipeline with
+    deterministic order and elastic resharding.
+
+The library is compiled on demand with g++ (cached next to the package).
+Every entry point has a pure-numpy fallback producing bit-identical results
+(the loader's shuffle is splitmix64 Fisher-Yates in both), so the framework
+works — slower — where no toolchain exists.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .utils import get_logger
+
+log = get_logger("kungfu.native")
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "csrc")
+_LIBDIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_lib")
+_LIBPATH = os.path.join(_LIBDIR, "libkungfu_host.so")
+
+_OPS = {"sum": 0, "min": 1, "max": 2, "prod": 3}
+
+_DTYPES = {
+    np.dtype(np.uint8): 0, np.dtype(np.int8): 1,
+    np.dtype(np.uint16): 2, np.dtype(np.int16): 3,
+    np.dtype(np.uint32): 4, np.dtype(np.int32): 5,
+    np.dtype(np.uint64): 6, np.dtype(np.int64): 7,
+    np.dtype(np.float32): 8, np.dtype(np.float64): 9,
+    np.dtype(np.float16): 10,
+}
+
+_lib: Optional[ctypes.CDLL] = None
+_lib_lock = threading.Lock()
+_build_failed = False
+
+
+def _sources():
+    if not os.path.isdir(_CSRC):
+        return []
+    return sorted(
+        os.path.join(_CSRC, f) for f in os.listdir(_CSRC) if f.endswith(".cpp")
+    )
+
+
+def build(force: bool = False) -> Optional[str]:
+    """Compile csrc/ into the cached shared library; returns path or None."""
+    srcs = _sources()
+    if not srcs:
+        return None
+    if not force and os.path.exists(_LIBPATH):
+        newest = max(os.path.getmtime(s) for s in srcs)
+        if os.path.getmtime(_LIBPATH) >= newest:
+            return _LIBPATH
+    os.makedirs(_LIBDIR, exist_ok=True)
+    # compile to a per-process temp name then atomically rename: N launcher-
+    # spawned workers may build concurrently, and dlopen of a half-written
+    # .so crashes the process
+    tmp = f"{_LIBPATH}.{os.getpid()}.tmp"
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        "-march=native", *srcs, "-o", tmp,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _LIBPATH)
+    except (subprocess.SubprocessError, FileNotFoundError, OSError) as e:
+        stderr = getattr(e, "stderr", b"") or b""
+        log.warning("native build failed (%s); using numpy fallbacks", stderr.decode()[:500] or e)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+    return _LIBPATH
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    if _lib is not None:
+        return _lib
+    if _build_failed or os.environ.get("KUNGFU_NO_NATIVE"):
+        return None
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        path = build()
+        if path is None:
+            _build_failed = True
+            return None
+        lib = ctypes.CDLL(path)
+        lib.kft_transform2.restype = ctypes.c_int
+        lib.kft_transform2.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_int, ctypes.c_int,
+        ]
+        lib.kft_average_f32.restype = ctypes.c_int
+        lib.kft_average_f32.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+        ]
+        lib.kft_loader_create.restype = ctypes.c_void_p
+        lib.kft_loader_create.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_uint64, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ]
+        lib.kft_loader_next.restype = ctypes.c_int
+        lib.kft_loader_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+        lib.kft_loader_steps_per_epoch.restype = ctypes.c_int64
+        lib.kft_loader_steps_per_epoch.argtypes = [ctypes.c_void_p]
+        lib.kft_loader_reshard.restype = ctypes.c_int
+        lib.kft_loader_reshard.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_int]
+        lib.kft_loader_destroy.restype = None
+        lib.kft_loader_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+# --- transform2 -----------------------------------------------------------------------
+
+
+def transform2(y: np.ndarray, x: np.ndarray, op: str = "sum") -> np.ndarray:
+    """In-place y <- y OP x.  Arrays must share shape and dtype."""
+    if y.shape != x.shape or y.dtype != x.dtype:
+        raise ValueError(f"shape/dtype mismatch: {y.shape}/{y.dtype} vs {x.shape}/{x.dtype}")
+    lib = _load()
+    code = _DTYPES.get(y.dtype)
+    if lib is not None and code is not None and y.flags.c_contiguous and x.flags.c_contiguous:
+        rc = lib.kft_transform2(
+            y.ctypes.data_as(ctypes.c_void_p), x.ctypes.data_as(ctypes.c_void_p),
+            y.size, code, _OPS[op],
+        )
+        if rc == 0:
+            return y
+    # numpy fallback
+    if op == "sum":
+        np.add(y, x, out=y)
+    elif op == "min":
+        np.minimum(y, x, out=y)
+    elif op == "max":
+        np.maximum(y, x, out=y)
+    elif op == "prod":
+        np.multiply(y, x, out=y)
+    else:
+        raise ValueError(op)
+    return y
+
+
+def average_f32(y: np.ndarray, x: np.ndarray) -> np.ndarray:
+    """In-place y <- 0.5*(y + x), float32 (the gossip blob-average kernel)."""
+    if y.dtype != np.float32 or x.dtype != np.float32:
+        raise ValueError("average_f32 needs float32")
+    if y.shape != x.shape:
+        raise ValueError(f"shape mismatch: {y.shape} vs {x.shape}")
+    lib = _load()
+    if lib is not None and y.flags.c_contiguous and x.flags.c_contiguous:
+        if lib.kft_average_f32(
+            y.ctypes.data_as(ctypes.c_void_p), x.ctypes.data_as(ctypes.c_void_p), y.size
+        ) == 0:
+            return y
+    y += x
+    y *= 0.5
+    return y
+
+
+# --- loader ---------------------------------------------------------------------------
+
+_MASK64 = (1 << 64) - 1
+
+
+def _splitmix64_stream(state: int):
+    while True:
+        state = (state + 0x9E3779B97F4A7C15) & _MASK64
+        z = state
+        z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & _MASK64
+        z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & _MASK64
+        yield z ^ (z >> 31)
+
+
+def _shuffled_perm(seed: int, epoch: int, n: int) -> np.ndarray:
+    """Fisher-Yates with splitmix64 — bit-identical to csrc/dataloader.cpp."""
+    perm = np.arange(n, dtype=np.int64)
+    stream = _splitmix64_stream((seed * 0x9E3779B97F4A7C15 + epoch + 1) & _MASK64)
+    for i in range(n - 1, 0, -1):
+        j = next(stream) % (i + 1)
+        perm[i], perm[j] = perm[j], perm[i]
+    return perm
+
+
+class BatchLoader:
+    """Deterministic shuffled-gather batch stream with threaded prefetch.
+
+    Feeds (data, labels) numpy batches.  With the native library, gathering
+    and prefetch run in C++ worker threads; otherwise a same-stream Python
+    implementation is used.  ``reshard(rank, size)`` re-slices the epoch
+    permutation after an elastic resize (reference v1/datasets/adaptor.py).
+    """
+
+    def __init__(
+        self,
+        data: np.ndarray,
+        labels: np.ndarray,
+        batch_size: int,
+        seed: int = 0,
+        shard_rank: int = 0,
+        shard_size: int = 1,
+        threads: int = 2,
+        queue_cap: int = 4,
+    ):
+        if len(data) != len(labels):
+            raise ValueError("data/labels length mismatch")
+        self.data = np.ascontiguousarray(data)
+        self.labels = np.ascontiguousarray(labels)
+        self.batch_size = batch_size
+        self.seed = seed
+        self.shard_rank = shard_rank
+        self.shard_size = shard_size
+        self._sample_shape = self.data.shape[1:]
+        self._label_shape = self.labels.shape[1:]
+        self._sample_bytes = int(self.data.dtype.itemsize * np.prod(self._sample_shape or (1,)))
+        self._label_bytes = int(self.labels.dtype.itemsize * np.prod(self._label_shape or (1,)))
+        self._handle = None
+        self._seq = 0  # fallback cursor
+        lib = _load()
+        if lib is not None:
+            h = lib.kft_loader_create(
+                self.data.ctypes.data_as(ctypes.c_void_p),
+                self.labels.ctypes.data_as(ctypes.c_void_p),
+                len(self.data), self._sample_bytes, self._label_bytes,
+                batch_size, seed, shard_rank, shard_size, threads, queue_cap,
+            )
+            self._handle = h or None
+
+    @property
+    def steps_per_epoch(self) -> int:
+        if self._handle is not None:
+            return int(_load().kft_loader_steps_per_epoch(self._handle))
+        n = len(self.data)
+        shard_n = n // self.shard_size + (1 if (n % self.shard_size) > self.shard_rank else 0)
+        return shard_n // self.batch_size
+
+    def reshard(self, shard_rank: int, shard_size: int) -> None:
+        if not (0 <= shard_rank < shard_size):
+            raise ValueError(f"bad shard {shard_rank}/{shard_size}")
+        self.shard_rank, self.shard_size = shard_rank, shard_size
+        self._plan_cache = None
+        if self._handle is not None:
+            if _load().kft_loader_reshard(self._handle, shard_rank, shard_size) != 0:
+                raise ValueError(f"bad shard {shard_rank}/{shard_size}")
+
+    def __next__(self) -> Tuple[np.ndarray, np.ndarray]:
+        out_d = np.empty((self.batch_size, *self._sample_shape), self.data.dtype)
+        out_l = np.empty((self.batch_size, *self._label_shape), self.labels.dtype)
+        if self._handle is not None:
+            rc = _load().kft_loader_next(
+                self._handle,
+                out_d.ctypes.data_as(ctypes.c_void_p),
+                out_l.ctypes.data_as(ctypes.c_void_p),
+            )
+            if rc != 0:
+                raise StopIteration
+            return out_d, out_l
+        # fallback: same plan math as the C++ worker
+        spe = max(self.steps_per_epoch, 1)
+        epoch, step = divmod(self._seq, spe)
+        self._seq += 1
+        perm = self._fallback_plan(epoch)
+        idx = [perm[(step * self.batch_size + b) % len(perm)] for b in range(self.batch_size)]
+        out_d[...] = self.data[idx]
+        out_l[...] = self.labels[idx]
+        return out_d, out_l
+
+    def __iter__(self):
+        return self
+
+    _plan_cache: Optional[Tuple[int, np.ndarray]] = None
+
+    def _fallback_plan(self, epoch: int) -> np.ndarray:
+        if self._plan_cache is not None and self._plan_cache[0] == epoch:
+            return self._plan_cache[1]
+        perm = _shuffled_perm(self.seed, epoch, len(self.data))
+        plan = perm[self.shard_rank :: self.shard_size]
+        if len(plan) == 0:
+            plan = np.zeros(1, np.int64)
+        self._plan_cache = (epoch, plan)
+        return plan
+
+    def close(self) -> None:
+        if self._handle is not None:
+            _load().kft_loader_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass

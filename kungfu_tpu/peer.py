@@ -38,6 +38,8 @@ class Peer:
         self._session: Optional[Session] = None
         self._started = False
         self._dist_initialized = False
+        self._store_server = None
+        self._store_client = None
 
     # -- identity (reference peer.go + python/__init__.py:36-103) ---------------------
 
@@ -84,6 +86,11 @@ class Peer:
         if self.size > 1 and not self.config.single_machine:
             self._init_distributed()
         self._session = self._build_session()
+        if self.size > 1:
+            # eager store start: a faster peer must find our server listening
+            # before our first save/request (its wait=False pull is a miss,
+            # never a connection error)
+            self._ensure_store()
         self._started = True
         log.info(
             "peer up: rank %d/%d local %d/%d hosts %d version %d",
@@ -129,7 +136,52 @@ class Peer:
         assert self._session is not None
         return self._session
 
+    # -- p2p blob store (reference peer/p2p.go Save/Request + handler/p2p.go) ---------
+
+    def _ensure_store(self):
+        from .store import StoreClient, StoreServer, store_port
+
+        if self._store_server is None:
+            bind = "0.0.0.0" if not self.config.single_machine else "127.0.0.1"
+            self._store_server = StoreServer(
+                host=bind, port=store_port(self.self_id.port)
+            ).start()
+            self._store_client = StoreClient()
+        return self._store_server, self._store_client
+
+    def save(self, name: str, arr, version: str = "") -> None:
+        """Publish a named blob in this peer's store (GoKungfuSave analog)."""
+        import numpy as np
+
+        srv, _ = self._ensure_store()
+        srv.save(name, np.asarray(arr), version=version)
+
+    def request(self, target_rank: int, name: str, version: str = "",
+                wait: bool = True, timeout: float = 30.0):
+        """Pull a named blob from peer `target_rank`'s store (GoKungfuRequest)."""
+        from .store import poll_until
+        import time as _time
+
+        srv, client = self._ensure_store()
+        if target_rank == self.rank:
+            # honor wait semantics on the self path too: correct code must
+            # not break only when the target happens to be self
+            return poll_until(
+                lambda: srv.get(name, version=version),
+                wait=wait, deadline=_time.monotonic() + timeout,
+            )
+        return client.request(
+            self.config.peers[target_rank], name, version=version,
+            wait=wait, timeout=timeout,
+        )
+
     def close(self) -> None:
+        if self._store_server is not None:
+            self._store_server.close()
+            self._store_server = None
+        if self._store_client is not None:
+            self._store_client.close()
+            self._store_client = None
         if self._dist_initialized:
             try:
                 jax.distributed.shutdown()
