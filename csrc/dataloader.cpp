@@ -6,8 +6,8 @@
 // (v1/datasets/adaptor.py:4-33) does skip -> shard -> batch).  JAX has no
 // native input pipeline, so this supplies one: the dataset lives in host
 // RAM (numpy arrays from Python), and C++ worker threads do the shuffled
-// gather into contiguous pinned-size batch buffers ahead of the consumer —
-// feeding the TPU without Python in the hot loop.
+// gather into contiguous batch buffers ahead of the consumer — feeding the
+// TPU without Python in the hot loop.
 //
 // Semantics (matches the elastic adaptor):
 //   * per-epoch deterministic shuffle from (seed, epoch) — every shard sees
@@ -15,7 +15,10 @@
 //     after an elastic resize is just changing (rank, size),
 //   * remainder samples of each epoch's shard are dropped (static shapes
 //     for XLA),
-//   * batches are delivered in deterministic order via a reorder window.
+//   * batches are delivered in deterministic order via a reorder window,
+//   * reshard is generation-fenced: batches prefetched under the old
+//     (rank,size) are discarded and re-gathered, so every batch delivered
+//     after kft_loader_reshard returns reflects the new shard.
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
@@ -51,61 +54,88 @@ struct Batch {
     std::vector<uint8_t> labels;
 };
 
+struct EpochPlan {
+    uint64_t epoch;
+    std::vector<int64_t> idx;  // this shard's sample indices for the epoch
+};
+
 struct Loader {
     const uint8_t* data;
     const uint8_t* labels;
     int64_t n, sample_bytes, label_bytes, batch;
     uint64_t seed;
-    std::atomic<int> shard_rank, shard_size;
     int queue_cap;
 
     std::vector<std::thread> workers;
+
+    // mu guards the claim/reorder machinery AND the shard tuple + generation
     std::mutex mu;
     std::condition_variable cv_put, cv_get;
-    std::map<uint64_t, Batch> ready;          // seq -> batch (reorder window)
-    uint64_t next_seq = 0;                    // consumer cursor
-    std::atomic<uint64_t> claim_seq{0};       // producer cursor
+    std::map<uint64_t, Batch> ready;  // seq -> batch (reorder window)
+    uint64_t next_seq = 0;            // consumer cursor
+    uint64_t claim_seq = 0;           // producer cursor
+    uint64_t gen = 0;                 // bumped by reshard; fences stale batches
+    int shard_rank, shard_size;
     std::atomic<bool> stop{false};
 
-    // epoch plan shared by workers, rebuilt lazily per epoch
+    // plan cache: the current and next epoch's plans, so workers straddling
+    // an epoch boundary don't rebuild the O(n) permutation per batch
     std::mutex plan_mu;
-    uint64_t plan_epoch = ~0ull;
-    std::vector<int64_t> plan;                // this shard's sample indices
+    std::vector<EpochPlan> plans;
+    uint64_t plan_gen = 0;
 
-    int64_t steps_per_epoch() const {
-        int r = shard_rank.load(), s = shard_size.load();
+    int64_t steps_for(int r, int s) const {
         int64_t shard_n = n / s + ((n % s) > r ? 1 : 0);
         return shard_n / batch;
     }
 
-    void gather(uint64_t seq, Batch& out) {
-        // map the global sequence number to (epoch, step) lazily; an
-        // elastic reshard changes steps_per_epoch, so recompute each call
-        int64_t spe = steps_per_epoch();
+    int64_t steps_per_epoch() {
+        std::lock_guard<std::mutex> lk(mu);
+        return steps_for(shard_rank, shard_size);
+    }
+
+    // copy out this batch's `batch` indices from the (epoch, shard) plan,
+    // building/caching the plan if needed.  Only O(batch) work is done under
+    // plan_mu (plus the rare O(n) plan build); the memcpys run unlocked.
+    void batch_indices(uint64_t epoch, int64_t step, uint64_t g, int r, int s,
+                       std::vector<int64_t>& idxs) {
+        std::lock_guard<std::mutex> lk(plan_mu);
+        if (plan_gen != g) {
+            plans.clear();
+            plan_gen = g;
+        }
+        const EpochPlan* found = nullptr;
+        for (auto& p : plans)
+            if (p.epoch == epoch) { found = &p; break; }
+        if (!found) {
+            std::vector<int64_t> perm;
+            shuffled_perm(seed, epoch, n, perm);
+            EpochPlan p;
+            p.epoch = epoch;
+            for (int64_t i = r; i < n; i += s) p.idx.push_back(perm[i]);
+            if (p.idx.empty()) p.idx.push_back(0);
+            if (plans.size() >= 2) {  // keep current + one neighbor epoch
+                size_t oldest = plans[0].epoch < plans[1].epoch ? 0 : 1;
+                plans.erase(plans.begin() + (long)oldest);
+            }
+            plans.push_back(std::move(p));
+            found = &plans.back();
+        }
+        const auto& plan = found->idx;
+        idxs.resize((size_t)batch);
+        for (int64_t b = 0; b < batch; ++b)
+            idxs[(size_t)b] = plan[(size_t)((step * batch + b) % (int64_t)plan.size())];
+    }
+
+    void gather(uint64_t seq, uint64_t g, int r, int s, Batch& out) {
+        int64_t spe = steps_for(r, s);
         if (spe == 0) spe = 1;
         uint64_t epoch = seq / (uint64_t)spe;
         int64_t step = (int64_t)(seq % (uint64_t)spe);
         out.data.resize((size_t)(batch * sample_bytes));
         out.labels.resize((size_t)(batch * label_bytes));
-        // snapshot this batch's indices under the lock, memcpy outside it:
-        // the copies dominate, and serializing them would defeat the worker
-        // pool.  The lock spans plan build + index read so workers near an
-        // epoch boundary never read a plan rebuilt for a different epoch.
-        std::vector<int64_t> idxs((size_t)batch);
-        {
-            std::lock_guard<std::mutex> lk(plan_mu);
-            if (plan_epoch != epoch) {
-                std::vector<int64_t> perm;
-                shuffled_perm(seed, epoch, n, perm);
-                int r = shard_rank.load(), s = shard_size.load();
-                plan.clear();
-                for (int64_t i = r; i < n; i += s) plan.push_back(perm[i]);
-                plan_epoch = epoch;
-            }
-            if (plan.empty()) plan.push_back(0);
-            for (int64_t b = 0; b < batch; ++b)
-                idxs[(size_t)b] = plan[(size_t)((step * batch + b) % (int64_t)plan.size())];
-        }
+        std::vector<int64_t> idxs;
+        batch_indices(epoch, step, g, r, s, idxs);
         for (int64_t b = 0; b < batch; ++b) {
             int64_t idx = idxs[(size_t)b];
             std::memcpy(out.data.data() + b * sample_bytes,
@@ -117,14 +147,23 @@ struct Loader {
 
     void worker() {
         while (!stop.load()) {
-            uint64_t seq = claim_seq.fetch_add(1);
+            uint64_t seq, g;
+            int r, s;
+            {
+                std::lock_guard<std::mutex> lk(mu);
+                seq = claim_seq++;
+                g = gen;
+                r = shard_rank;
+                s = shard_size;
+            }
             Batch b;
-            gather(seq, b);
+            gather(seq, g, r, s, b);
             std::unique_lock<std::mutex> lk(mu);
             cv_put.wait(lk, [&] {
-                return stop.load() || (seq < next_seq + (uint64_t)queue_cap);
+                return stop.load() || g != gen || seq < next_seq + (uint64_t)queue_cap;
             });
             if (stop.load()) return;
+            if (g != gen) continue;  // resharded while gathering: discard
             ready.emplace(seq, std::move(b));
             cv_get.notify_all();
         }
@@ -139,7 +178,8 @@ void* kft_loader_create(const void* data, const void* labels, int64_t n,
                         int64_t sample_bytes, int64_t label_bytes,
                         int64_t batch, uint64_t seed, int shard_rank,
                         int shard_size, int threads, int queue_cap) {
-    if (n <= 0 || batch <= 0 || shard_size <= 0 || threads <= 0) return nullptr;
+    if (n <= 0 || batch <= 0 || threads <= 0) return nullptr;
+    if (shard_size <= 0 || shard_rank < 0 || shard_rank >= shard_size) return nullptr;
     auto* L = new Loader();
     L->data = (const uint8_t*)data;
     L->labels = (const uint8_t*)labels;
@@ -179,13 +219,18 @@ int64_t kft_loader_steps_per_epoch(void* handle) {
 
 // Elastic reshard: after a cluster resize the same loader continues with a
 // new (rank, size) — mirrors the reference adaptor's shard-by-variables.
+// Generation fencing guarantees no batch gathered under the old shard is
+// delivered after this returns.
 int kft_loader_reshard(void* handle, int shard_rank, int shard_size) {
     auto* L = (Loader*)handle;
     if (shard_size <= 0 || shard_rank < 0 || shard_rank >= shard_size) return -1;
-    std::lock_guard<std::mutex> lk(L->plan_mu);
+    std::lock_guard<std::mutex> lk(L->mu);
     L->shard_rank = shard_rank;
     L->shard_size = shard_size;
-    L->plan_epoch = ~0ull;  // force plan rebuild
+    L->gen++;
+    L->ready.clear();           // drop prefetched old-shard batches
+    L->claim_seq = L->next_seq; // re-gather everything not yet delivered
+    L->cv_put.notify_all();     // wake stale waiters so they discard
     return 0;
 }
 

@@ -30,4 +30,8 @@ from .api import (  # noqa: F401
     propose_new_size,
     save_variable,
     request_variable,
+    calc_stats,
+    log_stats,
+    egress_rates,
+    check_interference,
 )

@@ -71,6 +71,34 @@ def run_barrier() -> None:
     default_peer().current_session().barrier()
 
 
+def calc_stats() -> dict:
+    """Per-op throughput stats (reference GoKungfuCalcStats)."""
+    return default_peer().current_session().calc_stats()
+
+
+def log_stats() -> None:
+    """Log the current throughput stats (reference python/__init__.py log_stats)."""
+    from .utils import get_logger
+
+    get_logger("kungfu.stats").info("throughput stats: %s", calc_stats())
+
+
+def egress_rates() -> dict:
+    """Windowed egress byte rates per op (reference EgressRates op)."""
+    from .monitor import global_counters
+
+    return global_counters().egress_rates()
+
+
+def check_interference() -> bool:
+    """Majority-vote interference check; True if the cluster switched
+    strategy (reference python/__init__.py check_interference).  Collective:
+    every peer must call it at the same point."""
+    det = default_peer().interference_detector()
+    det.observe()
+    return det.check()
+
+
 def save_variable(name: str, arr, version: str = "") -> None:
     """Publish a blob in this peer's p2p store (reference ops/local.py save_variable)."""
     default_peer().save(name, arr, version=version)

@@ -1,0 +1,10 @@
+"""Observability: byte counters, Prometheus endpoint, interference detection.
+
+Reference: srcs/go/monitor/{monitor,counters.go} (windowed egress/ingress
+rates, Prometheus-text exposition), peer.go:92-99 (HTTP server on
+self.Port+10000 behind KUNGFU_CONFIG_ENABLE_MONITORING), and
+session/adaptiveStrategies.go (throughput-reference interference vote).
+"""
+from .counters import Counters, RateWindow, global_counters  # noqa: F401
+from .server import MonitorServer, monitor_port, maybe_start_monitor  # noqa: F401
+from .interference import InterferenceDetector  # noqa: F401

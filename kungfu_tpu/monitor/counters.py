@@ -1,0 +1,113 @@
+"""Byte counters with windowed rates (reference srcs/go/monitor/counters.go).
+
+The reference accumulates per-peer egress/ingress bytes at the rchannel
+client/server and computes rates over a sampling window (counters.go:13-110).
+On TPU the data plane is inside XLA, so the byte stream is accounted at the
+Session boundary instead: every collective records (bytes entering the
+collective) per op name, and the store/elastic layers record their own host
+traffic per peer.  Rates use the same windowed-delta scheme.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+
+class RateWindow:
+    """Windowed byte-rate estimator (counters.go rate sampling)."""
+
+    def __init__(self, window_s: float = 5.0):
+        self.window_s = window_s
+        self._samples: deque = deque()  # (t, cumulative_bytes)
+        self._total = 0
+
+    def add(self, nbytes: int, t: Optional[float] = None) -> None:
+        t = time.monotonic() if t is None else t
+        self._total += nbytes
+        self._samples.append((t, self._total))
+        self._trim(t)
+
+    def _trim(self, now: float) -> None:
+        while self._samples and now - self._samples[0][0] > self.window_s:
+            self._samples.popleft()
+
+    @property
+    def total(self) -> int:
+        return self._total
+
+    def rate(self, now: Optional[float] = None) -> float:
+        """Bytes/sec over the window."""
+        now = time.monotonic() if now is None else now
+        self._trim(now)
+        if not self._samples:
+            return 0.0
+        t0, b0 = self._samples[0]
+        t1, b1 = self._samples[-1]
+        if t1 <= t0:
+            return 0.0
+        return (b1 - b0) / (t1 - t0)
+
+
+class Counters:
+    """Named egress/ingress accumulators with Prometheus-text exposition."""
+
+    def __init__(self, window_s: float = 5.0):
+        self._lock = threading.Lock()
+        self._window_s = window_s
+        self._egress: Dict[str, RateWindow] = {}
+        self._ingress: Dict[str, RateWindow] = {}
+
+    def _get(self, table: Dict[str, RateWindow], key: str) -> RateWindow:
+        w = table.get(key)
+        if w is None:
+            w = table[key] = RateWindow(self._window_s)
+        return w
+
+    def add_egress(self, key: str, nbytes: int) -> None:
+        with self._lock:
+            self._get(self._egress, key).add(nbytes)
+
+    def add_ingress(self, key: str, nbytes: int) -> None:
+        with self._lock:
+            self._get(self._ingress, key).add(nbytes)
+
+    def egress_rates(self) -> Dict[str, float]:
+        with self._lock:
+            return {k: w.rate() for k, w in self._egress.items()}
+
+    def ingress_rates(self) -> Dict[str, float]:
+        with self._lock:
+            return {k: w.rate() for k, w in self._ingress.items()}
+
+    def totals(self) -> Tuple[Dict[str, int], Dict[str, int]]:
+        with self._lock:
+            return (
+                {k: w.total for k, w in self._egress.items()},
+                {k: w.total for k, w in self._ingress.items()},
+            )
+
+    def prometheus_text(self) -> str:
+        """Exposition format matching the reference's metric names
+        (counters.go:57-60,100-147: egress_total_bytes{peer=...} etc.)."""
+        lines: List[str] = []
+        etot, itot = self.totals()
+        erate, irate = self.egress_rates(), self.ingress_rates()
+        for metric, table in (
+            ("egress_total_bytes", etot),
+            ("ingress_total_bytes", itot),
+            ("egress_rate_bytes_per_sec", erate),
+            ("ingress_rate_bytes_per_sec", irate),
+        ):
+            lines.append(f"# TYPE {metric} {'counter' if 'total' in metric else 'gauge'}")
+            for key in sorted(table):
+                lines.append(f'{metric}{{peer="{key}"}} {table[key]}')
+        return "\n".join(lines) + "\n"
+
+
+_global = Counters()
+
+
+def global_counters() -> Counters:
+    return _global

@@ -1,0 +1,98 @@
+"""Network-interference detection + majority-vote strategy adaptation.
+
+Reference: session/adaptiveStrategies.go:13-123 — each peer tracks per-
+strategy throughput; when the current strategy's throughput drops below
+0.8x its best observed ("reference") rate the peer votes "interference";
+votes are summed with an allreduce and on a cluster majority every peer
+deterministically switches to the next strategy.  monitoring.go:15-36 wires
+this behind monitored collectives.
+
+On TPU the strategies being voted between are the Session's allreduce
+implementations (one-shot psum / phased reduce-scatter+all-gather / explicit
+ring / hierarchical ici-dcn) — the XLA-era analog of swapping routing graphs.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..plan import Strategy
+from ..utils import get_logger
+
+log = get_logger("kungfu.interference")
+
+DEFAULT_THRESHOLD = 0.8  # adaptiveStrategies.go: tput < 0.8*reference => vote
+
+
+class InterferenceDetector:
+    """Per-peer throughput reference + cluster-majority strategy switching."""
+
+    def __init__(
+        self,
+        session,
+        candidates: Optional[List[Strategy]] = None,
+        threshold: float = DEFAULT_THRESHOLD,
+        min_samples: int = 3,
+    ):
+        self.session = session
+        self.threshold = threshold
+        self.min_samples = min_samples
+        self.candidates = candidates or [
+            Strategy.BINARY_TREE_STAR,  # -> hierarchical / rs+ag
+            Strategy.RING,
+            Strategy.STAR,              # -> one-shot psum
+        ]
+        self._reference: Dict[Strategy, float] = {}
+        self._samples: Dict[Strategy, int] = {}
+
+    def observe(self) -> float:
+        """Record the session's current throughput as a strategy sample."""
+        s = self.session.strategy
+        tput = self.session.throughput()
+        if tput <= 0:
+            return 0.0
+        self._samples[s] = self._samples.get(s, 0) + 1
+        self._reference[s] = max(self._reference.get(s, 0.0), tput)
+        return tput
+
+    def local_vote(self) -> bool:
+        """True if this peer sees degraded throughput vs its reference."""
+        s = self.session.strategy
+        if self._samples.get(s, 0) < self.min_samples:
+            return False
+        ref = self._reference.get(s, 0.0)
+        cur = self.session.throughput()
+        return ref > 0 and cur < self.threshold * ref
+
+    def check(self) -> bool:
+        """Allreduce the vote; on majority, rotate every peer's strategy.
+
+        Returns True if a switch happened.  All peers must call this at the
+        same point (it contains a collective) — same contract as the
+        reference's CheckInterference op.
+        """
+        import jax.numpy as jnp
+
+        n = self.session.size
+        vote = 1.0 if self.local_vote() else 0.0
+        votes = self.session.all_reduce(
+            jnp.full((n, 1), vote, jnp.float32), name="interference-vote"
+        )
+        total = float(np.asarray(votes)[0, 0])
+        if total <= n / 2:
+            return False
+        nxt = self._next_strategy()
+        log.info("interference majority (%d/%d votes): switching to %s",
+                 int(total), n, nxt.name)
+        self.session.set_strategy(nxt)
+        self.session.stats.reset()
+        return True
+
+    def _next_strategy(self) -> Strategy:
+        cur = self.session.strategy
+        if cur in self.candidates:
+            i = (self.candidates.index(cur) + 1) % len(self.candidates)
+        else:
+            i = 0
+        return self.candidates[i]

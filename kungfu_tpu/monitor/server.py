@@ -1,0 +1,80 @@
+"""Prometheus-text HTTP endpoint (reference peer.go:92-99 + counters.go).
+
+The reference serves /metrics on self.Port+10000 when
+KUNGFU_CONFIG_ENABLE_MONITORING=true.  Same contract here with KFT_* names;
+the port offset differs (16000) to stay clear of the store (+15000) and the
+jax.distributed coordinator (+20000) while remaining below the Linux
+ephemeral range.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..utils import get_logger
+from ..utils.envflag import env_flag
+from .counters import Counters, global_counters
+
+log = get_logger("kungfu.monitor")
+
+ENABLE_ENV = "KFT_CONFIG_ENABLE_MONITORING"
+MONITOR_PORT_OFFSET = 16000
+
+
+def monitor_port(worker_port: int) -> int:
+    p = worker_port + MONITOR_PORT_OFFSET
+    if not (0 < p <= 65535):
+        raise ValueError(f"worker port {worker_port} leaves no room for monitor port")
+    return p
+
+
+def enabled() -> bool:
+    return env_flag(ENABLE_ENV)
+
+
+class MonitorServer:
+    """Serves GET /metrics with the counters' Prometheus text."""
+
+    def __init__(self, counters: Optional[Counters] = None,
+                 host: str = "0.0.0.0", port: int = 0):
+        self.counters = counters if counters is not None else global_counters()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path.rstrip("/") in ("", "/metrics"):
+                    body = outer.counters.prometheus_text().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+
+            def log_message(self, *a):  # silence default stderr spam
+                pass
+
+        self._srv = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._srv.server_address[:2]
+        self._thread = threading.Thread(target=self._srv.serve_forever, daemon=True)
+
+    def start(self) -> "MonitorServer":
+        self._thread.start()
+        log.info("monitoring on http://%s:%d/metrics", self.host, self.port)
+        return self
+
+    def close(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+def maybe_start_monitor(worker_port: int, host: str = "0.0.0.0") -> Optional[MonitorServer]:
+    """Start the endpoint iff KFT_CONFIG_ENABLE_MONITORING is set
+    (the reference's gate, peer.go:92-99)."""
+    if not enabled():
+        return None
+    return MonitorServer(host=host, port=monitor_port(worker_port)).start()

@@ -31,7 +31,21 @@ from .utils import get_logger
 log = get_logger("kungfu.native")
 
 _CSRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "csrc")
-_LIBDIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_lib")
+
+
+def _lib_dir() -> str:
+    """Per-host cache dir: the -march=native build must never be shared
+    across heterogeneous hosts (SIGILL on the weaker CPU)."""
+    override = os.environ.get("KFT_NATIVE_CACHE")
+    if override:
+        return override
+    import platform
+
+    base = os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache"))
+    return os.path.join(base, "kungfu_tpu", f"{platform.machine()}-{platform.node()}")
+
+
+_LIBDIR = _lib_dir()
 _LIBPATH = os.path.join(_LIBDIR, "libkungfu_host.so")
 
 _OPS = {"sum": 0, "min": 1, "max": 2, "prod": 3}
@@ -142,6 +156,8 @@ def transform2(y: np.ndarray, x: np.ndarray, op: str = "sum") -> np.ndarray:
     """In-place y <- y OP x.  Arrays must share shape and dtype."""
     if y.shape != x.shape or y.dtype != x.dtype:
         raise ValueError(f"shape/dtype mismatch: {y.shape}/{y.dtype} vs {x.shape}/{x.dtype}")
+    if op not in _OPS:
+        raise ValueError(f"unknown op {op!r}; want one of {sorted(_OPS)}")
     lib = _load()
     code = _DTYPES.get(y.dtype)
     if lib is not None and code is not None and y.flags.c_contiguous and x.flags.c_contiguous:
@@ -228,6 +244,8 @@ class BatchLoader:
     ):
         if len(data) != len(labels):
             raise ValueError("data/labels length mismatch")
+        if not (0 <= shard_rank < shard_size):
+            raise ValueError(f"bad shard {shard_rank}/{shard_size}")
         self.data = np.ascontiguousarray(data)
         self.labels = np.ascontiguousarray(labels)
         self.batch_size = batch_size

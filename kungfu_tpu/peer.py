@@ -40,6 +40,8 @@ class Peer:
         self._dist_initialized = False
         self._store_server = None
         self._store_client = None
+        self._monitor = None
+        self._interference = None
 
     # -- identity (reference peer.go + python/__init__.py:36-103) ---------------------
 
@@ -91,6 +93,10 @@ class Peer:
             # before our first save/request (its wait=False pull is a miss,
             # never a connection error)
             self._ensure_store()
+        from .monitor import maybe_start_monitor
+
+        bind = "127.0.0.1" if self.config.single_machine else "0.0.0.0"
+        self._monitor = maybe_start_monitor(self.self_id.port, host=bind)
         self._started = True
         log.info(
             "peer up: rank %d/%d local %d/%d hosts %d version %d",
@@ -136,6 +142,16 @@ class Peer:
         assert self._session is not None
         return self._session
 
+    def interference_detector(self):
+        """Lazily-built detector bound to the current session
+        (GoKungfuCheckInterference analog, libkungfu-comm/monitoring.go)."""
+        from .monitor import InterferenceDetector
+
+        sess = self.current_session()
+        if self._interference is None or self._interference.session is not sess:
+            self._interference = InterferenceDetector(sess)
+        return self._interference
+
     # -- p2p blob store (reference peer/p2p.go Save/Request + handler/p2p.go) ---------
 
     def _ensure_store(self):
@@ -176,6 +192,9 @@ class Peer:
         )
 
     def close(self) -> None:
+        if getattr(self, "_monitor", None) is not None:
+            self._monitor.close()
+            self._monitor = None
         if self._store_server is not None:
             self._store_server.close()
             self._store_server = None

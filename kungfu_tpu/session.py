@@ -42,6 +42,12 @@ from .utils import get_logger, stall_detector
 log = get_logger("kungfu.session")
 
 
+def _counters():
+    from .monitor.counters import global_counters
+
+    return global_counters()
+
+
 class OpStats:
     """Per-named-op throughput accounting (reference session/strategy.go:22-56).
 
@@ -184,6 +190,7 @@ class Session:
             out = fn(x)
             out.block_until_ready()
         self.stats.record(name or kind, x.nbytes, time.perf_counter() - t0)
+        _counters().add_egress(name or kind, x.nbytes)
         return out
 
     def all_reduce(self, x, op: str = "sum", name: str = "", strategy=None):

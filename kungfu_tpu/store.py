@@ -62,28 +62,35 @@ _ST_NOT_FOUND = 1
 
 
 class Blob:
-    """A named byte buffer + dtype/shape sidecar for numpy round-trips."""
+    """A named byte buffer + dtype/shape sidecar for numpy round-trips.
 
-    def __init__(self, data: bytes, dtype: str = "u1", shape: Tuple[int, ...] = ()):
+    shape=None means a raw flat buffer (no reshape on read); shape=() is a
+    genuine 0-d scalar and round-trips as such.
+    """
+
+    def __init__(self, data: bytes, dtype: str = "u1",
+                 shape: Optional[Tuple[int, ...]] = None):
         self.data = data
         self.dtype = dtype
         self.shape = shape
 
     @classmethod
     def from_array(cls, arr: np.ndarray) -> "Blob":
-        arr = np.ascontiguousarray(arr)
+        # NOT ascontiguousarray: it silently promotes 0-d scalars to 1-d
+        arr = np.asarray(arr, order="C")
         return cls(arr.tobytes(), arr.dtype.str, arr.shape)
 
     def to_array(self) -> np.ndarray:
         # copy: frombuffer views are read-only, but callers aggregate into
         # received blobs in place (native.transform2/average_f32)
         a = np.frombuffer(self.data, dtype=np.dtype(self.dtype)).copy()
-        return a.reshape(self.shape) if self.shape else a
+        return a if self.shape is None else a.reshape(self.shape)
 
     # sidecar is serialized into the payload header so remote blobs
-    # reconstruct with dtype+shape intact
+    # reconstruct with dtype+shape intact ("*" marks a raw flat buffer)
     def pack(self) -> bytes:
-        meta = f"{self.dtype};{','.join(map(str, self.shape))}".encode()
+        shape_s = "*" if self.shape is None else ",".join(map(str, self.shape))
+        meta = f"{self.dtype};{shape_s}".encode()
         return struct.pack(">I", len(meta)) + meta + self.data
 
     @classmethod
@@ -91,7 +98,7 @@ class Blob:
         (mlen,) = struct.unpack(">I", payload[:4])
         meta = payload[4 : 4 + mlen].decode()
         dtype, shape_s = meta.split(";")
-        shape = tuple(int(x) for x in shape_s.split(",") if x)
+        shape = None if shape_s == "*" else tuple(int(x) for x in shape_s.split(",") if x)
         return cls(payload[4 + mlen :], dtype, shape)
 
 

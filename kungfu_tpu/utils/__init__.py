@@ -1,5 +1,9 @@
 from .log import get_logger, log
 from .stall import stall_detector
 from .ema import EMA
+from .trace import trace_scope, log_event, profile_to
 
-__all__ = ["get_logger", "log", "stall_detector", "EMA"]
+__all__ = [
+    "get_logger", "log", "stall_detector", "EMA",
+    "trace_scope", "log_event", "profile_to",
+]

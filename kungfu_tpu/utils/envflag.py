@@ -1,0 +1,6 @@
+"""Shared truthy-env-flag parsing for the KFT_CONFIG_* tuning tier."""
+import os
+
+
+def env_flag(name: str) -> bool:
+    return os.environ.get(name, "").lower() in ("1", "true", "yes", "on")

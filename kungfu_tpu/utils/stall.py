@@ -22,7 +22,9 @@ DEFAULT_PERIOD_S = 3.0
 
 
 def enabled() -> bool:
-    return os.environ.get(ENABLED_ENV, "").lower() in ("1", "true", "yes")
+    from .envflag import env_flag
+
+    return env_flag(ENABLED_ENV)
 
 
 @contextlib.contextmanager

@@ -1,0 +1,88 @@
+"""Tracing/profiling — TRACE_SCOPE analog + jax.profiler integration.
+
+Reference: include/kungfu/utils/trace.hpp (TRACE_SCOPE macros compiled in
+behind KUNGFU_ENABLE_TRACE) and the Python event logger stamping times since
+proc/job start (srcs/python/kungfu/_utils.py:33-50).
+
+`trace_scope(name)` is a no-op unless KFT_CONFIG_ENABLE_TRACE is set, in
+which case it logs enter/exit with durations and (when requested) also
+opens a `jax.profiler.TraceAnnotation` so the scope shows up in TPU
+profiler timelines (Perfetto / tensorboard).  `profile_to(dir)` wraps a
+block in a full `jax.profiler.trace` capture.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import Iterator, Optional
+
+from .log import get_logger
+
+log = get_logger("kungfu.trace")
+
+ENABLE_ENV = "KFT_CONFIG_ENABLE_TRACE"
+
+# times since job/proc start (reference _utils.py:33-50: the launcher stamps
+# KFT_JOB_START; each worker stamps its own proc start at import)
+_PROC_START = time.time()
+
+
+def _job_start() -> float:
+    v = os.environ.get("KFT_JOB_START")
+    try:
+        return float(v) if v else _PROC_START
+    except ValueError:
+        return _PROC_START
+
+
+def enabled() -> bool:
+    from .envflag import env_flag
+
+    return env_flag(ENABLE_ENV)
+
+
+def log_event(name: str) -> None:
+    """One-line event with (t_since_job, t_since_proc) stamps."""
+    if not enabled():
+        return
+    now = time.time()
+    log.info("[event] %s +%.3fs job +%.3fs proc", name, now - _job_start(), now - _PROC_START)
+
+
+@contextlib.contextmanager
+def trace_scope(name: str, device: bool = False) -> Iterator[None]:
+    """Scoped timing log; with device=True also annotates the XLA timeline."""
+    if not enabled():
+        yield
+        return
+    ann = None
+    if device:
+        try:
+            import jax.profiler
+
+            ann = jax.profiler.TraceAnnotation(name)
+            ann.__enter__()
+        except Exception:  # pragma: no cover - profiler backend optional
+            ann = None
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        if ann is not None:
+            ann.__exit__(None, None, None)
+        log.info("[trace] %s took %.3f ms", name, dt * 1e3)
+
+
+@contextlib.contextmanager
+def profile_to(logdir: str) -> Iterator[None]:
+    """Full profiler capture of the block into `logdir` (Perfetto-viewable)."""
+    import jax.profiler
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        log.info("profile written to %s", logdir)

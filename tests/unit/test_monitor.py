@@ -1,0 +1,150 @@
+"""Monitoring: rate windows, Prometheus exposition, HTTP endpoint,
+interference vote + strategy switch.
+
+Reference coverage analog: the monitor test in CI (ci.yaml runs the Go
+monitor test with a 10ms period) and the adaptation tests.
+"""
+import time
+import urllib.request
+
+import jax.numpy as jnp
+import numpy as np
+
+from kungfu_tpu.monitor import (
+    Counters,
+    InterferenceDetector,
+    MonitorServer,
+    RateWindow,
+)
+from kungfu_tpu.plan import Strategy, make_mesh
+from kungfu_tpu.session import Session
+
+
+def test_rate_window():
+    w = RateWindow(window_s=10.0)
+    t0 = 100.0
+    w.add(1000, t=t0)
+    w.add(1000, t=t0 + 1.0)
+    assert w.total == 2000
+    assert w.rate(now=t0 + 1.0) == 1000.0  # 1000 bytes over 1 s window delta
+    # samples age out of the window
+    assert w.rate(now=t0 + 100.0) == 0.0
+
+
+def test_counters_and_prometheus_text():
+    c = Counters()
+    c.add_egress("peerA", 512)
+    c.add_ingress("peerA", 256)
+    c.add_egress("peerB", 1)
+    text = c.prometheus_text()
+    assert 'egress_total_bytes{peer="peerA"} 512' in text
+    assert 'ingress_total_bytes{peer="peerA"} 256' in text
+    assert 'egress_total_bytes{peer="peerB"} 1' in text
+    assert "egress_rate_bytes_per_sec" in text
+    etot, itot = c.totals()
+    assert etot == {"peerA": 512, "peerB": 1}
+
+
+def test_monitor_http_endpoint():
+    c = Counters()
+    c.add_egress("x", 42)
+    srv = MonitorServer(counters=c, host="127.0.0.1", port=0).start()
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics", timeout=5
+        ).read().decode()
+        assert 'egress_total_bytes{peer="x"} 42' in body
+        # 404 on unknown path
+        try:
+            urllib.request.urlopen(f"http://127.0.0.1:{srv.port}/bogus", timeout=5)
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        srv.close()
+
+
+def test_session_records_egress():
+    from kungfu_tpu.monitor.counters import global_counters
+
+    sess = Session(make_mesh(dp=-1))
+    x = jnp.ones((sess.size, 4), jnp.float32)
+    sess.all_reduce(x, name="egress-probe")
+    etot, _ = global_counters().totals()
+    assert etot.get("egress-probe", 0) == x.nbytes
+
+
+class _FakeSession:
+    """Deterministic throughput playback for the vote logic."""
+
+    def __init__(self, real: Session):
+        self._real = real
+        self.strategy = Strategy.BINARY_TREE_STAR
+        self.size = real.size
+        self.stats = real.stats
+        self._tput = 100.0
+
+    def throughput(self):
+        return self._tput
+
+    def all_reduce(self, x, name=""):
+        return self._real.all_reduce(x, name=name)
+
+    def set_strategy(self, s):
+        self.strategy = s
+
+
+def test_interference_vote_switches_strategy():
+    real = Session(make_mesh(dp=-1))
+    fake = _FakeSession(real)
+    det = InterferenceDetector(fake, min_samples=2)
+    for _ in range(3):
+        det.observe()  # builds reference at 100.0
+    assert not det.local_vote()
+    fake._tput = 50.0  # below 0.8 * 100
+    assert det.local_vote()
+    # all 8 virtual peers vote identically -> majority -> switch
+    old = fake.strategy
+    assert det.check()
+    assert fake.strategy != old
+
+
+def test_interference_no_switch_when_healthy():
+    real = Session(make_mesh(dp=-1))
+    fake = _FakeSession(real)
+    det = InterferenceDetector(fake, min_samples=2)
+    for _ in range(3):
+        det.observe()
+    old = fake.strategy
+    assert not det.check()
+    assert fake.strategy == old
+
+
+def test_trace_scope_and_events(monkeypatch):
+    import logging
+    from kungfu_tpu.utils import trace_scope, log_event
+
+    records = []
+
+    class Sink(logging.Handler):
+        def emit(self, r):
+            records.append(r.getMessage())
+
+    sink = Sink()
+    logger = logging.getLogger("kungfu.trace")
+    logger.addHandler(sink)
+    try:
+        # disabled: no output
+        monkeypatch.delenv("KFT_CONFIG_ENABLE_TRACE", raising=False)
+        with trace_scope("quiet"):
+            pass
+        assert records == []
+        monkeypatch.setenv("KFT_CONFIG_ENABLE_TRACE", "1")
+        with trace_scope("noisy"):
+            time.sleep(0.01)
+        log_event("checkpoint-done")
+    finally:
+        logger.removeHandler(sink)
+    text = "\n".join(records)
+    assert "noisy took" in text
+    assert "checkpoint-done" in text

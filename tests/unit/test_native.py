@@ -111,3 +111,37 @@ def test_loader_reshard():
     with pytest.raises(ValueError):
         ld.reshard(4, 4)
     ld.close()
+
+
+def test_loader_rejects_bad_shard_at_construction():
+    with pytest.raises(ValueError):
+        _make(n=16, batch=4, shard_rank=4, shard_size=4)
+    with pytest.raises(ValueError):
+        _make(n=16, batch=4, shard_rank=-1, shard_size=2)
+
+
+def test_transform2_unknown_op_is_value_error():
+    y = np.ones(4, np.float32)
+    with pytest.raises(ValueError):
+        native.transform2(y, y.copy(), "avg")
+
+
+def test_loader_reshard_discards_prefetched_batches():
+    """After reshard, every delivered batch must reflect the new shard."""
+    n = 64
+    ld = _make(n=n, batch=4, seed=2, shard_rank=0, shard_size=2, queue_cap=8)
+    next(ld)  # let prefetch fill with old-shard batches
+    ld.reshard(1, 2)
+    # rank-1 shard of epoch 0: strided slice of the same permutation
+    from kungfu_tpu.native import _shuffled_perm
+
+    perm = _shuffled_perm(2, 0, n)
+    allowed = set(perm[1::2].tolist())
+    spe = ld.steps_per_epoch
+    seen = set()
+    # consume remaining epoch-0-mapped batches (seq continues from 1)
+    for _ in range(spe - 1):
+        _, l = next(ld)
+        seen.update(int(x) for x in l)
+    assert seen <= allowed, f"stale old-shard samples delivered: {seen - allowed}"
+    ld.close()

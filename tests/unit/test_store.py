@@ -154,3 +154,12 @@ def test_host_pair_averaging_two_peers():
         c.close()
     for s in servers:
         s.close()
+
+
+def test_blob_scalar_and_raw_roundtrip():
+    # 0-d scalars keep their rank (regression: `if self.shape` dropped ())
+    s = Blob.unpack(Blob.from_array(np.array(3.5, np.float64)).pack()).to_array()
+    assert s.shape == () and float(s) == 3.5
+    # raw flat blobs stay flat
+    r = Blob.unpack(Blob(b"\x01\x02\x03").pack()).to_array()
+    assert r.shape == (3,)
