@@ -26,6 +26,8 @@ def main():
     ap.add_argument("--lr", type=float, default=0.1)
     ap.add_argument("--schedule", default="", help="size:steps,... resize schedule")
     ap.add_argument("--check-every", type=int, default=2)
+    ap.add_argument("--checkpoint-dir", default="", help="durable resume dir")
+    ap.add_argument("--checkpoint-every", type=int, default=20)
     args = ap.parse_args()
 
     def make_loss():
@@ -77,6 +79,8 @@ def main():
             batch_size=args.batch_size,
             schedule=args.schedule,
             check_every=args.check_every,
+            checkpoint_dir=args.checkpoint_dir,
+            checkpoint_every=args.checkpoint_every,
         ),
     )
     print(

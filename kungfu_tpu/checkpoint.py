@@ -1,0 +1,124 @@
+"""Checkpoint/resume — first-class durable training state.
+
+The reference has *no real checkpoint subsystem* (SURVEY.md §5): elastic
+resizes keep state alive only in memory (broadcast from survivors), and
+state dies if old∩new membership is empty.  The TPU build closes that gap
+with an orbax-backed manager: asynchronous saves (training continues while
+the previous step's state flushes), retention policies, and a restore path
+that works across cluster-size changes — parameters are replicated over the
+data axis, so any membership can restore any checkpoint, including the
+disjoint-membership case the reference warns about (peer.go:214-218).
+
+Metadata (step, trained samples, cluster size at save time) rides alongside
+the pytree so the elastic trainer can resume its sample-offset accounting
+exactly where it stopped — the durable analog of the reference's
+allreduce-max of trained-sample counters (experimental/hook/elastic.py:
+76-86).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional, Tuple
+
+from .utils import get_logger, trace_scope
+
+log = get_logger("kungfu.checkpoint")
+
+
+class CheckpointManager:
+    """Async orbax checkpointing of (train_state, metadata).
+
+    Only rank 0 (the process holding addressable replicas of the fully-
+    replicated state) should call `save` in multi-process runs — pass
+    `is_primary=False` elsewhere and save() becomes a no-op barrier-free
+    stub.  Restore is valid on every process.
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        max_to_keep: int = 3,
+        save_interval_steps: int = 1,
+        is_primary: bool = True,
+        async_save: bool = True,
+    ):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.directory = os.path.abspath(directory)
+        self.is_primary = is_primary
+        os.makedirs(self.directory, exist_ok=True)
+        opts = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            save_interval_steps=save_interval_steps,
+            enable_async_checkpointing=async_save,
+        )
+        self._mgr = ocp.CheckpointManager(self.directory, options=opts)
+
+    # -- write path -------------------------------------------------------------------
+
+    def save(self, step: int, state: Any, meta: Optional[Dict[str, Any]] = None,
+             force: bool = False) -> bool:
+        """Queue an async save; returns True if a save was accepted."""
+        if not self.is_primary:
+            return False
+        ocp = self._ocp
+        import jax
+
+        # device arrays -> host before handing to the async writer so the
+        # training loop can immediately donate/overwrite its buffers
+        host_state = jax.tree.map(lambda x: jax.device_get(x), state)
+        args = ocp.args.Composite(
+            state=ocp.args.StandardSave(host_state),
+            meta=ocp.args.JsonSave(dict(meta or {})),
+        )
+        with trace_scope(f"checkpoint-save-{step}"):
+            accepted = self._mgr.save(step, args=args, force=force)
+        if accepted:
+            log.info("checkpoint step %d queued to %s", step, self.directory)
+        return bool(accepted)
+
+    def wait(self) -> None:
+        """Block until queued async saves are durable."""
+        self._mgr.wait_until_finished()
+
+    # -- read path --------------------------------------------------------------------
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(self, step: Optional[int] = None,
+                like: Any = None) -> Tuple[Any, Dict[str, Any]]:
+        """Restore (state, meta); `like` is an abstract/concrete pytree
+        template used to re-place arrays (pass your freshly-initialized
+        state to restore onto the current topology)."""
+        ocp = self._ocp
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        if like is not None:
+            import jax
+
+            abstract = jax.tree.map(
+                lambda x: ocp.utils.to_shape_dtype_struct(x), like
+            )
+            args = ocp.args.Composite(
+                state=ocp.args.StandardRestore(abstract),
+                meta=ocp.args.JsonRestore(),
+            )
+        else:
+            args = ocp.args.Composite(
+                state=ocp.args.StandardRestore(),
+                meta=ocp.args.JsonRestore(),
+            )
+        with trace_scope(f"checkpoint-restore-{step}"):
+            out = self._mgr.restore(step, args=args)
+        log.info("restored checkpoint step %d from %s", step, self.directory)
+        return out["state"], dict(out["meta"] or {})
+
+    def all_steps(self):
+        return sorted(self._mgr.all_steps())
+
+    def close(self) -> None:
+        self.wait()
+        self._mgr.close()

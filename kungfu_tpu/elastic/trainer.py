@@ -48,6 +48,12 @@ class ElasticConfig:
     check_every: int = 5  # steps between config polls (resize latency knob)
     per_replica: bool = False
     consensus_timeout_s: float = 60.0
+    # durable checkpointing (SURVEY §5: the gap the reference leaves open).
+    # With a dir set, rank 0 saves every checkpoint_every steps and training
+    # resumes from the latest checkpoint on restart — state now survives
+    # even the disjoint-membership resize the reference only warns about.
+    checkpoint_dir: str = ""
+    checkpoint_every: int = 50
 
 
 class _MeshPrograms:
@@ -237,6 +243,28 @@ def run_elastic(
 
     step = 0  # monotonic optimizer-step count (survives resizes via sync)
 
+    ckpt = None
+    if cfg.checkpoint_dir:
+        from ..checkpoint import CheckpointManager
+
+        # save_interval_steps=1: the loop's modulo gate is the only cadence
+        # (orbax's own interval gate would silently skip the first
+        # post-resume save when the final forced step isn't a multiple)
+        ckpt = CheckpointManager(
+            cfg.checkpoint_dir,
+            save_interval_steps=1,
+            is_primary=peer.rank == 0,
+        )
+        if ckpt.latest_step() is not None:
+            # durable resume: load on every process, then the initial sync
+            # below re-establishes bit-identical state across the cluster
+            sp0, so0 = snap(state)
+            restored, meta = ckpt.restore(like={"params": sp0, "opt": so0})
+            offset = int(meta.get("trained_samples", 0))
+            step = int(meta.get("step", 0))
+            state = trainer.place_state(restored["params"], restored["opt"], step)
+            log.info("resumed from checkpoint: step %d, %d samples", step, offset)
+
     # initial sync: identical at version 0, but a worker joining an already-
     # running cluster (spawned at version N) gets real state here
     sp, so = snap(state)
@@ -283,11 +311,21 @@ def run_elastic(
                     cluster = last_got["cluster"]
                     log.info("resizing to version %d: %d workers", version, cluster.size())
                     snap_params, snap_opt = snap(state)
+                    if ckpt is not None:
+                        # flush queued async saves before membership changes:
+                        # a detaching primary must not abandon them
+                        ckpt.wait()
                     _teardown_backend()
                     if not peer.update_cluster(cluster, version):
                         print(f"DETACHED: rank left cluster at version {version}", flush=True)
+                        if ckpt is not None:
+                            ckpt.close()
                         sys.exit(0)
                     trainer, programs = build()
+                    if ckpt is not None:
+                        # primariness follows the POST-resize rank: the new
+                        # rank 0 takes over saving even if the old one left
+                        ckpt.is_primary = peer.rank == 0
                     (offset, step), synced = programs.sync_state(
                         (offset, step), {"params": snap_params, "opt": snap_opt}
                     )
@@ -302,6 +340,20 @@ def run_elastic(
         state, metrics = trainer.train_step(state, batch)
         offset += cfg.batch_size * trainer.world
         step += 1
+
+        if ckpt is not None and step % max(1, cfg.checkpoint_every) == 0:
+            sp_c, so_c = snap(state)
+            ckpt.save(step, {"params": sp_c, "opt": so_c},
+                      meta={"trained_samples": offset, "step": step,
+                            "cluster_size": peer.size})
+
+    if ckpt is not None:
+        if ckpt.latest_step() != step:  # avoid double-save when the loop just did
+            sp_c, so_c = snap(state)
+            ckpt.save(step, {"params": sp_c, "opt": so_c},
+                      meta={"trained_samples": offset, "step": step,
+                            "cluster_size": peer.size}, force=True)
+        ckpt.close()
 
     loss = float(np.asarray(metrics["loss"]))
     dt = time.time() - t_start

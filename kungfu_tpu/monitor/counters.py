@@ -30,7 +30,9 @@ class RateWindow:
         self._trim(t)
 
     def _trim(self, now: float) -> None:
-        while self._samples and now - self._samples[0][0] > self.window_s:
+        # keep one sample older than the window as the delta anchor:
+        # traffic slower than one add per window must not read as 0 B/s
+        while len(self._samples) >= 2 and now - self._samples[1][0] > self.window_s:
             self._samples.popleft()
 
     @property

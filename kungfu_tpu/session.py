@@ -43,9 +43,13 @@ log = get_logger("kungfu.session")
 
 
 def _counters():
+    """Global byte counters, or None when monitoring is off — the hot path
+    must not pay lock+deque overhead nobody reads (gate mirrors the
+    reference's KUNGFU_CONFIG_ENABLE_MONITORING, peer.go:92-99)."""
+    from .monitor.server import enabled
     from .monitor.counters import global_counters
 
-    return global_counters()
+    return global_counters() if enabled() else None
 
 
 class OpStats:
@@ -190,7 +194,9 @@ class Session:
             out = fn(x)
             out.block_until_ready()
         self.stats.record(name or kind, x.nbytes, time.perf_counter() - t0)
-        _counters().add_egress(name or kind, x.nbytes)
+        c = _counters()
+        if c is not None:
+            c.add_egress(name or kind, x.nbytes)
         return out
 
     def all_reduce(self, x, op: str = "sum", name: str = "", strategy=None):

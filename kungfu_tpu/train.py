@@ -156,7 +156,12 @@ class DataParallelTrainer:
         """Build TrainState; in per_replica mode, replicas start identical
         (the BroadcastGlobalVariables-at-init semantics,
         reference initializer/__init__.py:13-99)."""
-        opt_state = self.tx.init(params)
+        return self.place_state(params, self.tx.init(params))
+
+    def place_state(self, params: Any, opt_state: Any, step: int = 0) -> TrainState:
+        """Place host (params, opt_state) onto the mesh as a TrainState —
+        also the checkpoint-restore path (single-replica snapshots are
+        re-broadcast in per_replica mode)."""
         if self.per_replica:
             n = self.world
 
@@ -177,7 +182,7 @@ class DataParallelTrainer:
 
         params = jax.tree.map(place, params)
         opt_state = jax.tree.map(place, opt_state)
-        return TrainState(params=params, opt_state=opt_state, step=0)
+        return TrainState(params=params, opt_state=opt_state, step=step)
 
     def shard_batch(self, batch: Any) -> Any:
         """Place a batch sharded over the data axis.

@@ -56,3 +56,35 @@ class TestElasticE2E:
         for line in results:
             assert "resizes=2" in line, line
             assert "trained=4480" in line, line
+
+
+@pytest.mark.slow
+class TestCheckpointResume:
+    def test_kill_and_resume(self, tmp_path):
+        """Train, stop, relaunch with the same checkpoint dir: the run must
+        resume from the saved offset, not restart (durable elasticity —
+        the capability SURVEY.md §5 says the reference lacks)."""
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env.pop("XLA_FLAGS", None)
+        env.pop("JAX_PLATFORMS", None)
+        ckpt = str(tmp_path / "ckpt")
+
+        def launch(total):
+            return subprocess.run(
+                [sys.executable, "-m", "kungfu_tpu.run", "-np", "1",
+                 "-platform", "cpu", "--", sys.executable,
+                 "examples/elastic_mnist.py", "--total-samples", str(total),
+                 "--checkpoint-dir", ckpt, "--checkpoint-every", "5"],
+                capture_output=True, text=True, timeout=300, env=env, cwd=REPO,
+            )
+
+        r1 = launch(640)
+        assert r1.returncode == 0, r1.stdout[-3000:] + r1.stderr[-2000:]
+        assert "trained=640" in r1.stdout
+
+        r2 = launch(1280)
+        assert r2.returncode == 0, r2.stdout[-3000:] + r2.stderr[-2000:]
+        # resumed at 640, so the second run reports the cumulative total
+        assert "resumed from checkpoint" in (r2.stdout + r2.stderr), r2.stdout[-2000:]
+        assert "trained=1280" in r2.stdout

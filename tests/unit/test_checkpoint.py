@@ -1,0 +1,74 @@
+"""Checkpoint manager: async save/restore, retention, elastic resume meta.
+
+The reference has no checkpoint subsystem to mirror; these tests cover the
+contract SURVEY.md §5 says the TPU build must add (durable elastic handoff).
+"""
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from kungfu_tpu.checkpoint import CheckpointManager
+
+
+def _state(scale: float):
+    params = {"w": jnp.full((4, 3), scale, jnp.float32), "b": jnp.zeros((3,), jnp.float32)}
+    opt = optax.sgd(0.1, momentum=0.9).init(params)
+    return {"params": params, "opt": opt, "step": jnp.asarray(7, jnp.int32)}
+
+
+def test_save_restore_roundtrip(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    st = _state(2.5)
+    assert mgr.save(0, st, meta={"trained_samples": 1024, "cluster_size": 8})
+    mgr.wait()
+    got, meta = mgr.restore(like=_state(0.0))
+    np.testing.assert_allclose(np.asarray(got["params"]["w"]), 2.5)
+    assert int(got["step"]) == 7
+    assert meta == {"trained_samples": 1024, "cluster_size": 8}
+    mgr.close()
+
+
+def test_latest_and_retention(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=2)
+    for s in (0, 1, 2, 3):
+        assert mgr.save(s, _state(float(s)), meta={"s": s})
+    mgr.wait()
+    assert mgr.latest_step() == 3
+    assert mgr.all_steps() == [2, 3]  # retention pruned 0 and 1
+    got, meta = mgr.restore(step=2, like=_state(0.0))
+    np.testing.assert_allclose(np.asarray(got["params"]["w"]), 2.0)
+    mgr.close()
+
+
+def test_restore_without_template(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    mgr.save(5, _state(1.0), meta={})
+    mgr.wait()
+    got, _ = mgr.restore()
+    np.testing.assert_allclose(np.asarray(got["params"]["b"]), 0.0)
+    mgr.close()
+
+
+def test_non_primary_save_is_noop(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), is_primary=False)
+    assert not mgr.save(0, _state(1.0))
+    assert mgr.latest_step() is None
+    mgr.close()
+
+
+def test_restore_empty_raises(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    with pytest.raises(FileNotFoundError):
+        mgr.restore()
+    mgr.close()
+
+
+def test_save_interval_skips(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), save_interval_steps=10)
+    assert mgr.save(0, _state(0.0))
+    assert not mgr.save(3, _state(0.0))   # within interval -> skipped
+    assert mgr.save(10, _state(1.0))
+    mgr.wait()
+    assert mgr.all_steps() == [0, 10]
+    mgr.close()

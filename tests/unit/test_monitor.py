@@ -5,6 +5,7 @@ Reference coverage analog: the monitor test in CI (ci.yaml runs the Go
 monitor test with a 10ms period) and the adaptation tests.
 """
 import time
+import pytest
 import urllib.request
 
 import jax.numpy as jnp
@@ -64,14 +65,25 @@ def test_monitor_http_endpoint():
         srv.close()
 
 
-def test_session_records_egress():
+def test_session_records_egress(monkeypatch):
     from kungfu_tpu.monitor.counters import global_counters
 
+    monkeypatch.setenv("KFT_CONFIG_ENABLE_MONITORING", "1")
     sess = Session(make_mesh(dp=-1))
     x = jnp.ones((sess.size, 4), jnp.float32)
     sess.all_reduce(x, name="egress-probe")
     etot, _ = global_counters().totals()
     assert etot.get("egress-probe", 0) == x.nbytes
+
+
+def test_session_skips_counters_when_disabled(monkeypatch):
+    from kungfu_tpu.monitor.counters import global_counters
+
+    monkeypatch.delenv("KFT_CONFIG_ENABLE_MONITORING", raising=False)
+    sess = Session(make_mesh(dp=-1))
+    sess.all_reduce(jnp.ones((sess.size, 4), jnp.float32), name="silent-probe")
+    etot, _ = global_counters().totals()
+    assert "silent-probe" not in etot
 
 
 class _FakeSession:
@@ -148,3 +160,12 @@ def test_trace_scope_and_events(monkeypatch):
     text = "\n".join(records)
     assert "noisy took" in text
     assert "checkpoint-done" in text
+
+
+def test_rate_window_slow_traffic_not_zero():
+    """One add per >window interval must still report a real rate
+    (regression: single-in-window sample returned 0)."""
+    w = RateWindow(window_s=5.0)
+    w.add(1000, t=0.0)
+    w.add(1000, t=10.0)  # slower than the window
+    assert w.rate(now=10.0) == pytest.approx(100.0)  # 1000 B / 10 s
