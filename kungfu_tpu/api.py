@@ -83,10 +83,28 @@ def log_stats() -> None:
     get_logger("kungfu.stats").info("throughput stats: %s", calc_stats())
 
 
-def egress_rates() -> dict:
-    """Windowed egress byte rates per op (reference EgressRates op)."""
-    from .monitor import global_counters
+_warned_monitoring_off = False
 
+
+def egress_rates() -> dict:
+    """Windowed egress byte rates per op (reference EgressRates op).
+
+    Populated only when KFT_CONFIG_ENABLE_MONITORING is set (the reference's
+    KUNGFU_CONFIG_ENABLE_MONITORING gate, peer.go:92-99); warns once instead
+    of silently returning nothing when it isn't."""
+    from .monitor import global_counters
+    from .monitor.server import enabled
+
+    global _warned_monitoring_off
+    if not enabled() and not _warned_monitoring_off:
+        _warned_monitoring_off = True
+        from .utils import get_logger
+
+        get_logger("kungfu.monitor").warning(
+            "egress_rates(): monitoring is disabled; set "
+            "KFT_CONFIG_ENABLE_MONITORING=1 before creating the Session "
+            "to record byte rates"
+        )
     return global_counters().egress_rates()
 
 

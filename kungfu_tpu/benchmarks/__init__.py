@@ -1,0 +1,153 @@
+"""All-reduce / p2p microbenchmarks over fake-model gradient lists.
+
+TPU re-design of the reference benchmark harness
+(srcs/python/kungfu/tensorflow/v1/benchmarks/__main__.py:1-188): the
+reference sweeps allreduce *methods* (CPU | NCCL | NCCL+CPU | HOROVOD) over
+synthetic per-tensor gradient lists for ResNet50/VGG16/BERT and prints
+``RESULT:`` lines with achieved rates.  Here the methods are XLA collective
+*strategies* (psum | ring | rs_ag | hierarchical), run over the session mesh
+— real ICI on TPU, virtual devices on CPU — and the same fake-model lists
+come from :mod:`kungfu_tpu.models.fakemodel`.
+
+Reported numbers:
+  * ``data`` GiB/s — payload bytes / wall time (the reference's rate).
+  * ``busbw`` GiB/s — algorithmic bus bandwidth, data × 2(n-1)/n, the
+    standard cross-framework comparison figure for allreduce.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..models import fakemodel
+from ..plan import Strategy
+from ..session import Session
+
+GiB = float(1 << 30)
+
+#: strategy sweep exposed as benchmark "methods" (reference --method flag)
+METHODS: Dict[str, Strategy] = {
+    "auto": Strategy.AUTO,
+    "psum": Strategy.STAR,          # single-pass XLA all-reduce
+    "ring": Strategy.RING,          # explicit ppermute ring
+    "rs_ag": Strategy.CLIQUE,       # reduce_scatter + all_gather phases
+    "hierarchical": Strategy.BINARY_TREE_STAR,  # ici-then-dcn two-level
+}
+
+
+@dataclass
+class BenchResult:
+    model: str
+    method: str
+    fuse: bool
+    steps: int
+    payload_bytes: int
+    seconds_per_step: float
+
+    @property
+    def data_gibps(self) -> float:
+        return self.payload_bytes / self.seconds_per_step / GiB
+
+    def busbw_gibps(self, n: int) -> float:
+        return self.data_gibps * (2.0 * (n - 1) / n if n > 1 else 1.0)
+
+    def line(self, n: int) -> str:
+        # RESULT: prefix mirrors the reference's grep-able output contract
+        # (benchmarks/__main__.py:112-120).
+        return (
+            f"RESULT: model={self.model} method={self.method} fuse={int(self.fuse)} "
+            f"np={n} payload={self.payload_bytes} B "
+            f"step={self.seconds_per_step * 1e3:.3f} ms "
+            f"data={self.data_gibps:.3f} GiB/s busbw={self.busbw_gibps(n):.3f} GiB/s"
+        )
+
+
+def _payloads(model: str, fuse: bool, size: int, dtype=np.float32) -> List[jnp.ndarray]:
+    sizes = fakemodel.get_sizes(model)
+    if fuse:
+        sizes = [sum(sizes)]
+    rng = np.random.RandomState(0)
+    # per-peer tensors stacked on dim 0 (Session value convention); broadcast a
+    # single row — identical payload per peer costs one host buffer, not `size`
+    return [
+        jnp.asarray(np.broadcast_to(rng.randn(1, s).astype(dtype), (size, s)))
+        for s in sizes
+    ]
+
+
+def bench_all_reduce(
+    session: Session,
+    model: str = "resnet50-imagenet",
+    method: str = "auto",
+    fuse: bool = True,
+    steps: int = 10,
+    warmup: int = 2,
+    dtype=np.float32,
+) -> BenchResult:
+    """Time `steps` group-all-reduces of the model's gradient list."""
+    if method not in METHODS:
+        raise ValueError(f"unknown method {method!r}; one of {sorted(METHODS)}")
+    strategy = METHODS[method]
+    xs = _payloads(model, fuse, session.size, dtype)
+    payload = sum(int(x.nbytes) // session.size for x in xs)
+
+    def one_step():
+        outs = [
+            session.all_reduce(x, name=f"bench/{model}/{i}", strategy=strategy)
+            for i, x in enumerate(xs)
+        ]
+        outs[-1].block_until_ready()
+
+    for _ in range(warmup):
+        one_step()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        one_step()
+    dt = (time.perf_counter() - t0) / steps
+    return BenchResult(model, method, fuse, steps, payload, dt)
+
+
+def bench_p2p(
+    store_size: int = 1 << 20,
+    steps: int = 50,
+    versioned: bool = True,
+) -> float:
+    """Save/request round-trips through the blob store (kungfu-bench-p2p
+    analog, tests/go/cmd/kungfu-bench-p2p).  Returns GiB/s."""
+    from ..store import VersionedStore, Store, Blob
+
+    arr = np.random.RandomState(0).randint(0, 255, store_size, dtype=np.uint8)
+    store = VersionedStore() if versioned else Store()
+    t0 = time.perf_counter()
+    for i in range(steps):
+        blob = Blob.from_array(arr)
+        if versioned:
+            store.save(str(i), "bench", blob)
+            out = store.get(str(i), "bench")
+        else:
+            store.save("bench", blob)
+            out = store.get("bench")
+        assert out is not None
+    dt = time.perf_counter() - t0
+    return 2 * store_size * steps / dt / GiB
+
+
+def run_sweep(
+    session: Session,
+    models: Sequence[str] = ("resnet50-imagenet",),
+    methods: Sequence[str] = ("auto",),
+    fuse: bool = True,
+    steps: int = 10,
+    warmup: int = 2,
+) -> List[BenchResult]:
+    results = []
+    for m in models:
+        for meth in methods:
+            r = bench_all_reduce(session, m, meth, fuse=fuse, steps=steps, warmup=warmup)
+            print(r.line(session.size), flush=True)
+            results.append(r)
+    return results

@@ -1,0 +1,56 @@
+"""``python -m kungfu_tpu.benchmarks`` — allreduce/p2p microbench CLI.
+
+Reference: ``python -m kungfu.tensorflow.v1.benchmarks --method CPU|NCCL|...``
+(srcs/python/kungfu/tensorflow/v1/benchmarks/__main__.py).  The --method sweep
+here selects XLA collective strategies instead of comm backends.
+
+Examples::
+
+    python -m kungfu_tpu.benchmarks --model resnet50-imagenet --method auto
+    python -m kungfu_tpu.benchmarks --model bert-base --method psum,ring,rs_ag
+    python -m kungfu_tpu.benchmarks --bench p2p
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="kungfu_tpu.benchmarks")
+    p.add_argument("--bench", default="all_reduce", choices=["all_reduce", "p2p"])
+    p.add_argument("--model", default="resnet50-imagenet",
+                   help="comma-separated fake models (see models.fakemodel.REGISTRY)")
+    p.add_argument("--method", default="auto",
+                   help="comma-separated: auto,psum,ring,rs_ag,hierarchical")
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--warmup", type=int, default=2)
+    p.add_argument("--no-fuse", action="store_true",
+                   help="allreduce each gradient tensor separately (default fuses)")
+    p.add_argument("--p2p-size", type=int, default=1 << 20)
+    args = p.parse_args(argv)
+
+    if args.bench == "p2p":
+        from . import bench_p2p
+
+        rate = bench_p2p(store_size=args.p2p_size, steps=args.steps or 50)
+        print(f"RESULT: bench=p2p payload={args.p2p_size} B rate={rate:.3f} GiB/s", flush=True)
+        return 0
+
+    from . import run_sweep
+    from ..session import Session
+
+    session = Session()
+    run_sweep(
+        session,
+        models=[m for m in args.model.split(",") if m],
+        methods=[m for m in args.method.split(",") if m],
+        fuse=not args.no_fuse,
+        steps=args.steps,
+        warmup=args.warmup,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

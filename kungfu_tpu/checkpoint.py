@@ -14,6 +14,20 @@ the pytree so the elastic trainer can resume its sample-offset accounting
 exactly where it stopped — the durable analog of the reference's
 allreduce-max of trained-sample counters (experimental/hook/elastic.py:
 76-86).
+
+Elastic-safety design.  orbax's CheckpointManager inserts cross-host
+barriers inside ``__init__``/``save``/``close`` when ``jax.process_count()
+> 1`` — under an elastic cluster whose membership and runtime are rebuilt
+mid-training, globally-matched barriers are exactly what we cannot promise
+(a joiner constructing its manager would rendezvous against survivors who
+never re-construct theirs).  So the write path is **primary-only** and the
+manager is pinned to a single-member barrier group
+(``MultiprocessingOptions(active_processes={self})``) — its barriers involve
+only this process, regardless of cluster changes.  The read path
+(``latest_step``/``restore``) is barrier-free for every process: it lists
+finalized step directories and restores with plain Checkpointers.  Across a
+resize the primary must ``release()`` the manager before the distributed
+runtime is torn down and re-acquire with ``set_primary`` after re-init.
 """
 from __future__ import annotations
 
@@ -25,13 +39,30 @@ from .utils import get_logger, trace_scope
 log = get_logger("kungfu.checkpoint")
 
 
+def reset_orbax_runtime_caches() -> None:
+    """Drop orbax state bound to a (possibly dead) jax.distributed runtime.
+
+    orbax lru-caches its signaling client around the coordination-service KV
+    store on first async save; after an elastic resize re-initializes
+    jax.distributed, the cached client still points at the old coordinator
+    and every subsequent async save dies with 'failed to connect'.  Call
+    this whenever the distributed runtime is torn down.  (Private orbax
+    surface — gated so an orbax upgrade degrades to a no-op.)
+    """
+    try:  # pragma: no cover - exercised via elastic integration tests
+        from orbax.checkpoint._src.futures import signaling_client
+
+        signaling_client.get_signaling_client.cache_clear()
+    except Exception:  # noqa: BLE001
+        pass
+
+
 class CheckpointManager:
     """Async orbax checkpointing of (train_state, metadata).
 
-    Only rank 0 (the process holding addressable replicas of the fully-
-    replicated state) should call `save` in multi-process runs — pass
-    `is_primary=False` elsewhere and save() becomes a no-op barrier-free
-    stub.  Restore is valid on every process.
+    Pass ``is_primary=(rank == 0)``: only the primary owns an orbax manager
+    and writes; everyone may restore.  State is expected fully replicated
+    over the data axis, so one writer loses nothing.
     """
 
     def __init__(
@@ -47,20 +78,53 @@ class CheckpointManager:
         self._ocp = ocp
         self.directory = os.path.abspath(directory)
         self.is_primary = is_primary
+        self._max_to_keep = max_to_keep
+        self._save_interval_steps = save_interval_steps
+        self._async_save = async_save
         os.makedirs(self.directory, exist_ok=True)
-        opts = ocp.CheckpointManagerOptions(
-            max_to_keep=max_to_keep,
-            save_interval_steps=save_interval_steps,
-            enable_async_checkpointing=async_save,
+        self._mgr = self._make_manager() if is_primary else None
+
+    def _mp_options(self, tag: str):
+        """Single-member barrier group: orbax's internal syncs must never
+        wait on other processes — elastic membership cannot guarantee
+        globally-matched barrier sequences."""
+        import jax
+
+        ocp = self._ocp
+        if jax.process_count() <= 1:
+            return ocp.options.MultiprocessingOptions()
+        me = jax.process_index()
+        return ocp.options.MultiprocessingOptions(
+            primary_host=me,
+            active_processes={me},
+            barrier_sync_key_prefix=f"kungfu-{tag}-{me}",
         )
-        self._mgr = ocp.CheckpointManager(self.directory, options=opts)
+
+    def _make_manager(self):
+        ocp = self._ocp
+        mp = self._mp_options("ckpt")
+        opts = ocp.CheckpointManagerOptions(
+            max_to_keep=self._max_to_keep,
+            save_interval_steps=self._save_interval_steps,
+            enable_async_checkpointing=self._async_save,
+            multiprocessing_options=mp,
+            create=False,  # we makedirs ourselves; orbax forbids create=True
+            # with a restricted active_processes barrier group
+        )
+        return ocp.CheckpointManager(self.directory, options=opts)
 
     # -- write path -------------------------------------------------------------------
+
+    @property
+    def writes(self) -> bool:
+        """True when save() on this process hands state to orbax (callers can
+        skip snapshotting device state when this is False)."""
+        return self._mgr is not None
 
     def save(self, step: int, state: Any, meta: Optional[Dict[str, Any]] = None,
              force: bool = False) -> bool:
         """Queue an async save; returns True if a save was accepted."""
-        if not self.is_primary:
+        if self._mgr is None:
             return False
         ocp = self._ocp
         import jax
@@ -80,45 +144,95 @@ class CheckpointManager:
 
     def wait(self) -> None:
         """Block until queued async saves are durable."""
-        self._mgr.wait_until_finished()
+        if self._mgr is not None:
+            self._mgr.wait_until_finished()
 
-    # -- read path --------------------------------------------------------------------
+    # -- elastic transitions ----------------------------------------------------------
+
+    def release(self) -> None:
+        """Flush and drop the orbax manager.  MUST be called before the
+        distributed runtime backing this process is torn down (resize or
+        detach); pair with `set_primary` after re-init."""
+        if self._mgr is not None:
+            self._mgr.wait_until_finished()
+            self._mgr.close()
+            self._mgr = None
+
+    def set_primary(self, is_primary: bool) -> None:
+        """Adopt post-resize primariness: the new rank 0 takes over writing
+        (re-acquiring a manager bound to the NEW runtime), everyone else
+        drops theirs."""
+        self.is_primary = is_primary
+        if is_primary and self._mgr is None:
+            self._mgr = self._make_manager()
+        elif not is_primary:
+            self.release()
+
+    # -- read path (barrier-free on every process) ------------------------------------
+
+    def all_steps(self):
+        return sorted(self._ocp.utils.checkpoint_steps(self.directory))
 
     def latest_step(self) -> Optional[int]:
-        return self._mgr.latest_step()
+        steps = self.all_steps()
+        return steps[-1] if steps else None
 
     def restore(self, step: Optional[int] = None,
                 like: Any = None) -> Tuple[Any, Dict[str, Any]]:
         """Restore (state, meta); `like` is an abstract/concrete pytree
         template used to re-place arrays (pass your freshly-initialized
-        state to restore onto the current topology)."""
+        state to restore onto the current topology).
+
+        When `step` is omitted, the latest finalized step is read — retrying
+        on a fresher step if the primary's max_to_keep garbage collection
+        deletes the directory mid-read (the barrier-free read path has no
+        pin on the step it is streaming)."""
+        auto = step is None
+        for attempt in range(3):
+            s = self.latest_step() if auto else step
+            if s is None:
+                raise FileNotFoundError(f"no checkpoints under {self.directory}")
+            try:
+                return self._restore_step(s, like)
+            except FileNotFoundError:
+                if not auto or attempt == 2:
+                    raise
+                log.warning(
+                    "checkpoint step %d vanished mid-restore (GC); retrying "
+                    "with the latest step", s,
+                )
+        raise AssertionError("unreachable")
+
+    def _restore_step(self, step: int, like: Any) -> Tuple[Any, Dict[str, Any]]:
         ocp = self._ocp
-        step = self.latest_step() if step is None else step
-        if step is None:
-            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        root = os.path.join(self.directory, str(step))
         if like is not None:
             import jax
 
-            abstract = jax.tree.map(
+            target = jax.tree.map(
                 lambda x: ocp.utils.to_shape_dtype_struct(x), like
             )
-            args = ocp.args.Composite(
-                state=ocp.args.StandardRestore(abstract),
-                meta=ocp.args.JsonRestore(),
-            )
         else:
-            args = ocp.args.Composite(
-                state=ocp.args.StandardRestore(),
-                meta=ocp.args.JsonRestore(),
-            )
+            target = None
         with trace_scope(f"checkpoint-restore-{step}"):
-            out = self._mgr.restore(step, args=args)
+            # read path must be as barrier-free as the write path: a joiner
+            # restores while survivors sit in an unrelated collective
+            with ocp.Checkpointer(
+                ocp.StandardCheckpointHandler(),
+                multiprocessing_options=self._mp_options("read"),
+            ) as ckptr:
+                state = ckptr.restore(
+                    os.path.join(root, "state"),
+                    args=ocp.args.StandardRestore(target),
+                )
+            with ocp.Checkpointer(
+                ocp.JsonCheckpointHandler(),
+                multiprocessing_options=self._mp_options("readmeta"),
+            ) as ckptr:
+                meta = ckptr.restore(os.path.join(root, "meta"),
+                                     args=ocp.args.JsonRestore())
         log.info("restored checkpoint step %d from %s", step, self.directory)
-        return out["state"], dict(out["meta"] or {})
-
-    def all_steps(self):
-        return sorted(self._mgr.all_steps())
+        return state, dict(meta or {})
 
     def close(self) -> None:
-        self.wait()
-        self._mgr.close()
+        self.release()

@@ -192,6 +192,9 @@ def _teardown_backend() -> None:
         log.warning("distributed shutdown: %s", e)
     jax.clear_caches()
     xb._clear_backends()
+    from ..checkpoint import reset_orbax_runtime_caches
+
+    reset_orbax_runtime_caches()
 
 
 def run_elastic(
@@ -312,20 +315,19 @@ def run_elastic(
                     log.info("resizing to version %d: %d workers", version, cluster.size())
                     snap_params, snap_opt = snap(state)
                     if ckpt is not None:
-                        # flush queued async saves before membership changes:
-                        # a detaching primary must not abandon them
-                        ckpt.wait()
+                        # flush queued async saves and drop the orbax manager
+                        # BEFORE the runtime it is bound to is torn down (a
+                        # detaching primary must not abandon queued saves)
+                        ckpt.release()
                     _teardown_backend()
                     if not peer.update_cluster(cluster, version):
                         print(f"DETACHED: rank left cluster at version {version}", flush=True)
-                        if ckpt is not None:
-                            ckpt.close()
                         sys.exit(0)
                     trainer, programs = build()
                     if ckpt is not None:
                         # primariness follows the POST-resize rank: the new
-                        # rank 0 takes over saving even if the old one left
-                        ckpt.is_primary = peer.rank == 0
+                        # rank 0 re-acquires a manager bound to the NEW runtime
+                        ckpt.set_primary(peer.rank == 0)
                     (offset, step), synced = programs.sync_state(
                         (offset, step), {"params": snap_params, "opt": snap_opt}
                     )
@@ -341,14 +343,15 @@ def run_elastic(
         offset += cfg.batch_size * trainer.world
         step += 1
 
-        if ckpt is not None and step % max(1, cfg.checkpoint_every) == 0:
+        if ckpt is not None and ckpt.writes and step % max(1, cfg.checkpoint_every) == 0:
             sp_c, so_c = snap(state)
             ckpt.save(step, {"params": sp_c, "opt": so_c},
                       meta={"trained_samples": offset, "step": step,
                             "cluster_size": peer.size})
 
     if ckpt is not None:
-        if ckpt.latest_step() != step:  # avoid double-save when the loop just did
+        ckpt.wait()  # settle queued async saves; latest_step lists only finalized
+        if ckpt.writes and ckpt.latest_step() != step:  # avoid double-save when the loop just did
             sp_c, so_c = snap(state)
             ckpt.save(step, {"params": sp_c, "opt": so_c},
                       meta={"trained_samples": offset, "step": step,

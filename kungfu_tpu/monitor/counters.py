@@ -47,6 +47,11 @@ class RateWindow:
             return 0.0
         t0, b0 = self._samples[0]
         t1, b1 = self._samples[-1]
+        if len(self._samples) >= 3 and t1 - t0 > self.window_s:
+            # the retained anchor can be arbitrarily old after an idle gap;
+            # measuring from it would average the gap into a resumed burst.
+            # With >=2 in-window samples, measure from the first of those.
+            t0, b0 = self._samples[1]
         if t1 <= t0:
             return 0.0
         return (b1 - b0) / (t1 - t0)

@@ -95,6 +95,9 @@ def main(argv=None):
         chips_per_host=args.chips_per_host,
     )
 
+    from .launcher import install_signal_trap
+
+    install_signal_trap()
     try:
         if args.watch:
             client = ConfigClient(config_url)

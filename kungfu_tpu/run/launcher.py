@@ -27,6 +27,24 @@ log = get_logger("kungfu.run")
 _COLORS = [36, 32, 33, 35, 34, 31]  # cyan green yellow magenta blue red
 
 
+def install_signal_trap() -> None:
+    """Route SIGTERM into the KeyboardInterrupt cleanup paths so a killed
+    launcher (timeout, supervisor, Ctrl-C on a different tty) never orphans
+    its worker processes (reference utils.Trap; watch.go kills procs on
+    job stop).  No-op off the main thread."""
+
+    def _raise(signum, frame):  # noqa: ARG001
+        # one-shot: supervisors re-send SIGTERM; a second conversion would
+        # raise inside the cleanup path and abandon the remaining workers
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        raise KeyboardInterrupt(f"signal {signum}")
+
+    try:
+        signal.signal(signal.SIGTERM, _raise)
+    except ValueError:  # pragma: no cover - non-main thread (tests)
+        pass
+
+
 class ProcRunner:
     """One worker subprocess with output pumping (utils/runner/local/local.go)."""
 
@@ -95,17 +113,19 @@ def simple_run(job: Job, cluster: Cluster, self_host: str, version: int = 0,
     local = [p for p in cluster.workers if p.host == self_host]
     pool = ChipPool(job.chips_per_host) if job.chips_per_host else None
     runners: List[ProcRunner] = []
-    for peer in local:
-        chip = pool.get() if pool else -1
-        proc = job.new_proc(peer, chip if chip is not None else -1, cluster, version)
-        r = ProcRunner(proc, logdir=logdir, quiet=quiet)
-        r.start()
-        runners.append(r)
-    log.info("spawned %d/%d workers on %s", len(local), cluster.size(), self_host)
-
     failed = 0
-    pending = list(runners)
     try:
+        # spawning inside the protected region: a SIGTERM mid-startup must
+        # still terminate the workers already running
+        for peer in local:
+            chip = pool.get() if pool else -1
+            proc = job.new_proc(peer, chip if chip is not None else -1, cluster, version)
+            r = ProcRunner(proc, logdir=logdir, quiet=quiet)
+            r.start()
+            runners.append(r)
+        log.info("spawned %d/%d workers on %s", len(local), cluster.size(), self_host)
+
+        pending = list(runners)
         while pending:
             for r in list(pending):
                 rc = r.popen.poll() if r.popen else None
@@ -177,10 +197,12 @@ class WatchRunner:
         self.version = version
 
     def run(self, initial: Optional[Cluster] = None, timeout_s: float = 0.0) -> int:
-        if initial is not None:
-            self.reconcile(initial, 0)
         t0 = time.monotonic()
         try:
+            # initial spawn inside the protected region: a SIGTERM during
+            # startup must still terminate already-running workers
+            if initial is not None:
+                self.reconcile(initial, 0)
             while True:
                 try:
                     got = self.client.get_cluster()

@@ -45,7 +45,8 @@ log = get_logger("kungfu.session")
 def _counters():
     """Global byte counters, or None when monitoring is off — the hot path
     must not pay lock+deque overhead nobody reads (gate mirrors the
-    reference's KUNGFU_CONFIG_ENABLE_MONITORING, peer.go:92-99)."""
+    reference's KUNGFU_CONFIG_ENABLE_MONITORING, peer.go:92-99).  Evaluated
+    once per Session: the env gate cannot meaningfully change mid-process."""
     from .monitor.server import enabled
     from .monitor.counters import global_counters
 
@@ -103,6 +104,7 @@ class Session:
         self.strategy = strategy
         self.host_count = host_count
         self.stats = OpStats()
+        self._byte_counters = _counters()
         self._fns: Dict[Any, Callable] = {}
         names = self.mesh.axis_names
         self._hierarchical_axes = ("ici", "dcn") if ("ici" in names and "dcn" in names) else None
@@ -194,7 +196,7 @@ class Session:
             out = fn(x)
             out.block_until_ready()
         self.stats.record(name or kind, x.nbytes, time.perf_counter() - t0)
-        c = _counters()
+        c = self._byte_counters
         if c is not None:
             c.add_egress(name or kind, x.nbytes)
         return out

@@ -88,3 +88,81 @@ class TestCheckpointResume:
         # resumed at 640, so the second run reports the cumulative total
         assert "resumed from checkpoint" in (r2.stdout + r2.stderr), r2.stdout[-2000:]
         assert "trained=1280" in r2.stdout
+
+
+@pytest.mark.slow
+class TestElasticCheckpointedResize:
+    def test_resize_with_checkpointing(self, tmp_path):
+        """Watch-mode grow+shrink WITH durable checkpointing on: the joiner
+        restores from the checkpoint written by the pre-resize cluster, and
+        orbax's internal barriers must never entangle with the resize
+        collectives (regression: rank-0-only orbax calls deadlocked the
+        cluster; a stale cached signaling client crashed post-resize saves)."""
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env.pop("XLA_FLAGS", None)
+        env.pop("JAX_PLATFORMS", None)
+        ckpt = str(tmp_path / "ckpt")
+        r = subprocess.run(
+            [sys.executable, "-m", "kungfu_tpu.run", "-w", "-np", "2",
+             "-platform", "cpu", "--", sys.executable, "examples/elastic_mnist.py",
+             "--schedule", "2:10,3:10,2:100", "--total-samples", "3200",
+             "--check-every", "2", "--checkpoint-dir", ckpt,
+             "--checkpoint-every", "5"],
+            capture_output=True, text=True, timeout=420, env=env, cwd=REPO,
+        )
+        out = r.stdout
+        assert r.returncode == 0, out[-3000:] + r.stderr[-2000:]
+        results = [l for l in out.splitlines() if "RESULT:" in l]
+        assert len(results) == 2, out[-3000:]
+        for line in results:
+            assert "trained=3200" in line and "resizes=2" in line, line
+        # the joiner (spawned at version 1) resumed from the durable state
+        assert "resumed from checkpoint" in out, out[-3000:]
+        # retention kept finalized steps only, ending at the final step
+        # (640 + 960 + 1600 samples = 10 + 10 + 25 steps)
+        steps = sorted(int(d) for d in os.listdir(ckpt) if d.isdigit())
+        assert steps and steps[-1] == 45, steps
+
+
+@pytest.mark.slow
+class TestLauncherSignalCleanup:
+    def test_sigterm_kills_workers(self):
+        """SIGTERM to the launcher must not orphan workers (regression:
+        `timeout`-killed launcher left Gloo workers holding ports)."""
+        import signal
+        import time
+
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env.pop("XLA_FLAGS", None)
+        env.pop("JAX_PLATFORMS", None)
+        p = subprocess.Popen(
+            [sys.executable, "-m", "kungfu_tpu.run", "-w", "-np", "2",
+             "-platform", "cpu", "--", sys.executable, "examples/elastic_mnist.py",
+             "--total-samples", "1000000", "--batch-size", "32"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=REPO,
+        )
+        try:
+            time.sleep(15)  # let workers come up
+            p.send_signal(signal.SIGTERM)
+            p.wait(timeout=60)
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                probe = subprocess.run(
+                    ["pgrep", "-f", "elastic_mnist.py --total-samples 1000000"],
+                    capture_output=True, text=True,
+                )
+                if probe.returncode != 0:  # no survivors
+                    break
+                time.sleep(1)
+            else:
+                subprocess.run(
+                    ["pkill", "-9", "-f",
+                     "elastic_mnist.py --total-samples 1000000"], check=False,
+                )
+                raise AssertionError("workers survived launcher SIGTERM")
+        finally:
+            if p.poll() is None:
+                p.kill()

@@ -72,3 +72,17 @@ def test_save_interval_skips(tmp_path):
     mgr.wait()
     assert mgr.all_steps() == [0, 10]
     mgr.close()
+
+
+def test_writes_property(tmp_path):
+    """Single-runtime workers: only the primary hands state to orbax.  (In a
+    multi-process runtime orbax barriers in save(), so all ranks write — that
+    branch needs jax.process_count() > 1 and is exercised by the launcher
+    integration tests.)"""
+    mgr = CheckpointManager(str(tmp_path / "a"), is_primary=True)
+    assert mgr.writes
+    mgr2 = CheckpointManager(str(tmp_path / "b"), is_primary=False)
+    assert not mgr2.writes
+    assert mgr2.save(1, {"x": 1}) is False
+    mgr.close()
+    mgr2.close()

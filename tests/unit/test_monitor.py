@@ -169,3 +169,24 @@ def test_rate_window_slow_traffic_not_zero():
     w.add(1000, t=0.0)
     w.add(1000, t=10.0)  # slower than the window
     assert w.rate(now=10.0) == pytest.approx(100.0)  # 1000 B / 10 s
+
+
+def test_rate_window_idle_gap_burst():
+    """A resumed burst after a long idle gap must not be averaged over the gap
+    (the stale delta-anchor bias found in review)."""
+    w = RateWindow(window_s=5.0)
+    w.add(1000, t=0.0)
+    w.add(1000, t=10.0)  # becomes the stale anchor
+    # idle until t=600, then a burst at ~1000 B/s
+    w.add(1000, t=600.0)
+    w.add(1000, t=601.0)
+    w.add(1000, t=602.0)
+    r = w.rate(now=602.0)
+    assert 500.0 <= r <= 2000.0, r  # not ~5 B/s over the 592 s gap
+
+
+def test_rate_window_slow_traffic_still_measured():
+    w = RateWindow(window_s=5.0)
+    w.add(700, t=0.0)
+    w.add(700, t=7.0)  # one add per 7 s, slower than the window
+    assert w.rate(now=7.0) == pytest.approx(100.0)
