@@ -34,4 +34,10 @@ from .api import (  # noqa: F401
     log_stats,
     egress_rates,
     check_interference,
+    get_peer_latencies,
+    minimum_spanning_tree,
+    set_tree,
+    set_strategy,
+    get_variable,
+    set_variable,
 )

@@ -127,6 +127,47 @@ def request_variable(target_rank: int, name: str, version: str = ""):
     return default_peer().request(target_rank, name, version=version)
 
 
+def get_peer_latencies(timeout: float = 5.0) -> list:
+    """Per-peer RTTs over the control plane (reference GetPeerLatencies op)."""
+    return default_peer().get_peer_latencies(timeout=timeout)
+
+
+def minimum_spanning_tree(latencies) -> list:
+    """Father-array MST over a symmetric latency matrix (reference
+    MinimumSpanningTree op + include/kungfu/mst.hpp)."""
+    from .plan import minimum_spanning_tree as mst
+
+    return mst(latencies)
+
+
+def set_tree(forest) -> None:
+    """Adopt an explicit bcast tree for subsequent collectives (reference
+    SetTree op; see Session.set_tree for the XLA mapping).  Collective in
+    spirit: call at the same point on every peer."""
+    default_peer().current_session().set_tree(forest)
+
+
+def set_strategy(strategy) -> None:
+    """Runtime strategy swap (reference SetGlobalStrategy)."""
+    from .plan import Strategy
+
+    s = Strategy.parse(strategy) if isinstance(strategy, str) else strategy
+    default_peer().current_session().set_strategy(s)
+
+
+def get_variable(name: str, default=None):
+    """Read a named global training variable (reference variables.py)."""
+    from . import variables as V
+
+    return V.get_variable(name, default)
+
+
+def set_variable(name: str, value: float) -> None:
+    from . import variables as V
+
+    V.set_variable(name, value)
+
+
 def propose_new_size(new_size: int) -> None:
     """Rank 0 proposes a resize via the config server (legacy.go:18-37).
 

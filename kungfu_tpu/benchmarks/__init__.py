@@ -66,17 +66,15 @@ class BenchResult:
         )
 
 
-def _payloads(model: str, fuse: bool, size: int, dtype=np.float32) -> List[jnp.ndarray]:
+def _payloads(session: Session, model: str, fuse: bool, dtype=np.float32) -> List[jnp.ndarray]:
     sizes = fakemodel.get_sizes(model)
     if fuse:
         sizes = [sum(sizes)]
     rng = np.random.RandomState(0)
-    # per-peer tensors stacked on dim 0 (Session value convention); broadcast a
-    # single row — identical payload per peer costs one host buffer, not `size`
-    return [
-        jnp.asarray(np.broadcast_to(rng.randn(1, s).astype(dtype), (size, s)))
-        for s in sizes
-    ]
+    # Session.lift places per-peer rows correctly in BOTH single-controller
+    # and multi-controller (launcher) runs — a plain jnp.asarray of the
+    # global shape would break under jax.process_count() > 1
+    return [session.lift(rng.randn(s).astype(dtype)) for s in sizes]
 
 
 def bench_all_reduce(
@@ -92,7 +90,7 @@ def bench_all_reduce(
     if method not in METHODS:
         raise ValueError(f"unknown method {method!r}; one of {sorted(METHODS)}")
     strategy = METHODS[method]
-    xs = _payloads(model, fuse, session.size, dtype)
+    xs = _payloads(session, model, fuse, dtype)
     payload = sum(int(x.nbytes) // session.size for x in xs)
 
     def one_step():

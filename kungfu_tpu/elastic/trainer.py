@@ -313,6 +313,12 @@ def run_elastic(
                 if last_got.get("version") == version:
                     cluster = last_got["cluster"]
                     log.info("resizing to version %d: %d workers", version, cluster.size())
+                    if cluster.workers.rank(peer.self_id) is None:
+                        # announce detachment BEFORE the slow teardown: the
+                        # watcher reconciles off the config server and may
+                        # SIGTERM this (now-removed) worker at any moment
+                        print(f"DETACHED: rank left cluster at version {version}",
+                              flush=True)
                     snap_params, snap_opt = snap(state)
                     if ckpt is not None:
                         # flush queued async saves and drop the orbax manager
@@ -321,7 +327,6 @@ def run_elastic(
                         ckpt.release()
                     _teardown_backend()
                     if not peer.update_cluster(cluster, version):
-                        print(f"DETACHED: rank left cluster at version {version}", flush=True)
                         sys.exit(0)
                     trainer, programs = build()
                     if ckpt is not None:

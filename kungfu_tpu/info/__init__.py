@@ -1,0 +1,2 @@
+"""``python -m kungfu_tpu.info`` — environment/version dump
+(reference srcs/python/kungfu/info/__main__.py)."""

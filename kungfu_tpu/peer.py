@@ -191,6 +191,18 @@ class Peer:
             wait=wait, timeout=timeout,
         )
 
+    def get_peer_latencies(self, timeout: float = 5.0):
+        """RTT to every peer's store endpoint, seconds; 0 for self
+        (reference GetPeerLatencies, tensorflow/ops/cpu/topology.cpp:84 over
+        rchannel pings).  Feed into plan.minimum_spanning_tree + set_tree."""
+        if self.size <= 1:
+            return [0.0] * self.size
+        _, client = self._ensure_store()
+        return [
+            0.0 if r == self.rank else client.ping(p, timeout=timeout)
+            for r, p in enumerate(self.config.peers)
+        ]
+
     def close(self) -> None:
         if getattr(self, "_monitor", None) is not None:
             self._monitor.close()

@@ -121,3 +121,27 @@ def strategy_graphs(
     if s is Strategy.RING:
         return [G.gen_circular_graph_pair(n, shift=k) for k in range(min(n, 4))]
     raise ValueError(f"unhandled strategy {s}")
+
+
+def strategy_for_tree(g: "G.Graph") -> Strategy:
+    """Map an explicit bcast tree onto the nearest XLA strategy.
+
+    The reference installs arbitrary reduce/bcast graphs at runtime (SetTree,
+    session/adaptation.go:22-28); under XLA the collective routing is the
+    compiler's, so an installed tree selects the *implementation family* its
+    shape implies: a star -> one-shot PSUM, a chain -> RING, a bounded-fanout
+    tree -> phased RS_AG (bandwidth-optimal for deep topologies).
+    """
+    n = len(g)
+    if n <= 1:
+        return Strategy.STAR
+    roots = [i for i in range(n) if g.is_self_loop(i)]
+    root = roots[0] if roots else 0
+    # the forest array encodes the reduce orientation (child -> father), so a
+    # node's children are its `prevs`; classify by broadcast fanout
+    children = {i: [j for j in g.prevs(i) if j != i] for i in range(n)}
+    if len(children[root]) == n - 1:
+        return Strategy.STAR
+    if all(len(c) <= 1 for c in children.values()):
+        return Strategy.RING
+    return Strategy.CLIQUE  # phased reduce_scatter+all_gather

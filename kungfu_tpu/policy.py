@@ -1,0 +1,87 @@
+"""Training policies — lifecycle hooks around the train loop.
+
+Reference: srcs/python/kungfu/policy/{base_policy,policy_hook}.py — a
+`BasePolicy` with before/after_{train,epoch,step} callbacks driven by a
+SessionRunHook that maintains the trained-samples and batch-size global
+variables.  Here `PolicyRunner` plays the hook's role inside
+`DataParallelTrainer.fit(policies=...)` (or any custom loop), keeping the
+same named variables up to date via :mod:`kungfu_tpu.variables`.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+from . import variables as V
+
+
+class BasePolicy:
+    """Override any subset; all no-ops by default (base_policy.py)."""
+
+    def before_train(self) -> None: ...
+
+    def after_train(self) -> None: ...
+
+    def before_epoch(self) -> None: ...
+
+    def after_epoch(self) -> None: ...
+
+    def before_step(self) -> None: ...
+
+    def after_step(self, metrics: Optional[Dict[str, Any]] = None) -> None: ...
+
+
+class PolicyRunner:
+    """Drives policies and the named progress variables (policy_hook.py:8-80).
+
+    steps_per_epoch > 0 turns step boundaries into epoch callbacks, the way
+    the reference derives epochs from trained-sample counts.
+    """
+
+    def __init__(self, policies: Sequence[BasePolicy], batch_size: int = 0,
+                 steps_per_epoch: int = 0):
+        self.policies = list(policies)
+        self.batch_size = batch_size
+        self.steps_per_epoch = steps_per_epoch
+        self._step_in_epoch = 0
+        self._in_epoch = False
+        # batch_size=0 = unknown yet (fit discovers it from the first batch);
+        # never clobber a user-set kungfu_batch_size with 0
+        if batch_size:
+            V.set_variable(V.BATCH_SIZE, batch_size)
+        V.set_variable(V.TRAINED_SAMPLES, V.get_variable(V.TRAINED_SAMPLES, 0.0))
+
+    def begin(self) -> None:
+        for p in self.policies:
+            p.before_train()
+
+    def before_step(self) -> None:
+        if self.steps_per_epoch and not self._in_epoch:
+            self._in_epoch = True
+            self._step_in_epoch = 0
+            for p in self.policies:
+                p.before_epoch()
+        for p in self.policies:
+            p.before_step()
+
+    def after_step(self, samples: int,
+                   metrics: Optional[Dict[str, Any]] = None) -> None:
+        if not self.batch_size and samples:
+            self.batch_size = samples
+            V.set_variable(V.BATCH_SIZE, samples)
+        V.global_variables().add(V.TRAINED_SAMPLES, samples)
+        for p in self.policies:
+            p.after_step(metrics)
+        if self.steps_per_epoch:
+            self._step_in_epoch += 1
+            if self._step_in_epoch >= self.steps_per_epoch:
+                self._in_epoch = False
+                for p in self.policies:
+                    p.after_epoch()
+
+    def end(self) -> None:
+        if self.steps_per_epoch and self._in_epoch:
+            self._in_epoch = False
+            for p in self.policies:
+                p.after_epoch()
+        for p in self.policies:
+            p.after_train()

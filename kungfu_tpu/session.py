@@ -116,10 +116,44 @@ class Session:
     def size(self) -> int:
         return int(np.prod([self.mesh.shape[a] for a in self._axes]))
 
+    def lift(self, value) -> jax.Array:
+        """Per-peer host value -> stacked (size, ...) array on the mesh.
+
+        Single-controller: every row is this value.  Multi-controller: each
+        process contributes its own value for its local devices, so rows
+        differ per worker — the layout every Session collective expects.
+        """
+        value = np.asarray(value)
+        sharding = NamedSharding(self.mesh, P(self._axes))
+        if jax.process_count() == 1:
+            full = np.broadcast_to(value[None], (self.size,) + value.shape)
+            return jax.device_put(full, sharding)
+        n_local = jax.local_device_count()
+        tiled = np.broadcast_to(value[None], (n_local,) + value.shape)
+        return jax.make_array_from_process_local_data(sharding, tiled)
+
+    @staticmethod
+    def local_row(stacked) -> np.ndarray:
+        """First locally-addressable row of a stacked collective result."""
+        return np.asarray(stacked.addressable_shards[0].data)[0]
+
     def set_strategy(self, strategy: Strategy) -> None:
         """Runtime strategy swap (SetGlobalStrategy analog)."""
         log.info("strategy swap: %s -> %s", self.strategy.name, strategy.name)
         self.strategy = strategy
+
+    def set_tree(self, forest) -> None:
+        """Install an explicit bcast tree (SimpleSetGlobalStrategy analog,
+        session/adaptation.go:22-28; father-array encoding like the MST op's
+        output).  XLA owns intra-program routing, so the tree selects the
+        nearest implementation family (plan.strategy_for_tree) and is kept
+        for introspection/DCN planning."""
+        from .plan.graph import Graph
+        from .plan.strategy import strategy_for_tree
+
+        g = Graph.from_forest_array(list(forest))  # reduce orientation
+        self.tree = g.reverse()  # bcast orientation for introspection
+        self.set_strategy(strategy_for_tree(g))
 
     def _impl(self, strategy: Optional[Strategy]) -> Impl:
         s = strategy if strategy is not None else self.strategy

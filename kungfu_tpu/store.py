@@ -57,6 +57,7 @@ WINDOW_SIZE = 3  # last-3-versions GC window (reference p2p.go:11)
 
 _OP_SAVE = 1
 _OP_REQUEST = 2
+_OP_PING = 3
 _ST_OK = 0
 _ST_NOT_FOUND = 1
 
@@ -215,6 +216,8 @@ class StoreServer:
                             else:
                                 outer.store.save(name, blob)
                             self.request.sendall(struct.pack(">BQ", _ST_OK, 0))
+                        elif op == _OP_PING:
+                            self.request.sendall(struct.pack(">BQ", _ST_OK, 0))
                         elif op == _OP_REQUEST:
                             blob = (
                                 outer.versioned.get(version, name)
@@ -307,6 +310,16 @@ class StoreClient:
                 if sock is None:
                     sock = self._connect(ep, retries=connect_retries, deadline=deadline)
                     self._conns[ep] = sock
+                # the caller's deadline must bound the round-trip itself, not
+                # just connection establishment: a connected-but-hung peer
+                # would otherwise block for the socket's default timeout
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise ConnectionError(f"deadline exceeded for {ep}")
+                    sock.settimeout(remaining)
+                else:
+                    sock.settimeout(None)
                 try:
                     _write_frame(sock, op, version, name, payload)
                     status, plen = struct.unpack(">BQ", _read_exact(sock, 9))
@@ -323,6 +336,18 @@ class StoreClient:
     def save(self, peer: PeerID, name: str, arr: np.ndarray, version: str = "") -> None:
         """Push a blob into a remote peer's store."""
         self._roundtrip(peer, _OP_SAVE, version, name, Blob.from_array(arr).pack())
+
+    def ping(self, peer: PeerID, timeout: float = 5.0) -> float:
+        """Round-trip time to the peer's store in seconds (reference
+        client.Ping, rchannel/client/client.go:29-44)."""
+        t0 = time.perf_counter()
+        status, _ = self._roundtrip(
+            peer, _OP_PING, "", "", b"",
+            deadline=time.monotonic() + timeout,
+        )
+        if status != _ST_OK:
+            raise ConnectionError(f"ping to {peer} failed: status {status}")
+        return time.perf_counter() - t0
 
     def request(
         self, peer: PeerID, name: str, version: str = "",

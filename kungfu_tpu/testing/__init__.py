@@ -1,0 +1,82 @@
+"""Fake trainers and failure injection — the multi-node-without-a-cluster kit.
+
+Reference: tests/go/fakemodel + tests/go/cmd/{kungfu-fake-go-trainer,
+kungfu-fake-adaptive-trainer,kungfu-bad-worker} (SURVEY.md §4): synthetic
+gradient-size lists exercise the full communication stack with realistic
+message sizes and no ML framework, fake adaptive trainers replay the resize
+protocol, and bad workers inject failures.  Everything here runs under the
+launcher on the CPU backend, so the whole distributed stack is testable on
+one machine.
+"""
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+import numpy as np
+
+
+class FakeTrainerProgram:
+    """A group-allreduce step over the fake model's gradients, driven through
+    the Session engine — named collectives, the worker's configured strategy,
+    throughput stats and stall detection all engage, exactly like the
+    reference fake trainer exercises its full Go runtime.
+
+    Works single-controller (one process, many devices) and multi-controller
+    (one process per worker under jax.distributed).
+    """
+
+    def __init__(self, model: str = "resnet50-imagenet", fuse: bool = True,
+                 dtype=np.float32, session=None):
+        from ..models import fakemodel
+
+        if session is None:
+            from ..peer import default_peer
+
+            session = default_peer().current_session()
+        self.session = session
+        self.model = model
+        sizes = fakemodel.get_sizes(model)
+        if fuse:
+            sizes = [sum(sizes)]
+        self.sizes: List[int] = sizes
+        self.payload_bytes = sum(sizes) * np.dtype(dtype).itemsize
+        self.world = session.size
+
+        rng = np.random.RandomState(0)
+        self._grads = [session.lift(rng.randn(s).astype(dtype)) for s in sizes]
+
+    def run_step(self) -> None:
+        outs = [
+            self.session.all_reduce(g, name=f"fake/{self.model}/{i}")
+            for i, g in enumerate(self._grads)
+        ]
+        outs[-1].block_until_ready()
+
+
+def train_loop(program: FakeTrainerProgram, steps: int, batch_size: int = 32,
+               warmup: int = 2, report_every: int = 0,
+               step_hook: Optional[callable] = None) -> dict:
+    """Timed allreduce loop reporting img/sec (kungfu-fake-go-trainer.go:44-80)."""
+    for _ in range(warmup):
+        program.run_step()
+    t0 = time.perf_counter()
+    last = t0
+    for i in range(steps):
+        program.run_step()
+        if step_hook is not None:
+            step_hook(i)
+        if report_every and (i + 1) % report_every == 0:
+            now = time.perf_counter()
+            rate = report_every * batch_size / (now - last)
+            print(f"step {i + 1}/{steps}: {rate:.1f} img/sec/worker", flush=True)
+            last = now
+    dt = time.perf_counter() - t0
+    per_worker = steps * batch_size / dt
+    return {
+        "steps": steps,
+        "seconds": dt,
+        "img_per_sec_worker": per_worker,
+        "img_per_sec_cluster": per_worker * program.world,
+        "gibps": program.payload_bytes * steps / dt / float(1 << 30),
+    }

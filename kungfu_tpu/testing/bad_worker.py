@@ -1,0 +1,68 @@
+"""``python -m kungfu_tpu.testing.bad_worker`` — failure injection.
+
+Reference: tests/go/cmd/kungfu-bad-worker (SURVEY.md §5: the failure model is
+cooperative, so detection relies on fail-fast launchers, connection retries
+and stall warnings).  Modes:
+
+  crash  — join the cluster, run N good steps, then exit nonzero: the
+           launcher must fail fast and kill the remaining workers.
+  hang   — stop participating in collectives mid-training: peers' stall
+           detectors (KFT_CONFIG_ENABLE_STALL_DETECTION) must start warning.
+  slow   — sleep before every collective: throughput monitoring should show
+           the degradation without any failure.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="kungfu_tpu.testing.bad_worker")
+    ap.add_argument("--mode", default="crash", choices=["crash", "hang", "slow"])
+    ap.add_argument("--after", type=int, default=3, help="good steps before misbehaving")
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--delay", type=float, default=0.5, help="slow-mode per-step sleep")
+    ap.add_argument("--model", default="slp-mnist")
+    ap.add_argument("--only-rank", type=int, default=-1,
+                    help="misbehave only on this rank (-1: every rank)")
+    args = ap.parse_args(argv)
+
+    import kungfu_tpu
+
+    from . import FakeTrainerProgram, train_loop
+
+    peer = kungfu_tpu.init()
+    bad = args.only_rank < 0 or peer.rank == args.only_rank
+    program = FakeTrainerProgram(args.model)
+
+    def hook(i):
+        if not bad or i + 1 < args.after:
+            return
+        if args.mode == "crash":
+            print(f"BAD-WORKER: rank {peer.rank} crashing after step {i + 1}",
+                  flush=True)
+            sys.stdout.flush()
+            # hard exit: a sys.exit would run atexit handlers, and
+            # jax.distributed.shutdown blocks against peers stuck in the
+            # collective we just abandoned — real crashes don't say goodbye
+            os._exit(7)
+        if args.mode == "hang":
+            print(f"BAD-WORKER: rank {peer.rank} hanging after step {i + 1}",
+                  flush=True)
+            while True:  # pragma: no cover - killed externally
+                time.sleep(60)
+        if args.mode == "slow":
+            time.sleep(args.delay)
+
+    out = train_loop(program, args.steps, warmup=1, step_hook=hook)
+    print(f"RESULT: bad-worker mode={args.mode} survived steps={out['steps']}",
+          flush=True)
+    kungfu_tpu.finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

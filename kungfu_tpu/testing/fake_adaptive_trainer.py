@@ -1,0 +1,81 @@
+"""``python -m kungfu_tpu.testing.fake_adaptive_trainer`` — replay the elastic
+resize protocol with a tiny synthetic model (no ML framework semantics to get
+in the way).
+
+Reference: tests/go/cmd/kungfu-fake-adaptive-trainer — the Go replay of the
+SessionRunHook resize flow (propose -> consensus -> rebuild -> sync).  Run
+under the launcher in watch mode::
+
+    python -m kungfu_tpu.run -w -np 2 -platform cpu -- \
+        python -m kungfu_tpu.testing.fake_adaptive_trainer --schedule 2:8,3:8,2:8
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="kungfu_tpu.testing.fake_adaptive_trainer")
+    ap.add_argument("--schedule", default="", help="size:steps,... resize schedule")
+    ap.add_argument("--total-samples", type=int, default=2048)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--dim", type=int, default=64, help="fake parameter size")
+    ap.add_argument("--check-every", type=int, default=2)
+    args = ap.parse_args(argv)
+
+    from ..elastic.trainer import ElasticConfig, run_elastic
+
+    def make_loss():
+        import jax.numpy as jnp
+
+        def loss_fn(params, batch):
+            # quadratic bowl: params chase the batch mean — enough to make
+            # state sync observable without any model machinery
+            x, = batch
+            return jnp.mean((params["w"] - jnp.mean(x, axis=0)) ** 2)
+
+        return loss_fn
+
+    def init_params():
+        import jax.numpy as jnp
+
+        return {"w": jnp.zeros((args.dim,), jnp.float32)}
+
+    def make_tx():
+        import optax
+
+        from ..optimizers import synchronous_sgd
+
+        return synchronous_sgd(optax.sgd(0.1))
+
+    def make_data(rank, size, offset):
+        import numpy as np
+
+        def gen():
+            rng = np.random.RandomState(rank + (offset % 7))
+            while True:
+                yield (rng.randn(args.batch_size, args.dim).astype(np.float32),)
+
+        return gen()
+
+    out = run_elastic(
+        make_loss, init_params, make_tx, make_data,
+        ElasticConfig(
+            total_samples=args.total_samples,
+            batch_size=args.batch_size,
+            schedule=args.schedule,
+            check_every=args.check_every,
+        ),
+    )
+    print(
+        f"RESULT: fake-adaptive trained={out['trained_samples']} "
+        f"resizes={out['resizes']} final_size={out['final_size']} "
+        f"loss={out['loss']:.4f}",
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,68 @@
+"""``python -m kungfu_tpu.testing.fake_trainer`` — allreduce loop over a fake
+model, reporting img/sec per worker and per cluster.
+
+Reference: tests/go/cmd/kungfu-fake-go-trainer/kungfu-fake-go-trainer.go:52-80.
+Run under the launcher for the multi-worker sweep::
+
+    python -m kungfu_tpu.run -np 4 -platform cpu -- \
+        python -m kungfu_tpu.testing.fake_trainer --model resnet50-imagenet
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="kungfu_tpu.testing.fake_trainer")
+    ap.add_argument("--model", default="resnet50-imagenet")
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--warmup", type=int, default=2)
+    ap.add_argument("--no-fuse", action="store_true")
+    ap.add_argument("--report-every", type=int, default=0)
+    ap.add_argument("--show-latencies", action="store_true",
+                    help="measure peer RTTs, build the MST, adopt it (the "
+                         "GetPeerLatencies -> MinimumSpanningTree -> SetTree "
+                         "chain, reference topology.cpp:84-154)")
+    args = ap.parse_args(argv)
+
+    import kungfu_tpu
+
+    from . import FakeTrainerProgram, train_loop
+
+    peer = kungfu_tpu.init()
+    if args.show_latencies and peer.size > 1:
+        lats = kungfu_tpu.get_peer_latencies()
+        # symmetric matrix from each peer's view of its own row: every peer
+        # measures its row; for the drill, mirror the local row
+        n = peer.size
+        mat = [[0.0] * n for _ in range(n)]
+        for j, v in enumerate(lats):
+            mat[peer.rank][j] = mat[j][peer.rank] = v
+        for i in range(n):
+            for j in range(n):
+                if i != j and mat[i][j] == 0.0:
+                    mat[i][j] = max(lats) or 1e-3
+        father = kungfu_tpu.minimum_spanning_tree(mat)
+        kungfu_tpu.set_tree(father)
+        print(f"LATENCIES: rank={peer.rank} rtts={['%.4f' % x for x in lats]} "
+              f"mst={father}", flush=True)
+    program = FakeTrainerProgram(args.model, fuse=not args.no_fuse)
+    out = train_loop(
+        program, args.steps, batch_size=args.batch_size, warmup=args.warmup,
+        report_every=args.report_every,
+    )
+    print(
+        f"RESULT: model={args.model} rank={peer.rank} np={program.world} "
+        f"steps={out['steps']} img/sec/worker={out['img_per_sec_worker']:.1f} "
+        f"img/sec/cluster={out['img_per_sec_cluster']:.1f} "
+        f"allreduce={out['gibps']:.3f} GiB/s",
+        flush=True,
+    )
+    kungfu_tpu.finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,156 @@
+"""PyTorch interop — collectives and a synchronous-SGD wrapper for torch
+models, routed through the XLA Session.
+
+Reference: srcs/python/kungfu/torch/{__init__,ops/collective,ops/clib,
+optimizers/sync_sgd}.py — a pybind11 module dispatching torch tensors into
+the Go runtime by dtype.  Here torch tensors cross into the Session's mesh as
+numpy (zero-copy for CPU tensors) and the reduction runs as a compiled XLA
+collective; one worker process per rank joins via the launcher just like any
+other kungfu_tpu program.  The torch autograd/optimizer loop stays pure
+torch — only gradient/parameter exchange crosses the bridge.
+
+Single-process runs are a cluster of one: collectives are identity (the
+reference behaves the same with np=1).
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List
+
+import numpy as np
+
+__all__ = [
+    "all_reduce",
+    "all_gather",
+    "broadcast",
+    "broadcast_parameters",
+    "SynchronousSGDOptimizer",
+    "cluster_size",
+    "rank",
+]
+
+
+def _session():
+    from ..peer import default_peer
+
+    return default_peer().current_session()
+
+
+def _multi() -> bool:
+    import jax
+
+    return jax.process_count() > 1
+
+
+def rank() -> int:
+    from ..peer import default_peer
+
+    return default_peer().rank
+
+
+def cluster_size() -> int:
+    from ..peer import default_peer
+
+    return default_peer().size
+
+
+def _to_numpy(t) -> np.ndarray:
+    """torch -> numpy; bf16 has no numpy dtype, so cross as float32 (the
+    reduction runs in f32 either way — same as the reference's CPU path)."""
+    import torch
+
+    t = t.detach().cpu()
+    if t.dtype == torch.bfloat16:
+        t = t.float()
+    return t.numpy()
+
+
+def _roundtrip(kind: str, t, **kw):
+    """torch tensor -> session collective -> torch tensor (same dtype)."""
+    import torch
+
+    s = _session()
+    lifted = s.lift(_to_numpy(t))
+    out = getattr(s, kind)(lifted, **kw)
+    row = s.local_row(out)
+    return torch.from_numpy(np.ascontiguousarray(row)).to(t.dtype)
+
+
+def all_reduce(t, op: str = "sum"):
+    """Sum (or min/max/prod) across the cluster (reference all_reduce_cpu)."""
+    if not _multi():
+        return t.clone()
+    return _roundtrip("all_reduce", t, op=op)
+
+
+def broadcast(t, root: int = 0):
+    """Everyone adopts `root`'s tensor (reference broadcast_cuda_async)."""
+    if not _multi():
+        return t.clone()
+    return _roundtrip("broadcast", t, root=root)
+
+
+def all_gather(t):
+    """Stack every worker's tensor along a new dim 0 (reference all_gather_cpu)."""
+    import torch
+
+    if not _multi():
+        return t.clone().unsqueeze(0)
+    s = _session()
+    out = s.all_gather(s.lift(_to_numpy(t)))
+    gathered = s.local_row(out)  # (world, ...) identical on every peer
+    return torch.from_numpy(np.ascontiguousarray(gathered)).to(t.dtype)
+
+
+def broadcast_parameters(state_dict: Dict[str, "object"], root: int = 0) -> None:
+    """In-place broadcast of a model/optimizer state dict from `root`
+    (reference torch/ops/collective.py:42-48 broadcast_parameters)."""
+    import torch
+
+    for name, value in sorted(state_dict.items()):
+        if isinstance(value, torch.Tensor) and value.numel() > 0:
+            synced = broadcast(value, root=root)
+            value.detach().copy_(synced)
+
+
+class SynchronousSGDOptimizer:
+    """S-SGD wrapper for any torch optimizer: allreduce-average every grad
+    before the inner step (reference torch/optimizers/sync_sgd.py:6-33).
+
+    Usage::
+
+        opt = kungfu_tpu.torch.SynchronousSGDOptimizer(torch.optim.SGD(...))
+        kungfu_tpu.torch.broadcast_parameters(model.state_dict())
+        loss.backward(); opt.step(); opt.zero_grad()
+    """
+
+    def __init__(self, optimizer):
+        self.inner = optimizer
+        self._np = cluster_size()
+
+    @property
+    def param_groups(self) -> List[dict]:
+        return self.inner.param_groups
+
+    def _params(self) -> Iterable:
+        for group in self.inner.param_groups:
+            yield from group["params"]
+
+    def _sync_gradients(self) -> None:
+        if self._np <= 1:
+            return
+        for p in self._params():
+            if p.grad is not None:
+                p.grad.detach().copy_(all_reduce(p.grad) / self._np)
+
+    def step(self, closure=None):
+        self._sync_gradients()
+        return self.inner.step(closure)
+
+    def zero_grad(self, *a, **kw):
+        return self.inner.zero_grad(*a, **kw)
+
+    def state_dict(self):
+        return self.inner.state_dict()
+
+    def load_state_dict(self, sd):
+        return self.inner.load_state_dict(sd)

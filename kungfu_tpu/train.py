@@ -222,16 +222,37 @@ class DataParallelTrainer:
         data_iter,
         steps: int,
         log_every: int = 50,
+        policies=None,
     ) -> Tuple[TrainState, Dict]:
+        """Train for `steps`; `policies` is an optional sequence of
+        BasePolicy hooks (reference PolicyHook, policy/policy_hook.py) or an
+        already-configured PolicyRunner."""
+        runner = None
+        if policies is not None:
+            from .policy import PolicyRunner
+
+            runner = (
+                policies
+                if isinstance(policies, PolicyRunner)
+                else PolicyRunner(policies, batch_size=0)
+            )
+            runner.begin()
         t0 = time.perf_counter()
         samples = 0
         metrics: Dict[str, Any] = {}
         for i in range(steps):
+            if runner is not None:
+                runner.before_step()
             batch = self.shard_batch(next(data_iter))
-            samples += int(jax.tree.leaves(batch)[0].shape[0])
+            n = int(jax.tree.leaves(batch)[0].shape[0])
+            samples += n
             state, metrics = self.train_step(state, batch)
+            if runner is not None:
+                runner.after_step(n, metrics)
             if log_every and (i + 1) % log_every == 0:
                 log.info("step %d loss %.4f", state.step, float(metrics["loss"]))
+        if runner is not None:
+            runner.end()
         if metrics:
             # scalar fetch, not block_until_ready: remote-tunneled backends
             # (axon) return from block_until_ready before execution finishes

@@ -1,0 +1,99 @@
+"""Fake-trainer sweep + failure injection under the launcher.
+
+Reference CI: scripts/tests/run-integration-tests.sh:30-38 sweeps fake-agent
+over np x strategy; kungfu-bad-worker exercises fail-fast.  The np sweep on
+the CPU backend is the reference's multi-node-on-one-machine trick.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_launcher(args, timeout=300, check=True):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    r = subprocess.run(
+        [sys.executable, "-m", "kungfu_tpu.run"] + args,
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO,
+    )
+    if check:
+        assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-2000:]
+    return r
+
+
+def test_fake_trainer_single():
+    r = run_launcher(
+        ["-np", "1", "-platform", "cpu", "--", sys.executable, "-m",
+         "kungfu_tpu.testing.fake_trainer", "--model", "slp-mnist",
+         "--steps", "3", "--warmup", "1"]
+    )
+    assert "RESULT: model=slp-mnist" in r.stdout
+    assert "img/sec/worker=" in r.stdout
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("np_", [2, 4])
+@pytest.mark.parametrize("strategy", ["STAR", "RING"])
+def test_fake_trainer_sweep(np_, strategy):
+    """np x strategy sweep (run-integration-tests.sh analog, reduced grid)."""
+    r = run_launcher(
+        ["-np", str(np_), "-strategy", strategy, "-platform", "cpu", "--",
+         sys.executable, "-m", "kungfu_tpu.testing.fake_trainer",
+         "--model", "slp-mnist", "--steps", "3", "--warmup", "1"]
+    )
+    results = [l for l in r.stdout.splitlines() if "RESULT:" in l]
+    assert len(results) == np_, r.stdout[-3000:]
+    for line in results:
+        assert f"np={np_}" in line
+
+
+@pytest.mark.slow
+def test_bad_worker_crash_fails_fast():
+    """One worker crashing must take the job down nonzero (watch.go:144-149)."""
+    r = run_launcher(
+        ["-np", "2", "-platform", "cpu", "--", sys.executable, "-m",
+         "kungfu_tpu.testing.bad_worker", "--mode", "crash", "--after", "2",
+         "--steps", "50", "--only-rank", "1"],
+        check=False,
+    )
+    assert r.returncode != 0, r.stdout[-2000:]
+    assert "BAD-WORKER: rank 1 crashing" in r.stdout
+    # the healthy worker must not report a completed run
+    assert "RESULT: bad-worker" not in r.stdout
+
+
+@pytest.mark.slow
+def test_fake_adaptive_trainer_resize():
+    """Resize protocol replay without any model machinery."""
+    r = run_launcher(
+        ["-w", "-np", "2", "-platform", "cpu", "--", sys.executable, "-m",
+         "kungfu_tpu.testing.fake_adaptive_trainer",
+         "--schedule", "2:8,3:8,2:100", "--total-samples", "2048",
+         "--check-every", "2"],
+        timeout=420,
+    )
+    results = [l for l in r.stdout.splitlines() if "RESULT: fake-adaptive" in l]
+    assert len(results) == 2, r.stdout[-3000:]
+    for line in results:
+        assert "resizes=2" in line and "trained=2048" in line, line
+
+
+@pytest.mark.slow
+def test_latency_mst_set_tree_chain():
+    """GetPeerLatencies -> MST -> SetTree drill across real worker processes."""
+    r = run_launcher(
+        ["-np", "2", "-platform", "cpu", "--", sys.executable, "-m",
+         "kungfu_tpu.testing.fake_trainer", "--model", "slp-mnist",
+         "--steps", "2", "--warmup", "1", "--show-latencies"]
+    )
+    lat_lines = [l for l in r.stdout.splitlines() if "LATENCIES:" in l]
+    assert len(lat_lines) == 2, r.stdout[-3000:]
+    for line in lat_lines:
+        assert "mst=" in line
+    assert r.stdout.count("RESULT:") == 2

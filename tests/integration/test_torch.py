@@ -1,0 +1,61 @@
+"""Torch bridge tests (reference tests/python/integration/test_torch_ops.py)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def test_single_process_identity():
+    """Cluster of one: collectives are identity (reference np=1 semantics)."""
+    torch = pytest.importorskip("torch")
+
+    from kungfu_tpu.torch import (
+        SynchronousSGDOptimizer,
+        all_gather,
+        all_reduce,
+        broadcast,
+    )
+
+    t = torch.tensor([1.0, 2.0])
+    assert torch.equal(all_reduce(t), t)
+    assert torch.equal(broadcast(t), t)
+    assert all_gather(t).shape == (1, 2)
+
+    model = torch.nn.Linear(4, 1)
+    opt = SynchronousSGDOptimizer(torch.optim.SGD(model.parameters(), lr=0.1))
+    loss = model(torch.ones(2, 4)).sum()
+    opt.zero_grad()
+    loss.backward()
+    opt.step()  # must not raise; np=1 skips the sync
+    assert opt.param_groups and opt.state_dict() is not None
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("np_", [2, 4])
+def test_torch_check_under_launcher(np_):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    r = subprocess.run(
+        [sys.executable, "-m", "kungfu_tpu.run", "-np", str(np_),
+         "-platform", "cpu", "--", sys.executable, "-m", "kungfu_tpu.torch.check"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-2000:]
+    oks = [l for l in r.stdout.splitlines() if "RESULT: torch-check" in l]
+    assert len(oks) == np_, r.stdout[-3000:]
+
+
+def test_bf16_crossing():
+    """bf16 tensors must survive the numpy crossing (review regression)."""
+    torch = pytest.importorskip("torch")
+
+    from kungfu_tpu.torch import _to_numpy
+
+    t = torch.ones(4, dtype=torch.bfloat16)
+    arr = _to_numpy(t)
+    assert arr.dtype.name == "float32" and arr.sum() == 4.0

@@ -1,0 +1,205 @@
+"""Policies, named variables, tree adaptation (reference policy/,
+variables.py, SetTree/MST ops)."""
+import numpy as np
+import pytest
+
+from kungfu_tpu import variables as V
+from kungfu_tpu.plan import Strategy, minimum_spanning_tree
+from kungfu_tpu.plan.graph import Graph
+from kungfu_tpu.plan.strategy import strategy_for_tree
+from kungfu_tpu.policy import BasePolicy, PolicyRunner
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    V.global_variables().reset()
+    yield
+    V.global_variables().reset()
+
+
+class Recorder(BasePolicy):
+    def __init__(self):
+        self.events = []
+
+    def before_train(self):
+        self.events.append("bt")
+
+    def after_train(self):
+        self.events.append("at")
+
+    def before_epoch(self):
+        self.events.append("be")
+
+    def after_epoch(self):
+        self.events.append("ae")
+
+    def before_step(self):
+        self.events.append("bs")
+
+    def after_step(self, metrics=None):
+        self.events.append("as")
+
+
+class TestPolicyRunner:
+    def test_lifecycle_with_epochs(self):
+        p = Recorder()
+        r = PolicyRunner([p], batch_size=8, steps_per_epoch=2)
+        r.begin()
+        for _ in range(4):
+            r.before_step()
+            r.after_step(8)
+        r.end()
+        assert p.events == [
+            "bt",
+            "be", "bs", "as", "bs", "as", "ae",
+            "be", "bs", "as", "bs", "as", "ae",
+            "at",
+        ]
+        assert V.get_variable(V.TRAINED_SAMPLES) == 32
+        assert V.get_variable(V.BATCH_SIZE) == 8
+
+    def test_partial_epoch_closed_at_end(self):
+        p = Recorder()
+        r = PolicyRunner([p], batch_size=4, steps_per_epoch=10)
+        r.begin()
+        r.before_step()
+        r.after_step(4)
+        r.end()
+        assert p.events == ["bt", "be", "bs", "as", "ae", "at"]
+
+    def test_fit_integration(self):
+        import jax.numpy as jnp
+        import optax
+
+        from kungfu_tpu.optimizers import synchronous_sgd
+        from kungfu_tpu.train import DataParallelTrainer
+
+        def loss_fn(params, batch):
+            x, = batch
+            return jnp.mean((params["w"] - x.mean()) ** 2)
+
+        trainer = DataParallelTrainer(loss_fn, synchronous_sgd(optax.sgd(0.1)))
+        state = trainer.init({"w": jnp.zeros((4,))})
+        world = trainer.world
+
+        def gen():
+            rng = np.random.RandomState(0)
+            while True:
+                yield (rng.randn(2 * world, 4).astype(np.float32),)
+
+        p = Recorder()
+        state, metrics = trainer.fit(state, gen(), steps=3, policies=[p])
+        assert p.events.count("bs") == 3 and p.events.count("as") == 3
+        assert V.get_variable(V.TRAINED_SAMPLES) == 3 * 2 * world
+
+
+class TestVariables:
+    def test_set_get_add(self):
+        V.set_variable("x", 2.0)
+        assert V.get_variable("x") == 2.0
+        V.global_variables().add("x", 0.5)
+        assert V.get_variable("x") == 2.5
+        assert V.get_variable("missing", -1) == -1
+
+    def test_listeners(self):
+        seen = []
+        V.global_variables().subscribe(lambda n, v: seen.append((n, v)))
+        V.set_variable("y", 1.0)
+        assert seen == [("y", 1.0)]
+
+
+class TestTreeAdaptation:
+    def test_mst_then_strategy(self):
+        # host 0 near 1, far from 2,3; MST should avoid the slow links
+        lat = np.array(
+            [
+                [0.0, 1.0, 9.0, 9.0],
+                [1.0, 0.0, 1.0, 9.0],
+                [9.0, 1.0, 0.0, 1.0],
+                [9.0, 9.0, 1.0, 0.0],
+            ]
+        )
+        father = minimum_spanning_tree(lat)
+        g = Graph.from_forest_array(father)
+        # reduce orientation reversed = a valid broadcast tree
+        assert g.reverse().is_valid_tree()
+        # the chain 0-1-2-3 maps to the ring family
+        assert strategy_for_tree(g) is Strategy.RING
+
+    def test_star_tree(self):
+        g = Graph.from_forest_array([0, 0, 0, 0])
+        assert strategy_for_tree(g) is Strategy.STAR
+
+    def test_session_set_tree(self):
+        from kungfu_tpu.session import Session
+
+        sess = Session()
+        sess.set_tree([0, 0, 0, 0, 0, 0, 0, 0])
+        assert sess.strategy is Strategy.STAR
+        sess.set_tree([0, 0, 1, 2, 3, 4, 5, 6])  # chain
+        assert sess.strategy is Strategy.RING
+        assert sess.tree.is_valid_tree()
+
+
+class TestPing:
+    def test_store_ping_roundtrip(self):
+        from kungfu_tpu.plan import PeerID
+        from kungfu_tpu.store import (
+            STORE_PORT_OFFSET,
+            StoreClient,
+            StoreServer,
+            store_port,
+        )
+
+        srv = StoreServer(host="127.0.0.1", port=0).start()
+        try:
+            client = StoreClient()
+            peer = PeerID("127.0.0.1", srv.port - STORE_PORT_OFFSET)
+            # store_port(peer.port) must give back the bound port
+            assert store_port(peer.port) == srv.port
+            rtt = client.ping(peer)
+            assert 0 <= rtt < 5.0
+            client.close()
+        finally:
+            srv.close()
+
+
+class TestPingDeadline:
+    def test_ping_bounded_against_hung_peer(self):
+        """A connected-but-silent peer must not stall ping past its timeout
+        (review regression: only the connect phase honored the deadline)."""
+        import socket
+        import threading
+        import time
+
+        from kungfu_tpu.plan import PeerID
+        from kungfu_tpu.store import STORE_PORT_OFFSET, StoreClient
+
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+        accepted = []
+        threading.Thread(
+            target=lambda: accepted.append(srv.accept()), daemon=True
+        ).start()
+        try:
+            client = StoreClient()
+            peer = PeerID("127.0.0.1", port - STORE_PORT_OFFSET)
+            t0 = time.perf_counter()
+            with pytest.raises((ConnectionError, OSError)):
+                client.ping(peer, timeout=0.5)
+            assert time.perf_counter() - t0 < 3.0
+            client.close()
+        finally:
+            srv.close()
+
+
+class TestBatchSizeVariable:
+    def test_runner_does_not_clobber_user_batch_size(self):
+        V.set_variable(V.BATCH_SIZE, 256)
+        r = PolicyRunner([], batch_size=0)
+        assert V.get_variable(V.BATCH_SIZE) == 256
+        r.before_step()
+        r.after_step(64)
+        assert V.get_variable(V.BATCH_SIZE) == 64  # discovered from data
