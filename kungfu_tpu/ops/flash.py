@@ -1,0 +1,227 @@
+"""Flash attention — Pallas TPU kernel for the per-chip attention hot path.
+
+The reference has no attention kernels at all (it is model-agnostic DP;
+SURVEY.md §5); this is TPU-native capability: a fused online-softmax
+attention forward in Pallas (VMEM-resident blocks feeding the MXU, no
+[L, L] score matrix in HBM) with a blocked, rematerializing backward in
+XLA.  Layering with the parallelism stack: `parallel.ring_attention`
+rotates K/V shards across chips (ICI), and inside each chip this kernel
+computes the per-block attention; single-chip models call it directly.
+
+Shapes follow the rest of the framework: q, k, v are [B, L, H, D]; the
+kernel runs on a (B*H, L/block_q) grid with K/V streamed block-by-block
+from VMEM.  Computation is fp32 regardless of input dtype (bf16 in, fp32
+accumulate, cast back) — the MXU-native mixed precision.
+
+On non-TPU backends the kernel runs in interpreter mode automatically, so
+the same code path is exercised by the CPU test suite.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
+                causal: bool, block_k: int, seq_len: int):
+    """One q block vs all (needed) k blocks; online softmax in fp32.
+
+    q_ref: [1, block_q, D]; k_ref/v_ref: [1, L_pad, D];
+    o_ref: [1, block_q, D]; lse_ref: [1, block_q].
+    """
+    qi = pl.program_id(1)
+    block_q = q_ref.shape[1]
+    d = q_ref.shape[2]
+    l_pad = k_ref.shape[1]
+    nk = l_pad // block_k
+
+    q = q_ref[0].astype(jnp.float32) * scale  # [block_q, D]
+    q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        k_pos = j * block_k + lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+        valid = k_pos < seq_len  # mask the padded tail
+        if causal:
+            valid = jnp.logical_and(valid, q_pos >= k_pos)
+        s = jnp.where(valid, s, NEG_INF)
+        m_blk = jnp.max(s, axis=-1, keepdims=True)  # [block_q, 1]
+        m_new = jnp.maximum(m, m_blk)
+        p = jnp.exp(s - m_new)  # [block_q, block_k]
+        corr = jnp.exp(m - m_new)  # [block_q, 1]
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * corr + jnp.dot(p, v_blk, preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    if causal:
+        # blocks strictly above the diagonal contribute nothing: stop after
+        # the block containing this q block's last position
+        nk_needed = lax.min(nk, pl.cdiv((qi + 1) * block_q, block_k))
+        m, l, acc = lax.fori_loop(0, nk_needed, body, (m0, l0, acc0))
+    else:
+        m, l, acc = lax.fori_loop(0, nk, body, (m0, l0, acc0))
+
+    l_safe = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows (padding)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    # lse broadcast across a 128-lane dim: TPU tiling wants the last dim to
+    # be 128-aligned, so per-row scalars ride a full lane (upstream flash
+    # kernels use the same layout)
+    lse_ref[0] = jnp.broadcast_to(m + jnp.log(l_safe), (block_q, 128))
+
+
+def _pad_to(x, multiple: int, axis: int):
+    size = x.shape[axis]
+    rem = size % multiple
+    if rem == 0:
+        return x
+    pad = [(0, 0)] * x.ndim
+    pad[axis] = (0, multiple - rem)
+    return jnp.pad(x, pad)
+
+
+def _flash_fwd(q, k, v, scale: float, causal: bool, block_q: int, block_k: int,
+               interpret: Optional[bool]):
+    """q,k,v: [BH, L, D] -> (o [BH, L, D], lse [BH, L])."""
+    bh, seq_len, d = q.shape
+    qp = _pad_to(q, block_q, 1)
+    kp = _pad_to(k, block_k, 1)
+    vp = _pad_to(v, block_k, 1)
+    lq, lk = qp.shape[1], kp.shape[1]
+    nq = lq // block_q
+
+    kern = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_k=block_k,
+        seq_len=seq_len,
+    )
+    o, lse = pl.pallas_call(
+        kern,
+        grid=(bh, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, lk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, lk, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, lq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, lq, 128), jnp.float32),
+        ],
+        interpret=_use_interpret() if interpret is None else interpret,
+    )(qp, kp, vp)
+    return o[:, :seq_len], lse[:, :seq_len, 0]
+
+
+def _bwd_blocked(q, k, v, o, lse, g, scale: float, causal: bool,
+                 block_k: int):
+    """Rematerializing backward in XLA: scan over k/v blocks, never holding
+    the full [L, L] probability matrix (standard flash backward formula)."""
+    bh, seq_len, d = q.shape
+    kp = _pad_to(k, block_k, 1)
+    vp = _pad_to(v, block_k, 1)
+    nk = kp.shape[1] // block_k
+
+    qf = q.astype(jnp.float32) * scale
+    gf = g.astype(jnp.float32)
+    of = o.astype(jnp.float32)
+    delta = jnp.sum(of * gf, axis=-1)  # [BH, L]
+    q_pos = jnp.arange(seq_len)
+
+    def one_block(j):
+        k_blk = lax.dynamic_slice_in_dim(kp, j * block_k, block_k, 1)
+        v_blk = lax.dynamic_slice_in_dim(vp, j * block_k, block_k, 1)
+        kf = k_blk.astype(jnp.float32)
+        vf = v_blk.astype(jnp.float32)
+        s = jnp.einsum("bqd,bkd->bqk", qf, kf)
+        k_pos = j * block_k + jnp.arange(block_k)
+        valid = (k_pos < seq_len)[None, :]
+        if causal:
+            valid = jnp.logical_and(valid, q_pos[:, None] >= k_pos[None, :])
+        p = jnp.where(valid[None], jnp.exp(s - lse[:, :, None]), 0.0)
+        dv = jnp.einsum("bqk,bqd->bkd", p, gf)
+        dp = jnp.einsum("bqd,bkd->bqk", gf, vf)
+        ds = p * (dp - delta[:, :, None])
+        dq_c = jnp.einsum("bqk,bkd->bqd", ds, kf)
+        dk = jnp.einsum("bqk,bqd->bkd", ds, qf)
+        return dq_c, dk, dv
+
+    def scan_body(dq_acc, j):
+        dq_c, dk, dv = one_block(j)
+        return dq_acc + dq_c, (dk, dv)
+
+    dq, (dks, dvs) = lax.scan(
+        scan_body, jnp.zeros_like(qf), jnp.arange(nk)
+    )
+    dk = jnp.moveaxis(dks, 0, 1).reshape(bh, nk * block_k, d)[:, :seq_len]
+    dv = jnp.moveaxis(dvs, 0, 1).reshape(bh, nk * block_k, d)[:, :seq_len]
+    return (dq * scale).astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
+)
+def _flash_bhld(q, k, v, scale, causal, block_q, block_k, interpret):
+    o, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return o
+
+
+def _flash_bhld_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bhld_bwd(scale, causal, block_q, block_k, interpret, res, g):
+    q, k, v, o, lse = res
+    return _bwd_blocked(q, k, v, o, lse, g, scale, causal, block_k)
+
+
+_flash_bhld.defvjp(_flash_bhld_fwd, _flash_bhld_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Fused attention, [B, L, H, D] -> [B, L, H, D] in q's dtype.
+
+    Exact (not approximate): numerically the online-softmax refactoring of
+    softmax(qk^T)v.  `interpret=None` auto-selects interpreter mode off-TPU.
+    """
+    b, l, h, d = q.shape
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    bq = min(block_q, max(8, l))
+    bk = min(block_k, max(8, l))
+
+    def to_bhld(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, l, d)
+
+    o = _flash_bhld(
+        to_bhld(q), to_bhld(k), to_bhld(v), scale, causal, bq, bk, interpret
+    )
+    return o.reshape(b, h, l, d).transpose(0, 2, 1, 3)
