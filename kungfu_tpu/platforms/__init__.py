@@ -32,8 +32,14 @@ def from_tpu_pod_env(env=None) -> Optional[Tuple[Cluster, str]]:
     worker_id = int(e.get("TPU_WORKER_ID", "0"))
     hl = HostList.parse(",".join(f"{h}:1" for h in hosts))
     cluster = Cluster.from_hostlist(hl, len(hosts))
-    self_host = hosts[worker_id] if worker_id < len(hosts) else hosts[0]
-    return cluster, self_host
+    if worker_id >= len(hosts):
+        # a silent fallback to hosts[0] would give two processes the same
+        # self_host and both would claim host 0's worker slots
+        raise ValueError(
+            f"TPU_WORKER_ID={worker_id} out of range for "
+            f"{len(hosts)} hosts in TPU_WORKER_HOSTNAMES"
+        )
+    return cluster, hosts[worker_id]
 
 
 def from_generic_env(env=None) -> Optional[Tuple[Cluster, str]]:

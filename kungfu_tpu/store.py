@@ -269,11 +269,19 @@ class StoreClient:
     one cached connection per target, auto-reconnect with bounded retries —
     connection/config.go:16-19 uses 500x200ms; scaled down here)."""
 
-    def __init__(self, retries: int = 50, retry_interval: float = 0.1):
+    # bound on a deadline-less round-trip: a connected-but-hung peer (the
+    # bad_worker hang failure mode) must fail fast, never block forever
+    DEFAULT_OP_TIMEOUT = 5.0
+
+    def __init__(self, retries: int = 50, retry_interval: float = 0.1,
+                 op_timeout: Optional[float] = None):
         self._conns: Dict[Tuple[str, int], socket.socket] = {}
         self._locks: Dict[Tuple[str, int], threading.Lock] = {}
         self._retries = retries
         self._interval = retry_interval
+        self._op_timeout = (
+            self.DEFAULT_OP_TIMEOUT if op_timeout is None else op_timeout
+        )
         self._global_lock = threading.Lock()
 
     def _endpoint(self, peer: PeerID) -> Tuple[str, int]:
@@ -319,7 +327,7 @@ class StoreClient:
                         raise ConnectionError(f"deadline exceeded for {ep}")
                     sock.settimeout(remaining)
                 else:
-                    sock.settimeout(None)
+                    sock.settimeout(self._op_timeout)
                 try:
                     _write_frame(sock, op, version, name, payload)
                     status, plen = struct.unpack(">BQ", _read_exact(sock, 9))

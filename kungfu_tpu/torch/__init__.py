@@ -32,7 +32,20 @@ __all__ = [
 def _session():
     from ..peer import default_peer
 
-    return default_peer().current_session()
+    sess = default_peer().current_session()
+    import jax
+
+    if jax.process_count() > 1 and jax.local_device_count() != 1:
+        # Session.lift tiles one host value across all local devices: with
+        # k local devices a sum all_reduce counts each worker k times and
+        # all_gather returns k duplicate rows per worker.  The bridge's
+        # contract is one device per torch worker (launcher default).
+        raise RuntimeError(
+            "kungfu_tpu.torch requires 1 device per worker process "
+            f"(got local_device_count={jax.local_device_count()}); "
+            "launch with -devices-per-worker 1"
+        )
+    return sess
 
 
 def _multi() -> bool:
