@@ -215,8 +215,9 @@ class Session:
 
     # -- public collective API (reference session/{allreduce,allgather,session}.go) ---
 
-    def _run(self, kind: str, x: jax.Array, op: str = "sum", name: str = "",
-             strategy: Optional[Strategy] = None, **kw) -> jax.Array:
+    def _dispatch(self, kind: str, x: jax.Array, op: str = "sum",
+                  strategy: Optional[Strategy] = None, **kw) -> jax.Array:
+        """Enqueue one compiled collective without waiting for it."""
         x = jnp.asarray(x)
         if x.shape[0] != self.size:
             raise ValueError(
@@ -225,21 +226,57 @@ class Session:
             )
         impl = self._impl(strategy)
         fn = self._compiled(kind, op, impl, **kw)
+        return fn(x)
+
+    def _run(self, kind: str, x: jax.Array, op: str = "sum", name: str = "",
+             strategy: Optional[Strategy] = None, **kw) -> jax.Array:
         t0 = time.perf_counter()
         with stall_detector(name or kind):
-            out = fn(x)
+            out = self._dispatch(kind, x, op=op, strategy=strategy, **kw)
             out.block_until_ready()
-        self.stats.record(name or kind, x.nbytes, time.perf_counter() - t0)
+        nbytes = jnp.asarray(x).nbytes
+        self.stats.record(name or kind, nbytes, time.perf_counter() - t0)
         c = self._byte_counters
         if c is not None:
-            c.add_egress(name or kind, x.nbytes)
+            c.add_egress(name or kind, nbytes)
         return out
 
-    def all_reduce(self, x, op: str = "sum", name: str = "", strategy=None):
+    def all_reduce(self, x, op: str = "sum", name: str = "", strategy=None,
+                   tree=None):
+        """`tree` (father array) selects the implementation family for THIS
+        op only — the reference MonitoredAllReduce's explicit tree input
+        (cpu/collective.cpp:105), without touching the session default."""
+        if tree is not None:
+            from .plan.graph import Graph
+            from .plan.strategy import strategy_for_tree
+
+            strategy = strategy_for_tree(Graph.from_forest_array(list(tree)))
         return self._run("all_reduce", x, op=op, name=name, strategy=strategy)
 
     def group_all_reduce(self, xs: Sequence, op: str = "sum", name: str = ""):
-        return [self.all_reduce(x, op=op, name=f"{name}/{i}") for i, x in enumerate(xs)]
+        """Reduce a tensor list: dispatch every op, sync once at the end.
+
+        The reference pipelines chunks across strategy graphs so transfers
+        overlap (session.go:288-313); the XLA analog is async dispatch —
+        every compiled collective is enqueued before the first result is
+        awaited, so the runtime overlaps them — with one wall-clock window
+        for the whole group instead of dispatch-sync per tensor.
+        """
+        t0 = time.perf_counter()
+        gname = name or "group_all_reduce"
+        with stall_detector(gname):
+            outs = [
+                self._dispatch("all_reduce", x, op=op) for x in xs
+            ]
+            for out in outs:
+                out.block_until_ready()
+        dt = time.perf_counter() - t0
+        total = sum(jnp.asarray(x).nbytes for x in xs)
+        self.stats.record(gname, total, dt)
+        c = self._byte_counters
+        if c is not None:
+            c.add_egress(gname, total)
+        return outs
 
     def reduce(self, x, root: int = 0, op: str = "sum", name: str = ""):
         return self._run("reduce", x, op=op, name=name, root=root)

@@ -116,6 +116,17 @@ class TestSessionMechanics:
         sess.set_strategy(Strategy.AUTO)
         np.testing.assert_allclose(a, b, rtol=1e-5)
 
+    def test_per_op_tree(self, sess):
+        """all_reduce(tree=...) picks the impl for one op without touching
+        the session default (reference MonitoredAllReduce's tree input)."""
+        x = per_peer_values(sess.size, seed=21)
+        default = sess.strategy
+        a = np.asarray(sess.all_reduce(x))
+        # a star rooted at 0 (father array: everyone's father is 0)
+        b = np.asarray(sess.all_reduce(x, tree=[0] * sess.size))
+        np.testing.assert_allclose(a, b, rtol=1e-5)
+        assert sess.strategy is default
+
     def test_stats_recorded(self, sess):
         sess.stats.reset()
         x = per_peer_values(sess.size, seed=9)
