@@ -134,6 +134,76 @@ def bench_p2p(
     return 2 * store_size * steps / dt / GiB
 
 
+def bench_attention(
+    batch: int = 8,
+    seq_len: int = 2048,
+    heads: int = 16,
+    head_dim: int = 64,
+    causal: bool = True,
+    steps: int = 20,
+    warmup: int = 3,
+    dtype=jnp.bfloat16,
+    grad: bool = True,
+) -> Dict[str, float]:
+    """Flash (Pallas) vs full (einsum) attention on one chip.
+
+    Returns {impl: seconds_per_step} and prints RESULT lines with achieved
+    attention TFLOP/s (4*B*L^2*H*D matmul flops fwd, x2.5 with backward —
+    the standard flash-attention accounting, halved for causal).
+    """
+    import jax
+
+    from ..ops.flash import flash_attention
+    from ..parallel.ring_attention import full_attention
+
+    rng = np.random.RandomState(0)
+    shape = (batch, seq_len, heads, head_dim)
+    q, k, v = (jnp.asarray(rng.randn(*shape), dtype) for _ in range(3))
+
+    flops = 4.0 * batch * seq_len * seq_len * heads * head_dim
+    if causal:
+        flops /= 2
+    if grad:
+        flops *= 2.5
+
+    def make(fn):
+        if grad:
+            def loss(q, k, v):
+                return jnp.sum(fn(q, k, v, causal=causal).astype(jnp.float32) ** 2)
+
+            return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        return jax.jit(lambda q, k, v: fn(q, k, v, causal=causal))
+
+    def sync(r):
+        # force a device->host element fetch: on tunneled backends (axon)
+        # block_until_ready returns before execution finishes; device
+        # programs run in dispatch order, so fetching from the LAST result
+        # bounds all prior steps
+        leaf = jax.tree.leaves(r)[0]
+        return float(np.asarray(leaf.reshape(-1)[0]))
+
+    steps = max(1, steps)
+    warmup = max(1, warmup)  # first call is compile; timing it is never wanted
+    out: Dict[str, float] = {}
+    for name, fn in (("flash", flash_attention), ("full", full_attention)):
+        f = make(fn)
+        for _ in range(warmup):
+            r = f(q, k, v)
+        sync(r)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            r = f(q, k, v)
+        sync(r)
+        dt = (time.perf_counter() - t0) / steps
+        out[name] = dt
+        print(
+            f"RESULT: bench=attention impl={name} shape={shape} causal={int(causal)} "
+            f"grad={int(grad)} step={dt * 1e3:.3f} ms tflops={flops / dt / 1e12:.2f}",
+            flush=True,
+        )
+    return out
+
+
 def run_sweep(
     session: Session,
     models: Sequence[str] = ("resnet50-imagenet",),

@@ -18,7 +18,8 @@ import sys
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="kungfu_tpu.benchmarks")
-    p.add_argument("--bench", default="all_reduce", choices=["all_reduce", "p2p"])
+    p.add_argument("--bench", default="all_reduce",
+                   choices=["all_reduce", "p2p", "attention"])
     p.add_argument("--model", default="resnet50-imagenet",
                    help="comma-separated fake models (see models.fakemodel.REGISTRY)")
     p.add_argument("--method", default="auto",
@@ -28,7 +29,22 @@ def main(argv=None) -> int:
     p.add_argument("--no-fuse", action="store_true",
                    help="allreduce each gradient tensor separately (default fuses)")
     p.add_argument("--p2p-size", type=int, default=1 << 20)
+    p.add_argument("--seq-len", type=int, default=2048)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--heads", type=int, default=16)
+    p.add_argument("--head-dim", type=int, default=64)
+    p.add_argument("--no-grad", action="store_true")
     args = p.parse_args(argv)
+
+    if args.bench == "attention":
+        from . import bench_attention
+
+        bench_attention(
+            batch=args.batch, seq_len=args.seq_len, heads=args.heads,
+            head_dim=args.head_dim, steps=args.steps, warmup=args.warmup,
+            grad=not args.no_grad,
+        )
+        return 0
 
     if args.bench == "p2p":
         from . import bench_p2p

@@ -43,7 +43,9 @@ class TransformerConfig:
     d_ff: int = 2048
     max_len: int = 2048
     dtype: Any = jnp.bfloat16
-    attention: str = "full"  # "full" | "ring"
+    # "auto" = flash kernel on TPU, plain einsum elsewhere (the Pallas
+    # kernel would run interpreted off-TPU); "ring" = sequence-parallel
+    attention: str = "auto"  # "auto" | "flash" | "full" | "ring"
     causal: bool = True
     # MoE: every `moe_every`-th block uses experts (0 = dense model)
     n_experts: int = 0
@@ -85,7 +87,11 @@ class Attention(nn.Module):
         k = flax_spmd.with_logical_constraint(k, ("batch", "seq", "heads", "kv"))
         v = flax_spmd.with_logical_constraint(v, ("batch", "seq", "heads", "kv"))
 
-        if cfg.attention == "ring" and cfg.mesh is not None and cfg.sp_axis in cfg.mesh.axis_names:
+        kind = cfg.attention
+        if kind == "auto":
+            kind = "flash" if jax.default_backend() == "tpu" else "full"
+
+        if kind == "ring" and cfg.mesh is not None and cfg.sp_axis in cfg.mesh.axis_names:
             names = cfg.mesh.axis_names
             # keep batch on dp and heads on tp inside the manual region —
             # omitting them would all-gather those dims onto every device
@@ -102,6 +108,29 @@ class Attention(nn.Module):
                 out_specs=spec,
             )
             o = attn(q, k, v)
+        elif kind == "flash":
+            from ..ops.flash import flash_attention
+
+            if cfg.mesh is not None:
+                # pjit path with sharded q/k/v: a pallas_call is not GSPMD-
+                # partitionable, so enter a manual region over the batch/head
+                # axes (seq stays whole per device — sharded seq is "ring")
+                names = cfg.mesh.axis_names
+                spec = P(
+                    tuple(a for a in ("dp", "fsdp") if a in names) or None,
+                    None,
+                    "tp" if "tp" in names else None,
+                    None,
+                )
+                attn = _shard_map(
+                    partial(flash_attention, causal=cfg.causal),
+                    mesh=cfg.mesh,
+                    in_specs=(spec, spec, spec),
+                    out_specs=spec,
+                )
+                o = attn(q, k, v)
+            else:
+                o = flash_attention(q, k, v, causal=cfg.causal)
         else:
             o = full_attention(q, k, v, causal=cfg.causal)
 

@@ -19,7 +19,7 @@ the same code path is exercised by the CPU test suite.
 from __future__ import annotations
 
 import functools
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -97,9 +97,35 @@ def _pad_to(x, multiple: int, axis: int):
     return jnp.pad(x, pad)
 
 
+def _fwd_reference(q, k, v, scale: float, causal: bool):
+    """Pure-XLA forward with identical (o, lse) semantics to the kernel.
+
+    Used when auto-selection lands off-TPU: the Pallas interpreter is slow
+    and cannot run under shard_map's vma checking, while this lowers
+    anywhere.  Explicit interpret=True still runs the interpreted kernel
+    (that is what the kernel unit tests exercise).
+    """
+    bh, seq_len, d = q.shape
+    s = jnp.einsum(
+        "bqd,bkd->bqk", q.astype(jnp.float32) * scale, k.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    if causal:
+        pos = jnp.arange(seq_len)
+        s = jnp.where((pos[:, None] >= pos[None, :])[None], s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32)) / l
+    lse = (m + jnp.log(l))[..., 0]
+    return o.astype(q.dtype), lse
+
+
 def _flash_fwd(q, k, v, scale: float, causal: bool, block_q: int, block_k: int,
                interpret: Optional[bool]):
     """q,k,v: [BH, L, D] -> (o [BH, L, D], lse [BH, L])."""
+    if interpret is None and _use_interpret():
+        return _fwd_reference(q, k, v, scale, causal)
     bh, seq_len, d = q.shape
     qp = _pad_to(q, block_q, 1)
     kp = _pad_to(k, block_k, 1)
@@ -110,6 +136,11 @@ def _flash_fwd(q, k, v, scale: float, causal: bool, block_q: int, block_k: int,
     kern = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_k=block_k,
         seq_len=seq_len,
+    )
+    # under shard_map (check_vma) outputs must declare how they vary across
+    # mesh axes: they vary exactly as the union of the inputs
+    vma = frozenset().union(
+        *(getattr(jax.typeof(x), "vma", frozenset()) for x in (qp, kp, vp))
     )
     o, lse = pl.pallas_call(
         kern,
@@ -124,8 +155,8 @@ def _flash_fwd(q, k, v, scale: float, causal: bool, block_q: int, block_k: int,
             pl.BlockSpec((1, block_q, 128), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, lq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, lq, 128), jnp.float32),
+            jax.ShapeDtypeStruct((bh, lq, d), q.dtype, vma=vma),
+            jax.ShapeDtypeStruct((bh, lq, 128), jnp.float32, vma=vma),
         ],
         interpret=_use_interpret() if interpret is None else interpret,
     )(qp, kp, vp)
@@ -133,9 +164,14 @@ def _flash_fwd(q, k, v, scale: float, causal: bool, block_q: int, block_k: int,
 
 
 def _bwd_blocked(q, k, v, o, lse, g, scale: float, causal: bool,
-                 block_k: int):
+                 block_k: int, g_lse=None):
     """Rematerializing backward in XLA: scan over k/v blocks, never holding
-    the full [L, L] probability matrix (standard flash backward formula)."""
+    the full [L, L] probability matrix (standard flash backward formula).
+
+    `g_lse` is the cotangent of the log-sum-exp output when the caller
+    differentiates through it (ring attention's block merge does): since
+    d lse_q / d s_qk = p_qk, it folds into the delta term as
+    ds = p * (dp - (delta - g_lse))."""
     bh, seq_len, d = q.shape
     kp = _pad_to(k, block_k, 1)
     vp = _pad_to(v, block_k, 1)
@@ -145,6 +181,8 @@ def _bwd_blocked(q, k, v, o, lse, g, scale: float, causal: bool,
     gf = g.astype(jnp.float32)
     of = o.astype(jnp.float32)
     delta = jnp.sum(of * gf, axis=-1)  # [BH, L]
+    if g_lse is not None:
+        delta = delta - g_lse.astype(jnp.float32)
     q_pos = jnp.arange(seq_len)
 
     def one_block(j):
@@ -198,6 +236,28 @@ def _flash_bhld_bwd(scale, causal, block_q, block_k, interpret, res, g):
 _flash_bhld.defvjp(_flash_bhld_fwd, _flash_bhld_bwd)
 
 
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
+)
+def _flash_bhld_lse(q, k, v, scale, causal, block_q, block_k, interpret):
+    return _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+
+
+def _flash_bhld_lse_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return (o, lse), (q, k, v, o, lse)
+
+
+def _flash_bhld_lse_bwd(scale, causal, block_q, block_k, interpret, res, g):
+    q, k, v, o, lse = res
+    g_o, g_lse = g
+    return _bwd_blocked(q, k, v, o, lse, g_o, scale, causal, block_k,
+                        g_lse=g_lse)
+
+
+_flash_bhld_lse.defvjp(_flash_bhld_lse_fwd, _flash_bhld_lse_bwd)
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -225,3 +285,36 @@ def flash_attention(
         to_bhld(q), to_bhld(k), to_bhld(v), scale, causal, bq, bk, interpret
     )
     return o.reshape(b, h, l, d).transpose(0, 2, 1, 3)
+
+
+def flash_attention_with_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Fused attention also returning the log-sum-exp of each softmax row.
+
+    Returns (o [B, L, H, D] in q's dtype, lse [B, H, L] fp32).  The lse lets
+    callers merge attention over key/value blocks computed separately —
+    ring attention combines per-hop outputs as
+    o = sum_j exp(lse_j - logaddexp_j lse_j) * o_j — and it is
+    differentiable: the VJP folds the lse cotangent into the flash backward.
+    """
+    b, l, h, d = q.shape
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    bq = min(block_q, max(8, l))
+    bk = min(block_k, max(8, l))
+
+    def to_bhld(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, l, d)
+
+    o, lse = _flash_bhld_lse(
+        to_bhld(q), to_bhld(k), to_bhld(v), scale, causal, bq, bk, interpret
+    )
+    o = o.reshape(b, h, l, d).transpose(0, 2, 1, 3)
+    return o, lse.reshape(b, h, l)

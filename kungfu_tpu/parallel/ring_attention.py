@@ -51,6 +51,46 @@ def _block_attn(q, k, v, m, l, o, q_off, k_off, causal: bool, scale: float):
     return m_new, l_new, o_new
 
 
+def _merge_blocks(o1, lse1, o2, lse2):
+    """Combine two normalized attention outputs via their log-sum-exps.
+
+    o: [B, L, H, D] fp32 (already normalized per block); lse: [B, H, L].
+    """
+    lse = jnp.logaddexp(lse1, lse2)
+    w1 = jnp.exp(lse1 - lse).transpose(0, 2, 1)[..., None]
+    w2 = jnp.exp(lse2 - lse).transpose(0, 2, 1)[..., None]
+    return o1 * w1 + o2 * w2, lse
+
+
+def _block_attn_flash(q, k, v, mode, scale):
+    """Per-hop block compute on the Pallas flash kernel (ops/flash.py).
+
+    Ring blocks are all L_chunk long, so the causal structure per hop is one
+    of three whole-block cases decided by device index, never a dynamic
+    offset inside the kernel: `mode` 0 = fully masked (skip), 1 = fully
+    visible (non-causal kernel), 2 = diagonal (causal kernel).
+    Returns (o [B, Lq, H, D] fp32 normalized, lse [B, H, Lq]).
+    """
+    from ..ops.flash import flash_attention_with_lse
+
+    B, Lq, H, D = q.shape
+
+    def skip(q, k, v):
+        # derive from the operands so every switch branch agrees on vma types
+        z = jnp.zeros_like(q, jnp.float32) + (k[:, :1, :, :1] * 0 + v[:, :1, :, :1] * 0).astype(jnp.float32)
+        return z, z[:, :, :, 0].transpose(0, 2, 1) + NEG_INF
+
+    def full_blk(q, k, v):
+        o, lse = flash_attention_with_lse(q, k, v, causal=False, scale=scale)
+        return o.astype(jnp.float32), lse
+
+    def diag_blk(q, k, v):
+        o, lse = flash_attention_with_lse(q, k, v, causal=True, scale=scale)
+        return o.astype(jnp.float32), lse
+
+    return lax.switch(mode, (skip, full_blk, diag_blk), q, k, v)
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -58,12 +98,21 @@ def ring_attention(
     axis_name: str = "sp",
     causal: bool = True,
     scale: Optional[float] = None,
+    impl: Optional[str] = None,
 ) -> jax.Array:
     """Exact attention over a sequence sharded on `axis_name`.
 
     Shapes (per device): q, k, v: [B, L_chunk, H, D]; returns [B, L_chunk, H, D]
     in q's dtype.  Must be called inside shard_map with `axis_name` in scope.
+
+    `impl` selects the per-block compute: "flash" streams each hop's block
+    through the Pallas kernel (default on TPU), "einsum" is the plain-XLA
+    path (default elsewhere — the kernel would run interpreted).
     """
+    if impl is None:
+        impl = "flash" if jax.default_backend() == "tpu" else "einsum"
+    if impl == "flash":
+        return _ring_attention_flash(q, k, v, axis_name, causal, scale)
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     B, Lc, H, D = q.shape
@@ -98,6 +147,45 @@ def ring_attention(
     m, l, o = _block_attn(q, k_f, v_f, m, l, o, q_off, k_off_last, causal, scale)
     l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows (padding) stay 0
     return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+
+
+def _ring_attention_flash(q, k, v, axis_name, causal, scale):
+    """Ring rotation with the flash kernel as per-block compute: each hop's
+    normalized (o, lse) pair merges into the running pair (logaddexp), so
+    the accumulator math stays out of the kernel and stays differentiable
+    (the kernel's VJP handles the lse cotangent)."""
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    B, Lc, H, D = q.shape
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+
+    def mode_for(s):
+        if not causal:
+            return jnp.int32(1)
+        src = (idx - s) % n  # device the held block originated from
+        return jnp.where(src < idx, 1, jnp.where(src == idx, 2, 0)).astype(jnp.int32)
+
+    if n == 1:
+        o, lse = _block_attn_flash(q, k, v, mode_for(0), scale)
+        return o.astype(q.dtype)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    # derive accumulators from q so they inherit its varying-axes type
+    o0 = jnp.zeros_like(q, jnp.float32)
+    lse0 = o0[:, :, :, 0].transpose(0, 2, 1) + NEG_INF  # [B, H, Lc]
+
+    def hop(carry, s):
+        k_cur, v_cur, o, lse = carry
+        o_blk, lse_blk = _block_attn_flash(q, k_cur, v_cur, mode_for(s), scale)
+        o, lse = _merge_blocks(o, lse, o_blk, lse_blk)
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (k_nxt, v_nxt, o, lse), None
+
+    (k_f, v_f, o, lse), _ = lax.scan(hop, (k, v, o0, lse0), jnp.arange(n - 1))
+    o_blk, lse_blk = _block_attn_flash(q, k_f, v_f, mode_for(n - 1), scale)
+    o, _ = _merge_blocks(o, lse, o_blk, lse_blk)
+    return o.astype(q.dtype)
 
 
 def full_attention(q, k, v, causal: bool = True, scale: Optional[float] = None):

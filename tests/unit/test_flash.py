@@ -20,7 +20,7 @@ def _rand(b, l, h, d, dtype=jnp.float32, seed=0):
 @pytest.mark.parametrize("l", [64, 128, 192])
 def test_matches_reference(causal, l):
     q, k, v = _rand(2, l, 2, 32)
-    out = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64)
+    out = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64, interpret=True)
     ref = full_attention(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
@@ -28,14 +28,14 @@ def test_matches_reference(causal, l):
 def test_unpadded_lengths():
     """Sequence not a multiple of the block size: padded tail must not leak."""
     q, k, v = _rand(1, 100, 2, 32)
-    out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
+    out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64, interpret=True)
     ref = full_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
 def test_bf16_inputs():
     q, k, v = _rand(1, 128, 2, 32, dtype=jnp.bfloat16)
-    out = flash_attention(q, k, v, causal=True)
+    out = flash_attention(q, k, v, causal=True, interpret=True)
     ref = full_attention(q, k, v, causal=True)
     assert out.dtype == jnp.bfloat16
     np.testing.assert_allclose(
@@ -49,7 +49,7 @@ def test_gradients_match(causal):
 
     def f_flash(q, k, v):
         return jnp.sum(flash_attention(q, k, v, causal=causal,
-                                       block_q=32, block_k=32) ** 2)
+                                       block_q=32, block_k=32, interpret=True) ** 2)
 
     def f_ref(q, k, v):
         return jnp.sum(full_attention(q, k, v, causal=causal) ** 2)
@@ -62,7 +62,52 @@ def test_gradients_match(causal):
 
 def test_jit_and_scale():
     q, k, v = _rand(1, 64, 1, 16)
-    f = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=False, scale=0.5))
+    f = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=False, scale=0.5, interpret=True))
     out = f(q, k, v)
     ref = full_attention(q, k, v, causal=False, scale=0.5)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_lse_matches_reference(causal):
+    from kungfu_tpu.ops.flash import flash_attention_with_lse
+
+    q, k, v = _rand(2, 64, 2, 16, seed=5)
+    o, lse = flash_attention_with_lse(q, k, v, causal=causal, block_q=32, block_k=32, interpret=True)
+    # reference lse from the raw scores
+    scale = 1.0 / (16 ** 0.5)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((64, 64), bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+    ref_lse = jax.scipy.special.logsumexp(s, axis=-1)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse), atol=2e-4)
+    np.testing.assert_allclose(
+        np.asarray(o), np.asarray(full_attention(q, k, v, causal=causal)), atol=2e-5
+    )
+
+
+def test_lse_gradient():
+    """Differentiating THROUGH the lse output (the ring-merge path) must
+    agree with autodiff on the plain-XLA computation."""
+    from kungfu_tpu.ops.flash import flash_attention_with_lse
+
+    q, k, v = _rand(1, 48, 1, 16, seed=7)
+    scale = 1.0 / (16 ** 0.5)
+
+    def f_flash(q, k, v):
+        o, lse = flash_attention_with_lse(q, k, v, causal=False,
+                                          block_q=16, block_k=16, interpret=True)
+        return jnp.sum(o ** 2) + jnp.sum(jnp.sin(lse))
+
+    def f_ref(q, k, v):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        lse = jax.scipy.special.logsumexp(s, axis=-1)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+        return jnp.sum(o ** 2) + jnp.sum(jnp.sin(lse))
+
+    g_flash = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
