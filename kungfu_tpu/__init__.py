@@ -41,3 +41,16 @@ from .api import (  # noqa: F401
     get_variable,
     set_variable,
 )
+
+
+def __getattr__(name):
+    # lazy heavyweight exports (importing them pulls in jax at module scope)
+    if name == "FSDPTrainer":
+        from .fsdp import FSDPTrainer
+
+        return FSDPTrainer
+    if name == "DataParallelTrainer":
+        from .train import DataParallelTrainer
+
+        return DataParallelTrainer
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,269 @@
+"""FSDP — fully-sharded data parallelism over the `fsdp` mesh axis.
+
+The reference has no parameter sharding at all (its optimizers replicate the
+model on every worker); this is TPU-native capability backing the `fsdp`
+axis declared in plan/mesh.py.  The design is ZeRO-3 re-expressed the XLA
+way, inside the same shard_map-manual train step the DataParallelTrainer
+uses:
+
+  storage   every param / optimizer-state leaf lives as a flat, padded
+            chunk: logically `(n_fsdp, chunk)` sharded on dim 0, so each
+            device persistently holds 1/n of the model + optimizer state.
+  compute   the step all_gathers each param's chunks (tiled all_gather on
+            the fsdp axis rides ICI), reshapes to the original shape, and
+            runs forward/backward on full params.
+  gradients reduce_scatter (lax.psum_scatter) brings each device exactly
+            its chunk of the summed gradient — half the bytes of a full
+            all_reduce — then a pmean over `dp` if a replicated data axis
+            coexists (hybrid sharded DP).
+  update    the inner optax transform runs element-wise on chunks, so any
+            element-wise optimizer (sgd, momentum, adam, ...) works
+            unchanged and its state is sharded for free.
+
+The fsdp axis is also a data axis: each shard consumes a different slice of
+the batch (DATA_AXES in plan/mesh.py).  `FSDPTrainer` mirrors the
+DataParallelTrainer API so the two are drop-in interchangeable.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+from .plan import make_mesh
+from .train import TrainState, _put_global
+from .utils import get_logger
+
+log = get_logger("kungfu.fsdp")
+
+
+def _chunk(x: np.ndarray, n: int) -> np.ndarray:
+    """Flatten + zero-pad to a multiple of n -> (n, chunk)."""
+    flat = np.asarray(x).reshape(-1)
+    pad = (-flat.size) % n
+    if pad:
+        flat = np.concatenate([flat, np.zeros(pad, flat.dtype)])
+    return flat.reshape(n, -1)
+
+
+def _unchunk(c: np.ndarray, shape: Tuple[int, ...]) -> np.ndarray:
+    size = int(np.prod(shape)) if shape else 1
+    return np.asarray(c).reshape(-1)[:size].reshape(shape)
+
+
+class FSDPTrainer:
+    """Fully-sharded data-parallel trainer (same surface as DataParallelTrainer).
+
+    Args:
+      loss_fn: (params, batch) -> scalar loss for one shard's batch slice.
+      tx: element-wise optax transform (its state shards with the params).
+      mesh: mesh containing an `fsdp` axis (default: 1-D fsdp over all
+            devices); an additional `dp` axis gives hybrid sharded DP.
+      remat: rematerialize the forward so gathered full params are freed
+             after forward and re-gathered in backward (true ZeRO-3 memory;
+             costs one extra forward).
+    """
+
+    def __init__(
+        self,
+        loss_fn: Callable,
+        tx: optax.GradientTransformation,
+        mesh: Optional[Mesh] = None,
+        remat: bool = False,
+        donate: bool = True,
+    ):
+        self.loss_fn = loss_fn
+        self.tx = tx
+        self.mesh = mesh if mesh is not None else make_mesh(fsdp=-1)
+        if "fsdp" not in self.mesh.axis_names:
+            raise ValueError(f"mesh {self.mesh.axis_names} has no 'fsdp' axis")
+        self.n_shard = self.mesh.shape["fsdp"]
+        self.has_dp = "dp" in self.mesh.axis_names
+        self.data_axes = ("dp", "fsdp") if self.has_dp else ("fsdp",)
+        self.remat = remat
+        self._shapes: Any = None  # pytree of original param shapes
+        self._compiled_step: Optional[Callable] = None
+        self._build_step(donate)  # installs self._build
+
+    @property
+    def world(self) -> int:
+        n = 1
+        for a in self.data_axes:
+            n *= self.mesh.shape[a]
+        return n
+
+    # -- chunk layout -----------------------------------------------------------------
+
+    def _spec_for(self, leaf) -> P:
+        """Chunked leaves (n_fsdp, chunk) shard dim 0; scalars replicate."""
+        if getattr(leaf, "ndim", 0) >= 1 and leaf.shape[:1] == (self.n_shard,):
+            return P("fsdp")
+        return P()
+
+    def _state_specs(self, tree):
+        return jax.tree.map(self._spec_for, tree)
+
+    # -- step construction ------------------------------------------------------------
+
+    def _gather_params(self, chunks):
+        """Per-device chunk views -> full params (tiled all_gather on fsdp)."""
+        shapes = self._shapes
+
+        def gather(c, shape):
+            full = lax.all_gather(c.reshape(-1), "fsdp", tiled=True)
+            size = int(np.prod(shape)) if shape else 1
+            return full[:size].reshape(shape)
+
+        return jax.tree.map(gather, chunks, shapes)
+
+    def _scatter_grads(self, grads):
+        """Full grads -> this device's summed chunk (reduce_scatter)."""
+        n = self.n_shard
+
+        def scatter(g):
+            flat = g.reshape(-1)
+            pad = (-flat.size) % n
+            if pad:
+                flat = jnp.concatenate([flat, jnp.zeros(pad, flat.dtype)])
+            chunk = lax.psum_scatter(flat, "fsdp", scatter_dimension=0, tiled=True)
+            chunk = chunk / n
+            if self.has_dp:
+                chunk = lax.pmean(chunk, "dp")
+            return chunk
+
+        return jax.tree.map(scatter, grads)
+
+    def _build_step(self, donate: bool) -> Callable:
+        # NOTE on gradients: value_and_grad differentiates w.r.t. the chunk
+        # inputs THROUGH the all_gather — the autodiff transpose of a tiled
+        # all_gather is exactly psum_scatter, so grads arrive already
+        # reduce_scattered to this device's chunk; _scatter_grads is only
+        # exposed for callers composing manually.  The transpose SUMS the
+        # per-shard loss grads; S-SGD semantics average them (each shard's
+        # loss is the mean over its own batch slice), hence the /n below.
+        n_shard = self.n_shard
+
+        def build(params_template, opt_template):
+            param_spec = jax.tree.map(lambda _: P("fsdp", None), params_template)
+            opt_spec = self._state_specs(opt_template)
+
+            def squeeze_opt(o):
+                # sharded opt leaves arrive (1, chunk) per device; scalars whole
+                return jax.tree.map(
+                    lambda l, s: jnp.squeeze(l, 0) if s == P("fsdp") else l,
+                    o, opt_spec,
+                )
+
+            def expand_opt(o):
+                return jax.tree.map(
+                    lambda l, s: l[None] if s == P("fsdp") else l, o, opt_spec
+                )
+
+            def step(params, opt_state, batch):
+                chunks = jax.tree.map(lambda c: jnp.squeeze(c, 0), params)
+                opt_state = squeeze_opt(opt_state)
+
+                def compute_loss(ch, b):
+                    return self.loss_fn(self._gather_params(ch), b)
+
+                f = jax.checkpoint(compute_loss) if self.remat else compute_loss
+                loss, grads = jax.value_and_grad(f)(chunks, batch)
+                grads = jax.tree.map(
+                    lambda g: lax.pmean(g / n_shard, "dp") if self.has_dp
+                    else g / n_shard,
+                    grads,
+                )
+                updates, opt_state = self.tx.update(grads, opt_state, chunks)
+                chunks = optax.apply_updates(chunks, updates)
+                loss = lax.pmean(loss, self.data_axes)
+                return (
+                    jax.tree.map(lambda c: c[None], chunks),
+                    expand_opt(opt_state),
+                    {"loss": loss},
+                )
+
+            fn = _shard_map(
+                step,
+                mesh=self.mesh,
+                in_specs=(param_spec, opt_spec, P(self.data_axes)),
+                out_specs=(param_spec, opt_spec, P()),
+                check_vma=False,
+            )
+            return jax.jit(fn, donate_argnums=(0, 1) if donate else ())
+
+        self._build = build
+        return None
+
+    # -- host API ---------------------------------------------------------------------
+
+    def init(self, params: Any) -> TrainState:
+        """Chunk + shard host params, init sharded optimizer state."""
+        n = self.n_shard
+        self._shapes = jax.tree.map(lambda x: tuple(np.asarray(x).shape), params)
+        chunked = jax.tree.map(lambda x: _chunk(np.asarray(x), n), params)
+        opt_state = self.tx.init(
+            jax.tree.map(lambda c: jnp.asarray(c), chunked)
+        )
+        return self._place(chunked, opt_state)
+
+    def _place(self, chunked, opt_state, step: int = 0) -> TrainState:
+        pspec = NamedSharding(self.mesh, P("fsdp", None))
+
+        def place_param(c):
+            return _put_global(jnp.asarray(c), pspec)
+
+        def place_opt(leaf):
+            spec = self._spec_for(np.asarray(leaf))
+            return _put_global(jnp.asarray(leaf), NamedSharding(self.mesh, spec))
+
+        params = jax.tree.map(place_param, chunked)
+        opt_state = jax.tree.map(place_opt, opt_state)
+        if self._compiled_step is None:
+            self._compiled_step = self._build(params, opt_state)
+        return TrainState(params=params, opt_state=opt_state, step=step)
+
+    def place_state(self, params: Any, opt_state_full: Any = None, step: int = 0) -> TrainState:
+        """Checkpoint-restore path: full host params (+ optionally full
+        opt_state whose leaves mirror param shapes) -> sharded TrainState."""
+        n = self.n_shard
+        self._shapes = jax.tree.map(lambda x: tuple(np.asarray(x).shape), params)
+        chunked = jax.tree.map(lambda x: _chunk(np.asarray(x), n), params)
+        if opt_state_full is None:
+            opt_state = self.tx.init(jax.tree.map(lambda c: jnp.asarray(c), chunked))
+        else:
+            def conv(leaf):
+                a = np.asarray(leaf)
+                return _chunk(a, n) if a.ndim >= 1 else a
+
+            opt_state = jax.tree.map(conv, opt_state_full)
+        return self._place(chunked, opt_state, step)
+
+    def shard_batch(self, batch: Any) -> Any:
+        from .train import _put_local_shard
+
+        sharding = NamedSharding(self.mesh, P(self.data_axes))
+        return jax.tree.map(lambda x: _put_local_shard(x, sharding), batch)
+
+    def train_step(self, state: TrainState, batch: Any) -> Tuple[TrainState, Dict]:
+        params, opt_state, metrics = self._compiled_step(
+            state.params, state.opt_state, batch
+        )
+        return TrainState(params, opt_state, state.step + 1), metrics
+
+    def eval_params(self, state: TrainState) -> Any:
+        """Reassemble full params on host from the sharded chunks."""
+        return jax.tree.map(
+            lambda c, shape: _unchunk(np.asarray(c), shape),
+            state.params, self._shapes,
+        )

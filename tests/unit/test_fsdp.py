@@ -1,0 +1,126 @@
+"""FSDP trainer: correctness vs replicated DP, sharded-memory assertion,
+hybrid dp x fsdp mesh — on the 8-virtual-device CPU mesh."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from kungfu_tpu.fsdp import FSDPTrainer, _chunk, _unchunk
+from kungfu_tpu.models.slp import MLP, softmax_cross_entropy
+from kungfu_tpu.optimizers import synchronous_sgd
+from kungfu_tpu.plan import make_mesh
+from kungfu_tpu.train import DataParallelTrainer
+
+
+def _setup():
+    model = MLP(hidden=(32,), num_classes=10)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 8, 1)))["params"]
+
+    def loss_fn(p, batch):
+        images, labels = batch
+        return softmax_cross_entropy(model.apply({"params": p}, images), labels)
+
+    rng = np.random.RandomState(0)
+    batch = (
+        rng.randn(16, 8, 8, 1).astype(np.float32),
+        rng.randint(0, 10, size=16).astype(np.int32),
+    )
+    return params, loss_fn, batch
+
+
+def test_chunk_roundtrip():
+    rng = np.random.RandomState(1)
+    for shape in [(5,), (3, 7), (2, 3, 4), ()]:
+        x = np.asarray(rng.randn(*shape), np.float32)
+        c = _chunk(x, 8)
+        assert c.shape[0] == 8
+        np.testing.assert_array_equal(_unchunk(c, shape), x)
+
+
+@pytest.mark.parametrize("remat", [False, True], ids=["plain", "remat"])
+def test_matches_replicated_dp(remat):
+    """k steps of FSDP == k steps of replicated-DP S-SGD, same data."""
+    params, loss_fn, batch = _setup()
+    tx = optax.sgd(0.1, momentum=0.9)
+
+    dp = DataParallelTrainer(loss_fn, synchronous_sgd(tx), mesh=make_mesh(dp=8))
+    st_dp = dp.init(params)
+    b_dp = dp.shard_batch(batch)
+
+    fs = FSDPTrainer(loss_fn, tx, mesh=make_mesh(fsdp=8), remat=remat)
+    st_fs = fs.init(params)
+    b_fs = fs.shard_batch(batch)
+
+    for _ in range(3):
+        st_dp, m_dp = dp.train_step(st_dp, b_dp)
+        st_fs, m_fs = fs.train_step(st_fs, b_fs)
+        np.testing.assert_allclose(
+            float(np.asarray(m_dp["loss"])), float(np.asarray(m_fs["loss"])),
+            rtol=1e-5,
+        )
+
+    got = fs.eval_params(st_fs)
+    want = jax.tree.map(np.asarray, st_dp.params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-5),
+        got, want,
+    )
+
+
+def test_params_actually_sharded():
+    """Each device persistently holds ~1/n of params AND optimizer state."""
+    params, loss_fn, _ = _setup()
+    fs = FSDPTrainer(loss_fn, optax.sgd(0.1, momentum=0.9), mesh=make_mesh(fsdp=8))
+    st = fs.init(params)
+
+    for leaf in jax.tree.leaves(st.params):
+        shard = leaf.addressable_shards[0]
+        assert shard.data.size * 8 == leaf.size  # dim 0 split 8 ways
+    # momentum (trace) leaves shard the same way; scalar leaves replicate
+    chunked = [l for l in jax.tree.leaves(st.opt_state) if l.ndim >= 1]
+    assert chunked, "expected chunked optimizer-state leaves"
+    for leaf in chunked:
+        assert leaf.addressable_shards[0].data.size * 8 == leaf.size
+
+
+def test_hybrid_dp_fsdp():
+    """2-way replicated x 4-way sharded == pure DP."""
+    params, loss_fn, batch = _setup()
+    tx = optax.sgd(0.1)
+
+    dp = DataParallelTrainer(loss_fn, synchronous_sgd(tx), mesh=make_mesh(dp=8))
+    st_dp = dp.init(params)
+    b_dp = dp.shard_batch(batch)
+
+    fs = FSDPTrainer(loss_fn, tx, mesh=make_mesh(dp=2, fsdp=4))
+    st_fs = fs.init(params)
+    b_fs = fs.shard_batch(batch)
+
+    for _ in range(2):
+        st_dp, m_dp = dp.train_step(st_dp, b_dp)
+        st_fs, m_fs = fs.train_step(st_fs, b_fs)
+        np.testing.assert_allclose(
+            float(np.asarray(m_dp["loss"])), float(np.asarray(m_fs["loss"])),
+            rtol=1e-5,
+        )
+    got = fs.eval_params(st_fs)
+    want = jax.tree.map(np.asarray, st_dp.params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-5),
+        got, want,
+    )
+
+
+def test_place_state_restore():
+    """place_state(full params) reproduces init() (checkpoint-restore path)."""
+    params, loss_fn, batch = _setup()
+    fs = FSDPTrainer(loss_fn, optax.sgd(0.1), mesh=make_mesh(fsdp=8))
+    st = fs.init(params)
+    st2 = fs.place_state(fs.eval_params(st), step=5)
+    assert st2.step == 5
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        st.params, st2.params,
+    )
