@@ -51,12 +51,12 @@ def main():
 
         return SLP().init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))["params"]
 
-    def make_tx():
+    def make_tx(axes="dp", impl="pmean"):
         import optax
 
         from kungfu_tpu.optimizers import synchronous_sgd
 
-        return synchronous_sgd(optax.sgd(args.lr))
+        return synchronous_sgd(optax.sgd(args.lr), axis_name=axes, impl=impl)
 
     def make_data(rank, size, offset):
         import jax

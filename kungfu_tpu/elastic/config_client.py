@@ -84,9 +84,15 @@ def propose_new_size(peer, new_size: int) -> bool:
     if not url:
         raise RuntimeError("propose_new_size requires KFT_CONFIG_SERVER")
     client = ConfigClient(url)
-    got = client.get_cluster()
-    cluster, version = got if got is not None else (peer.config.cluster(), peer.cluster_version)
-    resized = cluster.resize(new_size)
-    ok = client.put_cluster(resized)
+    try:
+        got = client.get_cluster()
+        cluster, version = got if got is not None else (peer.config.cluster(), peer.cluster_version)
+        if cluster.size() == new_size:
+            return False  # already proposed (or applied): no spurious bump
+        resized = cluster.resize(new_size)
+        ok = client.put_cluster(resized)
+    except OSError as e:  # outage: drop the proposal, retry at next boundary
+        log.warning("propose_new_size: config server unreachable: %s", e)
+        return False
     log.info("proposed resize %d -> %d: %s", cluster.size(), new_size, "ok" if ok else "rejected")
     return ok

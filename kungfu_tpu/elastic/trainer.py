@@ -209,7 +209,11 @@ def run_elastic(
     Args:
       make_loss: () -> loss_fn(params, batch) (rebuilt after each remesh).
       init_params: () -> params pytree; deterministic across processes.
-      make_tx: () -> optax transform using axis name "dp".
+      make_tx: () -> optax transform using axis name "dp".  Declare a
+        parameter named `axes` (or `axis_name`) to receive the mesh's data
+        axes — required for the hierarchical dcn x ici mesh on multi-host
+        clusters — and optionally `impl` for the strategy-selected
+        reduction schedule.
       make_data: (rank, size, offset_samples) -> iterator of LOCAL batches.
       cfg: ElasticConfig.
 
@@ -223,11 +227,58 @@ def run_elastic(
     schedule = StepBasedSchedule(cfg.schedule)
     resizes = 0
 
-    def build():
-        from ..plan import make_mesh
+    import inspect
 
+    # opt-in by parameter NAME, not arity: a zero-arg-contract factory
+    # written as `def make_tx(lr=0.1)` must never receive an axis tuple
+    try:
+        _tx_names = set(inspect.signature(make_tx).parameters)
+    except (TypeError, ValueError):  # builtins / C callables
+        _tx_names = set()
+    _axes_kw = next((k for k in ("axes", "axis_name") if k in _tx_names), None)
+
+    def call_make_tx(axes, impl):
+        kw = {}
+        if _axes_kw is not None:
+            kw[_axes_kw] = axes
+        if "impl" in _tx_names:
+            kw["impl"] = impl
+        return make_tx(**kw)
+
+    def build():
+        """Mesh + trainer for the CURRENT cluster shape.
+
+        Mirrors Peer._build_session (peer.py): multi-host clusters with
+        several devices per host get the hierarchical dcn x ici mesh so
+        gradient collectives ride ICI within a host and only the cross-host
+        phase touches DCN (reference cross-strategies, session/strategy.go:
+        188-210).  The configured Strategy picks the in-step reduction
+        schedule.  A make_tx that takes no axis argument can only reduce
+        over "dp", so it pins the flat mesh (compatibility path).
+        """
+        import jax
+
+        from ..plan import Impl, impl_of, make_mesh, make_hierarchical_mesh
+
+        host_count = peer.host_count
+        devices_per_host = max(1, len(jax.devices()) // host_count)
+        if host_count > 1 and devices_per_host > 1 and _axes_kw is not None:
+            mesh = make_hierarchical_mesh(host_count)
+            axes: Any = ("dcn", "ici")
+        else:
+            mesh = make_mesh(dp=-1)
+            axes = "dp"
+        impl = {
+            Impl.HIERARCHICAL: "hierarchical",
+            Impl.RS_AG: "rs_ag",
+            Impl.RING: "ring",
+        }.get(impl_of(peer.config.strategy, host_count), "pmean")
+        if impl == "hierarchical" and axes == "dp":
+            impl = "pmean"  # no dcn/ici split on a flat mesh
+        if impl == "ring" and isinstance(axes, tuple):
+            impl = "rs_ag"
         trainer = DataParallelTrainer(
-            make_loss(), make_tx(), mesh=make_mesh(dp=-1),
+            make_loss(), call_make_tx(axes, impl), mesh=mesh, axis_name=axes,
             per_replica_params=cfg.per_replica,
         )
         return trainer, _MeshPrograms(trainer)
@@ -299,7 +350,10 @@ def run_elastic(
                 reference's consensus-on-cluster-bytes semantics: all workers
                 are guaranteed to hold the *same document*, not just the same
                 version number, before anyone acts."""
-                got = client.get_cluster()
+                try:
+                    got = client.get_cluster()
+                except OSError:  # config-server outage/restart mid-poll:
+                    got = None   # no new config visible; keep training
                 if got is None:
                     return peer.cluster_version, 0
                 last_got["cluster"], last_got["version"] = got

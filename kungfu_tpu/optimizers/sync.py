@@ -32,11 +32,47 @@ def _tree_pmean(tree, axis_name: AxisName):
     return jax.tree.map(lambda g: lax.pmean(g, axis_name), tree)
 
 
-def all_reduce_gradients(axis_name: AxisName = "dp") -> optax.GradientTransformation:
+def _mean_reducer(axis_name: AxisName, impl: str):
+    """Gradient-mean over the data axes using a named strategy implementation.
+
+    The runtime-strategy analog inside the compiled step (the Session handles
+    host-level ops; this handles the in-step gradient path): "pmean" lets
+    XLA pick, "rs_ag"/"ring" force the phased/ring schedules, and
+    "hierarchical" needs axis_name == (dcn, ici) — ici reduce-scatter, dcn
+    psum, ici all-gather (ops/collective.py:115-135).
+    """
+    if impl == "pmean":
+        return lambda g: lax.pmean(g, axis_name)
+
+    def world():
+        return C._axis_size(axis_name)
+
+    if impl == "hierarchical":
+        if not (isinstance(axis_name, (tuple, list)) and len(axis_name) == 2):
+            raise ValueError(
+                f"hierarchical reduction needs (dcn, ici) axes, got {axis_name!r}"
+            )
+        dcn, ici = axis_name
+        return lambda g: C.hierarchical_all_reduce(g, ici, dcn) / world()
+    if impl == "rs_ag":
+        return lambda g: C.rs_ag_all_reduce(g, axis_name) / world()
+    if impl == "ring":
+        if isinstance(axis_name, (tuple, list)):
+            raise ValueError("ring reduction needs a single axis")
+        return lambda g: C.ring_all_reduce(g, axis_name) / world()
+    raise ValueError(f"unknown reduce impl {impl!r}")
+
+
+def all_reduce_gradients(
+    axis_name: AxisName = "dp", impl: str = "pmean"
+) -> optax.GradientTransformation:
     """Gradient-averaging transform: the core of S-SGD (sync_sgd.py:81-112).
 
     Equivalent to the reference's group_all_reduce(grads) + /np.  Stateless.
+    `impl` selects the collective schedule (see _mean_reducer) — the in-step
+    analog of the reference's swappable allreduce strategies.
     """
+    reducer = _mean_reducer(axis_name, impl)
 
     def init_fn(params):
         del params
@@ -44,13 +80,15 @@ def all_reduce_gradients(axis_name: AxisName = "dp") -> optax.GradientTransforma
 
     def update_fn(updates, state, params=None):
         del params
-        return _tree_pmean(updates, axis_name), state
+        return jax.tree.map(reducer, updates), state
 
     return optax.GradientTransformation(init_fn, update_fn)
 
 
 def synchronous_sgd(
-    inner: optax.GradientTransformation, axis_name: AxisName = "dp"
+    inner: optax.GradientTransformation,
+    axis_name: AxisName = "dp",
+    impl: str = "pmean",
 ) -> optax.GradientTransformation:
     """SynchronousSGDOptimizer: average grads across the mesh, then `inner`.
 
@@ -58,7 +96,7 @@ def synchronous_sgd(
     every worker applies the same averaged gradient, so parameters stay
     bitwise identical across replicas.
     """
-    return optax.chain(all_reduce_gradients(axis_name), inner)
+    return optax.chain(all_reduce_gradients(axis_name, impl=impl), inner)
 
 
 class SMAState(NamedTuple):

@@ -28,6 +28,31 @@ from .utils import get_logger, stall_detector
 log = get_logger("kungfu.peer")
 
 COORDINATOR_PORT_OFFSET = 20000
+# versions cycle through a fixed window of ports: long-running elastic jobs
+# bump the cluster version unboundedly, and port+20000+version would walk
+# past 65535 (or into other services' ranges).  The window only needs to
+# fence CONSECUTIVE versions from each other — a stale peer is at most a few
+# versions behind — so a modest cycle is safe, and the wrap stays clear of
+# the Linux ephemeral range (32768+) for default worker ports (10000-10999:
+# coordinators at 30000-30999 + window).
+COORDINATOR_PORT_WINDOW = 1000
+
+
+def coordinator_port(root_port: int, cluster_version: int) -> int:
+    """Version-fenced jax.distributed coordinator port, bounded and cyclic.
+
+    The range check covers the WHOLE window, not the current version, so a
+    borderline root port fails at startup instead of hours into an elastic
+    job when the version modulo climbs.
+    """
+    if not (0 < root_port + COORDINATOR_PORT_OFFSET + COORDINATOR_PORT_WINDOW - 1 <= 65535):
+        raise ValueError(
+            f"worker port {root_port} leaves no room for the coordinator "
+            f"window (+{COORDINATOR_PORT_OFFSET}+{COORDINATOR_PORT_WINDOW} "
+            f"exceeds 65535); pick worker ports <= "
+            f"{65535 - COORDINATOR_PORT_OFFSET - COORDINATOR_PORT_WINDOW + 1}"
+        )
+    return root_port + COORDINATOR_PORT_OFFSET + (cluster_version % COORDINATOR_PORT_WINDOW)
 
 
 class Peer:
@@ -95,8 +120,7 @@ class Peer:
             self._ensure_store()
         from .monitor import maybe_start_monitor
 
-        bind = "127.0.0.1" if self.config.single_machine else "0.0.0.0"
-        self._monitor = maybe_start_monitor(self.self_id.port, host=bind)
+        self._monitor = maybe_start_monitor(self.self_id.port, host=self._bind_host())
         self._started = True
         log.info(
             "peer up: rank %d/%d local %d/%d hosts %d version %d",
@@ -105,10 +129,23 @@ class Peer:
         )
         return self
 
+    def _bind_host(self) -> str:
+        """Listen address for this peer's servers (store, monitor).
+
+        Loopback-alias "hosts" on one machine (127.0.0.1 vs 127.0.0.2, the
+        multi-host test shape) must each bind their OWN alias — 0.0.0.0
+        would collide on the shared port space.  Real deployments may list
+        hosts by an address the machine cannot bind (NAT, Docker published
+        port, LB DNS name), so everything else binds 0.0.0.0.
+        """
+        if self.config.single_machine:
+            return "127.0.0.1"
+        host = self.self_id.host
+        return host if host.startswith("127.") else "0.0.0.0"
+
     def _coordinator_address(self) -> str:
         root = self.config.peers[0]
-        port = root.port + COORDINATOR_PORT_OFFSET + self.cluster_version
-        return f"{root.host}:{port}"
+        return f"{root.host}:{coordinator_port(root.port, self.cluster_version)}"
 
     def _init_distributed(self) -> None:
         """Join the jax.distributed coordination service (multi-process).
@@ -158,9 +195,8 @@ class Peer:
         from .store import StoreClient, StoreServer, store_port
 
         if self._store_server is None:
-            bind = "0.0.0.0" if not self.config.single_machine else "127.0.0.1"
             self._store_server = StoreServer(
-                host=bind, port=store_port(self.self_id.port)
+                host=self._bind_host(), port=store_port(self.self_id.port)
             ).start()
             self._store_client = StoreClient()
         return self._store_server, self._store_client

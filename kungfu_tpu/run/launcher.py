@@ -168,6 +168,8 @@ class WatchRunner:
         )
         self.version = -1
         self._chip_of: Dict[PeerID, int] = {}
+        self._last_want = -1  # local workers wanted at last reconcile
+        self._idle_misses = 0
 
     def _spawn(self, peer: PeerID, cluster: Cluster, version: int) -> None:
         chip = self.pool.get() if self.pool else -1
@@ -195,6 +197,7 @@ class WatchRunner:
         for peer in sorted(want - have):
             self._spawn(peer, cluster, version)
         self.version = version
+        self._last_want = len(want)
 
     def run(self, initial: Optional[Cluster] = None, timeout_s: float = 0.0) -> int:
         t0 = time.monotonic()
@@ -226,8 +229,23 @@ class WatchRunner:
                             self.shutdown()
                             return rc
                 if not self.current and self.version >= 0:
-                    log.info("all workers exited")
-                    return 0
+                    if getattr(self, "_last_want", 1) > 0:
+                        log.info("all workers exited")
+                        return 0
+                    # this host was shrunk to zero workers: the job continues
+                    # elsewhere and a future version may regrow us (the
+                    # reference watcher keeps waiting for Stage updates,
+                    # watch.go:106-135).  The job's end is signalled by the
+                    # config server going away (the runner embedding it stops
+                    # it on exit); a long miss threshold rides out transient
+                    # restarts (which must not permanently remove this host).
+                    if got is None:
+                        self._idle_misses += 1
+                        if self._idle_misses * self.poll_s >= 60.0:
+                            log.info("idle host: config server gone; exiting")
+                            return 0
+                    else:
+                        self._idle_misses = 0
                 if timeout_s and time.monotonic() - t0 > timeout_s:
                     log.error("watch timeout after %.0fs", timeout_s)
                     self.shutdown()

@@ -42,12 +42,12 @@ def main(argv=None) -> int:
 
         return {"w": jnp.zeros((args.dim,), jnp.float32)}
 
-    def make_tx():
+    def make_tx(axes="dp", impl="pmean"):
         import optax
 
         from ..optimizers import synchronous_sgd
 
-        return synchronous_sgd(optax.sgd(0.1))
+        return synchronous_sgd(optax.sgd(0.1), axis_name=axes, impl=impl)
 
     def make_data(rank, size, offset):
         import numpy as np
@@ -68,10 +68,12 @@ def main(argv=None) -> int:
             check_every=args.check_every,
         ),
     )
+    mesh = out["trainer"].mesh
+    mesh_desc = ",".join(f"{a}:{mesh.shape[a]}" for a in mesh.axis_names)
     print(
         f"RESULT: fake-adaptive trained={out['trained_samples']} "
         f"resizes={out['resizes']} final_size={out['final_size']} "
-        f"loss={out['loss']:.4f}",
+        f"mesh={mesh_desc} loss={out['loss']:.4f}",
         flush=True,
     )
     return 0

@@ -86,6 +86,57 @@ class TestSynchronousSGD:
         np.testing.assert_allclose(wf[0], data.mean(axis=0), rtol=1e-4, atol=1e-5)
 
 
+class TestReduceImpls:
+    """Every strategy-selected reduction schedule equals plain pmean
+    (the in-step analog of the reference's swappable allreduce strategies)."""
+
+    @pytest.mark.parametrize("impl", ["rs_ag", "ring"])
+    def test_flat_axis_impls(self, mesh, impl):
+        tx = synchronous_sgd(optax.sgd(0.5), axis_name="dp", impl=impl)
+        ref = synchronous_sgd(optax.sgd(0.5), axis_name="dp", impl="pmean")
+        data = np.random.RandomState(1).randn(N, 5).astype(np.float32)
+        w0 = np.zeros((N, 5), np.float32)
+
+        def step(t):
+            def body(w, d):
+                state = t.init(w[0])
+                g = quad_grads(w[0], d[0])
+                u, _ = t.update(g, state, w[0])
+                return (w[0] + u)[None]
+
+            return np.asarray(run_spmd(mesh, body, w0, data))
+
+        np.testing.assert_allclose(step(tx), step(ref), rtol=1e-5)
+
+    def test_hierarchical_on_dcn_ici(self):
+        from kungfu_tpu.plan import make_hierarchical_mesh
+
+        hmesh = make_hierarchical_mesh(2)
+        axes = ("dcn", "ici")
+        tx = synchronous_sgd(optax.sgd(0.5), axis_name=axes, impl="hierarchical")
+        ref = synchronous_sgd(optax.sgd(0.5), axis_name=axes, impl="pmean")
+        data = np.random.RandomState(2).randn(N, 5).astype(np.float32)
+        w0 = np.zeros((N, 5), np.float32)
+
+        def step(t):
+            def body(w, d):
+                state = t.init(w[0])
+                g = quad_grads(w[0], d[0])
+                u, _ = t.update(g, state, w[0])
+                return (w[0] + u)[None]
+
+            f = shard_map(body, mesh=hmesh, in_specs=P(axes), out_specs=P(axes))
+            return np.asarray(jax.jit(f)(w0, data))
+
+        np.testing.assert_allclose(step(tx), step(ref), rtol=1e-5)
+
+    def test_bad_impl_raises(self):
+        with pytest.raises(ValueError):
+            synchronous_sgd(optax.sgd(0.1), axis_name="dp", impl="bogus")
+        with pytest.raises(ValueError):
+            synchronous_sgd(optax.sgd(0.1), axis_name="dp", impl="hierarchical")
+
+
 class TestSMA:
     def test_pulls_toward_average(self, mesh):
         tx = synchronous_averaging(optax.sgd(0.0), axis_name="dp", alpha=0.1)

@@ -1,0 +1,36 @@
+"""Coordinator/store port derivation: bounded, cyclic, collision-free for
+consecutive versions (VERDICT: the old +version arithmetic walked past 65535
+on long-running elastic jobs)."""
+import pytest
+
+from kungfu_tpu.peer import (
+    COORDINATOR_PORT_WINDOW,
+    coordinator_port,
+)
+from kungfu_tpu.store import store_port
+
+
+def test_in_range_for_many_versions():
+    for v in range(0, 5000, 7):
+        p = coordinator_port(10000, v)
+        assert 0 < p <= 65535
+        assert p >= 30000  # clear of worker (10000+) and store (25000+) ports
+
+
+def test_consecutive_versions_get_distinct_ports():
+    # fencing only needs NEIGHBORING versions to differ (a stale peer is at
+    # most a few versions behind)
+    for v in range(0, 3 * COORDINATOR_PORT_WINDOW, 97):
+        assert coordinator_port(10000, v) != coordinator_port(10000, v + 1)
+        assert coordinator_port(10000, v) != coordinator_port(10000, v + 2)
+
+
+def test_cycles_instead_of_overflowing():
+    assert coordinator_port(10000, 0) == coordinator_port(10000, COORDINATOR_PORT_WINDOW)
+
+
+def test_rejects_out_of_range_root_port():
+    with pytest.raises(ValueError):
+        coordinator_port(60000, 0)
+    with pytest.raises(ValueError):
+        store_port(60000)
