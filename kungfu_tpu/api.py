@@ -140,6 +140,15 @@ def minimum_spanning_tree(latencies) -> list:
     return mst(latencies)
 
 
+def get_neighbour_mask(father) -> list:
+    """This peer's neighbour mask in the (father-array) tree — reference
+    GetNeighbourMask op (cpu/topology.cpp:154-192); pair with
+    plan.RoundRobinSelector to cycle gossip partners over the MST."""
+    from .plan import mst_neighbour_mask
+
+    return mst_neighbour_mask(father, default_peer().rank)
+
+
 def set_tree(forest) -> None:
     """Adopt an explicit bcast tree for subsequent collectives (reference
     SetTree op; see Session.set_tree for the XLA mapping).  Collective in

@@ -18,6 +18,9 @@ from .graph import (
     gen_circular_graph_pair,
     gen_default_reduce_graph,
     minimum_spanning_tree,
+    neighbour_mask,
+    mst_neighbour_mask,
+    RoundRobinSelector,
 )
 from .strategy import Strategy, Impl, DEFAULT_STRATEGY, resolve_auto, impl_of, strategy_graphs
 from .mesh import (
@@ -36,6 +39,7 @@ __all__ = [
     "Graph", "gen_tree", "gen_binary_tree", "gen_star_bcast_graph",
     "gen_binary_tree_star", "gen_multi_binary_tree_star",
     "gen_circular_graph_pair", "gen_default_reduce_graph", "minimum_spanning_tree",
+    "neighbour_mask", "mst_neighbour_mask", "RoundRobinSelector",
     "Strategy", "Impl", "DEFAULT_STRATEGY", "resolve_auto", "impl_of", "strategy_graphs",
     "MeshSpec", "make_mesh", "make_hierarchical_mesh", "data_sharding",
     "replicated", "mesh_digest", "AXIS_ORDER",

@@ -239,6 +239,53 @@ def gen_clique_graph_pairs(n: int) -> List[Tuple[Graph, Graph]]:
     return out
 
 
+def neighbour_mask(
+    edges: Sequence[Tuple[int, int]], self_rank: int, size: int
+) -> List[bool]:
+    """Boolean mask of peers adjacent to `self_rank` in an edge list.
+
+    Reference GetNeighbourMask (srcs/cpp/src/tensorflow/ops/cpu/topology.cpp:
+    154-192): given the MST's (size-1, 2) edge list, mark every peer sharing
+    an edge with self — the candidate set for topology-aware gossip.
+    """
+    if not (0 <= self_rank < size):
+        raise ValueError(f"self_rank {self_rank} not in [0, {size})")
+    mask = [False] * size
+    for u, v in edges:
+        if u == self_rank:
+            mask[v] = True
+        if v == self_rank:
+            mask[u] = True
+    return mask
+
+
+def mst_neighbour_mask(father: Sequence[int], self_rank: int) -> List[bool]:
+    """neighbour_mask for a father-array tree (minimum_spanning_tree output)."""
+    edges = [(father[v], v) for v in range(len(father)) if father[v] != v]
+    return neighbour_mask(edges, self_rank, len(father))
+
+
+class RoundRobinSelector:
+    """Stateful cyclic chooser over a boolean mask.
+
+    Reference RoundRobin op (cpu/topology.cpp:196-230): each call returns the
+    next true index after the previous pick, cycling; -1 if the mask is all
+    false.  Host-side state, like the reference's per-kernel `pos_`.
+    """
+
+    def __init__(self):
+        self._pos = 0
+
+    def __call__(self, mask: Sequence[bool]) -> int:
+        n = len(mask)
+        for i in range(n):
+            idx = (self._pos + i) % n
+            if mask[idx]:
+                self._pos = (idx + 1) % n
+                return idx
+        return -1
+
+
 def minimum_spanning_tree(latency: Sequence[Sequence[float]]) -> List[int]:
     """Prim's MST over a symmetric latency matrix -> father array.
 

@@ -182,6 +182,32 @@ class TestGraph:
         assert (0, 2) not in g.edges() and (2, 0) not in g.edges()
         assert g.reverse().is_valid_tree() or g.is_valid_tree()
 
+    def test_neighbour_mask(self):
+        from kungfu_tpu.plan import neighbour_mask, mst_neighbour_mask
+
+        # path 0-1-2-3 (reference GetNeighbourMask semantics)
+        edges = [(0, 1), (1, 2), (2, 3)]
+        assert neighbour_mask(edges, 0, 4) == [False, True, False, False]
+        assert neighbour_mask(edges, 1, 4) == [True, False, True, False]
+        assert neighbour_mask(edges, 3, 4) == [False, False, True, False]
+        with pytest.raises(ValueError):
+            neighbour_mask(edges, 4, 4)
+        # father array for the same path: father = [0, 0, 1, 2]
+        assert mst_neighbour_mask([0, 0, 1, 2], 1) == [True, False, True, False]
+
+    def test_round_robin_selector(self):
+        from kungfu_tpu.plan import RoundRobinSelector
+
+        rr = RoundRobinSelector()
+        mask = [False, True, False, True]
+        assert [rr(mask) for _ in range(4)] == [1, 3, 1, 3]
+        assert rr([False, False]) == -1
+        # picks resume after the last choice (reference pos_ state)
+        rr2 = RoundRobinSelector()
+        assert rr2([True, True, True]) == 0
+        assert rr2([True, True, True]) == 1
+        assert rr2([False, True, True]) == 2
+
 
 class TestStrategy:
     def test_parse(self):
