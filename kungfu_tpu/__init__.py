@@ -53,4 +53,8 @@ def __getattr__(name):
         from .train import DataParallelTrainer
 
         return DataParallelTrainer
+    if name == "MeshTrainer":
+        from .trainer import MeshTrainer
+
+        return MeshTrainer
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

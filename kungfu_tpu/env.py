@@ -68,6 +68,21 @@ class Config:
         return Cluster(runners=self.runners, workers=self.peers)
 
 
+def apply_platform_override() -> None:
+    """Honor an explicit non-TPU JAX_PLATFORMS request (e.g. cpu).
+
+    The TPU tunnel's sitecustomize forces jax_platforms via jax.config in
+    every process, so the env var alone is not enough — scripts that want
+    the virtual CPU mesh must route through jax.config too.  Call before
+    any backend use.
+    """
+    plat = os.environ.get("JAX_PLATFORMS", "")
+    if plat and "axon" not in plat and "tpu" not in plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+
+
 def _parse_peers(s: str) -> PeerList:
     return PeerList(PeerID.parse(x) for x in s.split(",") if x)
 

@@ -1,0 +1,97 @@
+"""MeshTrainer (public multi-axis trainer): sharded steps must match the
+unsharded single-device computation, across dp x tp, dp x sp, and ep meshes."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from kungfu_tpu.models.transformer import (
+    TransformerConfig, TransformerLM, lm_loss,
+)
+from kungfu_tpu.plan import MeshSpec, make_mesh
+from kungfu_tpu.trainer import MeshTrainer
+
+
+def _loss_fn(model, params, toks):
+    return lm_loss(model.apply({"params": params}, toks), toks)
+
+
+def _cfg(mesh=None, **kw):
+    base = dict(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+        max_len=32, dtype=jnp.float32, mesh=mesh,
+    )
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def _tokens(batch=4):
+    return np.random.RandomState(0).randint(0, 64, size=(batch, 32)).astype(np.int32)
+
+
+def _baseline(cfg_kw, tokens, steps=2):
+    """Unsharded single-device reference run."""
+    model = TransformerLM(_cfg(**cfg_kw))
+    import flax.linen as nn
+
+    params = nn.meta.unbox(model.init(jax.random.PRNGKey(0), tokens)["params"])
+    tx = optax.sgd(0.05)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(p, s):
+        loss, g = jax.value_and_grad(lambda pp: _loss_fn(model, pp, tokens))(p)
+        u, s = tx.update(g, s, p)
+        return optax.apply_updates(p, u), s, loss
+
+    for _ in range(steps):
+        params, opt, loss = step(params, opt)
+    return float(loss)
+
+
+@pytest.mark.parametrize(
+    "spec", [dict(dp=2, tp=4), dict(dp=4, sp=2), dict(dp=8)],
+    ids=["dp2xtp4", "dp4xsp2", "dp8"],
+)
+def test_matches_unsharded(spec):
+    tokens = _tokens(8)
+    mesh = make_mesh(MeshSpec.make(**spec))
+    kw = {}
+    if spec.get("sp", 1) > 1:
+        kw["attention"] = "ring"
+    model = TransformerLM(_cfg(mesh=mesh, **kw))
+    trainer = MeshTrainer(model, _loss_fn, optax.sgd(0.05), mesh=mesh)
+    state = trainer.init(jax.random.PRNGKey(0), tokens)
+    batch = trainer.shard_batch(tokens)
+    for _ in range(2):
+        state, metrics = trainer.train_step(state, batch)
+    got = float(np.asarray(metrics["loss"]))
+    want = _baseline(kw, tokens, steps=2)
+    assert np.isclose(got, want, rtol=2e-4), (got, want)
+
+
+def test_params_actually_sharded_on_tp():
+    tokens = _tokens(4)
+    mesh = make_mesh(MeshSpec.make(dp=2, tp=4))
+    model = TransformerLM(_cfg(mesh=mesh))
+    trainer = MeshTrainer(model, _loss_fn, optax.sgd(0.05), mesh=mesh)
+    state = trainer.init(jax.random.PRNGKey(0), tokens)
+    # at least one param leaf (mlp/vocab kernels) is split over tp
+    sharded = [
+        l for l in jax.tree.leaves(state.params)
+        if l.addressable_shards[0].data.size < l.size
+    ]
+    assert sharded, "expected tp-sharded kernels"
+    # optimizer state (momentum-free sgd has none) still placed fine
+    state, metrics = trainer.train_step(state, trainer.shard_batch(tokens))
+    assert np.isfinite(float(np.asarray(metrics["loss"])))
+
+
+def test_requires_init_before_step():
+    mesh = make_mesh(MeshSpec.make(dp=8))
+    model = TransformerLM(_cfg(mesh=mesh))
+    trainer = MeshTrainer(model, _loss_fn, optax.sgd(0.05), mesh=mesh)
+    with pytest.raises(RuntimeError):
+        trainer.train_step(None, None)
