@@ -72,14 +72,16 @@ class InterferenceDetector:
         same point (it contains a collective) — same contract as the
         reference's CheckInterference op.
         """
-        import jax.numpy as jnp
-
         n = self.session.size
-        vote = 1.0 if self.local_vote() else 0.0
+        vote = np.asarray([1.0 if self.local_vote() else 0.0], np.float32)
+        # lift, don't broadcast a full (n, 1) array: under the launcher each
+        # process must contribute ITS OWN vote row (a full array would count
+        # one peer's vote n times in single-controller and is not even
+        # well-defined multi-controller)
         votes = self.session.all_reduce(
-            jnp.full((n, 1), vote, jnp.float32), name="interference-vote"
+            self.session.lift(vote), name="interference-vote"
         )
-        total = float(np.asarray(votes)[0, 0])
+        total = float(self.session.local_row(votes)[0])
         if total <= n / 2:
             return False
         nxt = self._next_strategy()

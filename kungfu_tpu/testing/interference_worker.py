@@ -1,0 +1,70 @@
+"""``python -m kungfu_tpu.testing.interference_worker`` — interference e2e drill.
+
+Reference behavior being replayed (session/adaptiveStrategies.go:61-123 +
+monitoring.go:15-36): every worker monitors collective throughput; when a
+worker's throughput drops below 0.8x its best, it votes; a majority vote
+(summed by an allreduce) makes EVERY worker rotate to the next strategy in
+lockstep.
+
+The drill: all workers hammer a named allreduce.  After `--slow-from`
+iterations, ONE worker (--slow-rank) sleeps before each collective —
+because collectives are synchronous, every peer's measured collective time
+inflates (the XLA-era analog of a congested link), all peers vote, and the
+cluster rotates together.  Run under the launcher::
+
+    python -m kungfu_tpu.run -np 4 -platform cpu -- \
+        python -m kungfu_tpu.testing.interference_worker --slow-rank 2
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="kungfu_tpu.testing.interference_worker")
+    ap.add_argument("--iters", type=int, default=40)
+    ap.add_argument("--size", type=int, default=1 << 16, help="floats per allreduce")
+    ap.add_argument("--slow-rank", type=int, default=0)
+    ap.add_argument("--slow-from", type=int, default=12)
+    ap.add_argument("--slow-ms", type=float, default=60.0)
+    ap.add_argument("--check-every", type=int, default=4)
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    import kungfu_tpu
+
+    peer = kungfu_tpu.init()
+    sess = peer.current_session()
+    det = peer.interference_detector()
+
+    rng = np.random.RandomState(peer.rank)
+    x = rng.randn(args.size).astype(np.float32)
+    lifted = sess.lift(x)
+
+    switches = 0
+    for i in range(args.iters):
+        if peer.rank == args.slow_rank and i >= args.slow_from:
+            time.sleep(args.slow_ms / 1e3)  # injected congestion
+        sess.all_reduce(lifted, name="drill")
+        det.observe()
+        if (i + 1) % args.check_every == 0:
+            if det.check():
+                switches += 1
+                print(f"SWITCHED: iter={i} to={sess.strategy.name}", flush=True)
+            # windowed throughput: each vote window stands on its own
+            # samples, so the post-switch reference is not diluted by
+            # pre-switch timings
+            sess.stats.reset()
+
+    print(
+        f"RESULT: interference switches={switches} final={sess.strategy.name}",
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

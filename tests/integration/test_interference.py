@@ -1,0 +1,41 @@
+"""Interference e2e drill: an injected slowdown on one worker must flip the
+cluster-majority vote and rotate EVERY worker's strategy in lockstep
+(reference session/adaptiveStrategies.go:61-123 wired into monitored
+collectives; VERDICT r1: this flow was unit-tested only)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.mark.slow
+class TestInterferenceE2E:
+    def test_slowdown_rotates_all_workers(self):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env.pop("XLA_FLAGS", None)
+        env.pop("JAX_PLATFORMS", None)
+        r = subprocess.run(
+            [sys.executable, "-m", "kungfu_tpu.run", "-np", "4",
+             "-platform", "cpu", "--", sys.executable, "-m",
+             "kungfu_tpu.testing.interference_worker",
+             "--slow-rank", "2", "--slow-from", "12", "--iters", "40"],
+            capture_output=True, text=True, timeout=420, env=env, cwd=REPO,
+        )
+        out = r.stdout
+        assert r.returncode == 0, out[-4000:] + r.stderr[-2000:]
+        results = [l for l in out.splitlines() if "RESULT:" in l]
+        assert len(results) == 4, out[-4000:]
+        finals = set()
+        for line in results:
+            n = int(line.split("switches=")[1].split()[0])
+            assert n >= 1, line  # every worker switched at least once
+            finals.add(line.split("final=")[1].strip())
+        # lockstep: every worker lands on the SAME strategy
+        assert len(finals) == 1, results
+        # and it moved off the default
+        switched_lines = [l for l in out.splitlines() if "SWITCHED:" in l]
+        assert len(switched_lines) >= 4, out[-4000:]
