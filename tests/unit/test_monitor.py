@@ -102,6 +102,12 @@ class _FakeSession:
     def all_reduce(self, x, name=""):
         return self._real.all_reduce(x, name=name)
 
+    def lift(self, value):
+        return self._real.lift(value)
+
+    def local_row(self, stacked):
+        return self._real.local_row(stacked)
+
     def set_strategy(self, s):
         self.strategy = s
 
