@@ -83,6 +83,9 @@ class TrainState:
     params: Any
     opt_state: Any
     step: int = 0
+    # non-trainable model state (e.g. BatchNorm running statistics) threaded
+    # through the step when the trainer is built with has_aux=True
+    model_state: Any = None
 
 
 class DataParallelTrainer:
@@ -95,6 +98,13 @@ class DataParallelTrainer:
       axis_name: the data axis the optimizer reduces over.
       per_replica_params: see module docstring.
       donate: donate params/opt_state buffers (halves HBM traffic per step).
+      has_aux: loss_fn is (params, model_state, batch) -> (loss, new_model_state)
+        and TrainState.model_state is threaded through every step.  This is
+        how BatchNorm running statistics (flax `mutable=["batch_stats"]`)
+        train for real instead of being baked in as compile-time constants.
+        In replicated mode the new model_state is pmean'd across the data
+        axis each step (cross-replica BN stat sync); in per_replica mode
+        each replica keeps its own.
     """
 
     def __init__(
@@ -105,12 +115,15 @@ class DataParallelTrainer:
         axis_name: str = "dp",
         per_replica_params: bool = False,
         donate: bool = True,
+        has_aux: bool = False,
     ):
         self.loss_fn = loss_fn
         self.tx = tx
         self.mesh = mesh if mesh is not None else make_mesh(dp=-1)
         self.axis_name = axis_name
         self.per_replica = per_replica_params
+        self.has_aux = has_aux
+        self._donate = donate
         self._step_fn = self._build_step(donate)
 
     @property
@@ -123,32 +136,62 @@ class DataParallelTrainer:
 
     # -- step construction ------------------------------------------------------------
 
-    def _build_step(self, donate: bool) -> Callable:
-        axis = self.axis_name
-        state_spec = P(axis) if self.per_replica else P()
-        data_spec = P(axis)
+    def _step_body(self, params, opt_state, model_state, batch):
+        """One replica-local step: grads -> distributed tx -> apply.
 
-        def step(params, opt_state, batch):
-            if self.per_replica:  # each shard carries leading dim 1: unstack
-                params = jax.tree.map(lambda x: jnp.squeeze(x, 0), params)
-                opt_state = jax.tree.map(lambda x: jnp.squeeze(x, 0), opt_state)
+        Returns (params, opt_state, model_state, loss), all in the same
+        (possibly per-replica-stacked) layout they came in with.
+        """
+        axis = self.axis_name
+        if self.per_replica:  # each shard carries leading dim 1: unstack
+            unstack = lambda x: jnp.squeeze(x, 0)
+            params = jax.tree.map(unstack, params)
+            opt_state = jax.tree.map(unstack, opt_state)
+            model_state = jax.tree.map(unstack, model_state)
+        if self.has_aux:
+            (loss, model_state), grads = jax.value_and_grad(
+                self.loss_fn, has_aux=True
+            )(params, model_state, batch)
+            if not self.per_replica:
+                # cross-replica sync of e.g. BN running stats so replicated
+                # state stays identical on every device; non-float leaves
+                # (counters, PRNG keys) must not be averaged
+                model_state = jax.tree.map(
+                    lambda x: jax.lax.pmean(x, axis)
+                    if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+                    else x,
+                    model_state,
+                )
+        else:
             loss, grads = jax.value_and_grad(self.loss_fn)(params, batch)
-            updates, opt_state = self.tx.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            loss = jax.lax.pmean(loss, axis)
-            if self.per_replica:
-                params = jax.tree.map(lambda x: x[None], params)
-                opt_state = jax.tree.map(lambda x: x[None], opt_state)
-            return params, opt_state, {"loss": loss}
+        updates, opt_state = self.tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        loss = jax.lax.pmean(loss, axis)
+        if self.per_replica:
+            stack = lambda x: x[None]
+            params = jax.tree.map(stack, params)
+            opt_state = jax.tree.map(stack, opt_state)
+            model_state = jax.tree.map(stack, model_state)
+        return params, opt_state, model_state, loss
+
+    def _build_step(self, donate: bool) -> Callable:
+        state_spec = P(self.axis_name) if self.per_replica else P()
+        data_spec = P(self.axis_name)
+
+        def step(params, opt_state, model_state, batch):
+            params, opt_state, model_state, loss = self._step_body(
+                params, opt_state, model_state, batch
+            )
+            return params, opt_state, model_state, {"loss": loss}
 
         fn = _shard_map(
             step,
             mesh=self.mesh,
-            in_specs=(state_spec, state_spec, data_spec),
-            out_specs=(state_spec, state_spec, P()),
+            in_specs=(state_spec, state_spec, state_spec, data_spec),
+            out_specs=(state_spec, state_spec, state_spec, P()),
             check_vma=False,  # monitor/gossip states mix varying+invariant leaves
         )
-        return jax.jit(fn, donate_argnums=(0, 1) if donate else ())
+        return jax.jit(fn, donate_argnums=(0, 1, 2) if donate else ())
 
     def _build_multi_step(self, n: int) -> Callable:
         """One compiled program running `n` steps (lax.scan) on a fixed batch.
@@ -157,55 +200,50 @@ class DataParallelTrainer:
         runtimes the per-dispatch round trip otherwise dominates step time.
         Used by benchmarks and tight loops where the batch is device-resident.
         """
-        axis = self.axis_name
-        state_spec = P(axis) if self.per_replica else P()
-        data_spec = P(axis)
+        state_spec = P(self.axis_name) if self.per_replica else P()
+        data_spec = P(self.axis_name)
 
-        def step_body(params, opt_state, batch):
-            if self.per_replica:
-                params = jax.tree.map(lambda x: jnp.squeeze(x, 0), params)
-                opt_state = jax.tree.map(lambda x: jnp.squeeze(x, 0), opt_state)
-            loss, grads = jax.value_and_grad(self.loss_fn)(params, batch)
-            updates, opt_state = self.tx.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            loss = jax.lax.pmean(loss, axis)
-            if self.per_replica:
-                params = jax.tree.map(lambda x: x[None], params)
-                opt_state = jax.tree.map(lambda x: x[None], opt_state)
-            return params, opt_state, loss
-
-        def many(params, opt_state, batch):
+        def many(params, opt_state, model_state, batch):
             def body(carry, _):
-                p, o = carry
-                p, o, loss = step_body(p, o, batch)
-                return (p, o), loss
+                p, o, m = carry
+                p, o, m, loss = self._step_body(p, o, m, batch)
+                return (p, o, m), loss
 
-            (params, opt_state), losses = jax.lax.scan(
-                body, (params, opt_state), None, length=n
+            (params, opt_state, model_state), losses = jax.lax.scan(
+                body, (params, opt_state, model_state), None, length=n
             )
-            return params, opt_state, {"loss": losses[-1]}
+            return params, opt_state, model_state, {"loss": losses[-1]}
 
         fn = _shard_map(
             many,
             mesh=self.mesh,
-            in_specs=(state_spec, state_spec, data_spec),
-            out_specs=(state_spec, state_spec, P()),
+            in_specs=(state_spec, state_spec, state_spec, data_spec),
+            out_specs=(state_spec, state_spec, state_spec, P()),
             check_vma=False,
         )
-        return jax.jit(fn, donate_argnums=(0, 1))
+        return jax.jit(fn, donate_argnums=(0, 1, 2) if self._donate else ())
 
     # -- host API ---------------------------------------------------------------------
 
-    def init(self, params: Any, rng_stack_fn=None) -> TrainState:
+    def init(self, params: Any, model_state: Any = None) -> TrainState:
         """Build TrainState; in per_replica mode, replicas start identical
         (the BroadcastGlobalVariables-at-init semantics,
         reference initializer/__init__.py:13-99)."""
-        return self.place_state(params, self.tx.init(params))
+        return self.place_state(params, self.tx.init(params), model_state=model_state)
 
-    def place_state(self, params: Any, opt_state: Any, step: int = 0) -> TrainState:
+    def place_state(
+        self, params: Any, opt_state: Any, step: int = 0, model_state: Any = None
+    ) -> TrainState:
         """Place host (params, opt_state) onto the mesh as a TrainState —
         also the checkpoint-restore path (single-replica snapshots are
         re-broadcast in per_replica mode)."""
+        if model_state is None:
+            if self.has_aux:
+                raise ValueError(
+                    "has_aux=True requires model_state (e.g. the model's "
+                    "batch_stats collection) at init/place_state time"
+                )
+            model_state = {}
         if self.per_replica:
             n = self.world
 
@@ -215,6 +253,7 @@ class DataParallelTrainer:
 
             params = jax.tree.map(stack, params)
             opt_state = jax.tree.map(stack, opt_state)
+            model_state = jax.tree.map(stack, model_state)
             sharding = NamedSharding(self.mesh, P(self.axis_name))
         else:
             sharding = NamedSharding(self.mesh, P())
@@ -226,7 +265,10 @@ class DataParallelTrainer:
 
         params = jax.tree.map(place, params)
         opt_state = jax.tree.map(place, opt_state)
-        return TrainState(params=params, opt_state=opt_state, step=step)
+        model_state = jax.tree.map(place, model_state)
+        return TrainState(
+            params=params, opt_state=opt_state, step=step, model_state=model_state
+        )
 
     def shard_batch(self, batch: Any) -> Any:
         """Place a batch sharded over the data axis.
@@ -247,12 +289,16 @@ class DataParallelTrainer:
         fn = self._multi.get(n)
         if fn is None:
             fn = self._multi[n] = self._build_multi_step(n)
-        params, opt_state, metrics = fn(state.params, state.opt_state, batch)
-        return TrainState(params, opt_state, state.step + n), metrics
+        ms = state.model_state if state.model_state is not None else {}
+        params, opt_state, ms, metrics = fn(state.params, state.opt_state, ms, batch)
+        return TrainState(params, opt_state, state.step + n, ms), metrics
 
     def train_step(self, state: TrainState, batch: Any) -> Tuple[TrainState, Dict]:
-        params, opt_state, metrics = self._step_fn(state.params, state.opt_state, batch)
-        return TrainState(params, opt_state, state.step + 1), metrics
+        ms = state.model_state if state.model_state is not None else {}
+        params, opt_state, ms, metrics = self._step_fn(
+            state.params, state.opt_state, ms, batch
+        )
+        return TrainState(params, opt_state, state.step + 1, ms), metrics
 
     def eval_params(self, state: TrainState, replica: int = 0) -> Any:
         """Materialize one replica's params (for eval/checkpoint).
@@ -270,6 +316,21 @@ class DataParallelTrainer:
                 )
             return jax.tree.map(jnp.asarray, first_local_replica(state.params))
         return jax.tree.map(lambda x: x[replica], state.params)
+
+    def eval_model_state(self, state: TrainState, replica: int = 0) -> Any:
+        """model_state analog of eval_params (BN stats at eval/checkpoint)."""
+        if state.model_state is None:
+            return None
+        if not self.per_replica:
+            return state.model_state
+        if jax.process_count() > 1:
+            if replica != 0:
+                raise ValueError(
+                    "multi-controller eval_model_state can only read this "
+                    "process's first local replica (pass replica=0)"
+                )
+            return jax.tree.map(jnp.asarray, first_local_replica(state.model_state))
+        return jax.tree.map(lambda x: x[replica], state.model_state)
 
     def fit(
         self,

@@ -95,3 +95,103 @@ def test_requires_init_before_step():
     trainer = MeshTrainer(model, _loss_fn, optax.sgd(0.05), mesh=mesh)
     with pytest.raises(RuntimeError):
         trainer.train_step(None, None)
+
+
+# -- DataParallelTrainer has_aux (mutable model state, e.g. BatchNorm) ----------------
+
+
+class _BNModel:
+    """Tiny dense+BN flax model used to exercise model_state threading."""
+
+    def __new__(cls):
+        import flax.linen as nn
+
+        class M(nn.Module):
+            @nn.compact
+            def __call__(self, x, train: bool = True):
+                x = nn.Dense(8)(x)
+                x = nn.BatchNorm(use_running_average=not train, momentum=0.5)(x)
+                return nn.Dense(1)(x)
+
+        return M()
+
+
+def _bn_setup(per_replica=False, donate=True):
+    from kungfu_tpu.optimizers import synchronous_sgd
+    from kungfu_tpu.train import DataParallelTrainer
+
+    model = _BNModel()
+    x = np.random.RandomState(0).randn(16, 4).astype(np.float32)
+    y = np.random.RandomState(1).randn(16, 1).astype(np.float32)
+    variables = model.init(jax.random.PRNGKey(0), x[:1], train=False)
+
+    def loss_fn(params, model_state, batch):
+        xb, yb = batch
+        out, mutated = model.apply(
+            {"params": params, **model_state}, xb, train=True,
+            mutable=["batch_stats"],
+        )
+        return jnp.mean((out - yb) ** 2), mutated
+
+    trainer = DataParallelTrainer(
+        loss_fn, synchronous_sgd(optax.sgd(0.05)),
+        per_replica_params=per_replica, has_aux=True, donate=donate,
+    )
+    state = trainer.init(
+        variables["params"], model_state={"batch_stats": variables["batch_stats"]}
+    )
+    return trainer, state, (x, y), variables
+
+
+@pytest.mark.parametrize("per_replica", [False, True], ids=["replicated", "per_replica"])
+def test_bn_stats_train_through_state(per_replica):
+    trainer, state, (x, y), variables = _bn_setup(per_replica=per_replica)
+    batch = trainer.shard_batch((x, y))
+    before = np.asarray(
+        jax.tree.leaves(trainer.eval_model_state(state))[0]
+    ).copy()
+    state, metrics = trainer.train_step(state, batch)
+    # scan path must thread the stats identically
+    state, metrics = trainer.train_steps(state, batch, n=3)
+    assert state.step == 4
+    after = np.asarray(jax.tree.leaves(trainer.eval_model_state(state))[0])
+    assert not np.allclose(before, after), "BN running stats never updated"
+    assert np.isfinite(float(np.asarray(metrics["loss"])))
+
+
+def test_bn_replicated_matches_single_device():
+    """Replicated-mode BN sync (pmean of per-shard stats) must equal the
+    single-device full-batch computation: mean of shard-means == full mean."""
+    trainer, state, (x, y), variables = _bn_setup()
+    batch = trainer.shard_batch((x, y))
+    state, _ = trainer.train_step(state, batch)
+
+    # single-device reference
+    model = _BNModel()
+    params, bstats = variables["params"], variables["batch_stats"]
+
+    def loss(p, ms):
+        out, mut = model.apply(
+            {"params": p, **ms}, x, train=True, mutable=["batch_stats"]
+        )
+        return jnp.mean((out - y) ** 2), mut
+
+    (_, mutated), grads = jax.value_and_grad(loss, has_aux=True)(
+        params, {"batch_stats": bstats}
+    )
+    want_mean = np.asarray(mutated["batch_stats"]["BatchNorm_0"]["mean"])
+    got_mean = np.asarray(
+        state.model_state["batch_stats"]["BatchNorm_0"]["mean"]
+    )
+    assert np.allclose(got_mean, want_mean, atol=1e-5), (got_mean, want_mean)
+
+
+def test_has_aux_requires_model_state():
+    from kungfu_tpu.optimizers import synchronous_sgd
+    from kungfu_tpu.train import DataParallelTrainer
+
+    trainer = DataParallelTrainer(
+        lambda p, m, b: (0.0, m), synchronous_sgd(optax.sgd(0.1)), has_aux=True
+    )
+    with pytest.raises(ValueError, match="model_state"):
+        trainer.init({"w": np.zeros(2, np.float32)})
