@@ -1,0 +1,141 @@
+"""PipelinedLM: the pipelined transformer must match the plain TransformerLM
+bit-for-bit-ish (fp32) across GPipe, circular, and dp x pp meshes, and train
+under MeshTrainer."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+import flax.linen as nn
+
+from kungfu_tpu.models.transformer import TransformerConfig, TransformerLM, lm_loss
+from kungfu_tpu.parallel.pp_transformer import PipelinedLM
+from kungfu_tpu.plan import MeshSpec, make_mesh
+
+
+def _mesh(**spec):
+    import numpy as np
+    n = int(np.prod([v for v in spec.values()]))
+    return make_mesh(MeshSpec.make(**spec), devices=jax.devices()[:n])
+
+
+def _cfg(mesh, n_layers=4, **kw):
+    base = dict(
+        vocab_size=64, d_model=32, n_layers=n_layers, n_heads=4, d_ff=64,
+        max_len=32, dtype=jnp.float32, mesh=mesh,
+    )
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def _tokens(batch=8):
+    return np.random.RandomState(0).randint(0, 64, size=(batch, 32)).astype(np.int32)
+
+
+def _reference_logits(cfg, tokens):
+    import dataclasses
+
+    plain = TransformerLM(dataclasses.replace(cfg, mesh=None))
+    variables = plain.init(jax.random.PRNGKey(0), tokens)
+    params = nn.meta.unbox(variables["params"])
+    return np.asarray(plain.apply({"params": params}, tokens)), params
+
+
+@pytest.mark.parametrize(
+    "spec,repeats,layers,micro",
+    [
+        (dict(pp=4), 1, 4, 4),    # GPipe
+        (dict(pp=4), 2, 8, 4),    # circular, R=2 (M == S boundary)
+        (dict(pp=2), 3, 6, 4),    # circular, R=3, M > S
+        (dict(dp=2, pp=4), 1, 4, 2),  # dp rides along
+    ],
+    ids=["gpipe-pp4", "circ-pp4-r2", "circ-pp2-r3", "dp2xpp4"],
+)
+def test_pipelined_matches_plain(spec, repeats, layers, micro):
+    tokens = _tokens(8)
+    mesh = _mesh(**spec)
+    cfg = _cfg(mesh, n_layers=layers)
+    want, _ = _reference_logits(cfg, tokens)
+
+    model = PipelinedLM(cfg, repeats=repeats, microbatches=micro, remat=False)
+    variables = model.init(jax.random.PRNGKey(0), tokens)
+    params = nn.meta.unbox(variables["params"])
+    with mesh:
+        got = np.asarray(jax.jit(lambda p: model.apply({"params": p}, tokens))(params))
+    assert np.allclose(got, want, atol=1e-4), np.abs(got - want).max()
+
+
+def test_pipelined_remat_matches():
+    tokens = _tokens(8)
+    mesh = _mesh(pp=4)
+    cfg = _cfg(mesh, n_layers=4)
+    want, _ = _reference_logits(cfg, tokens)
+    model = PipelinedLM(cfg, microbatches=4, remat=True)
+    params = nn.meta.unbox(model.init(jax.random.PRNGKey(0), tokens)["params"])
+    with mesh:
+        got = np.asarray(jax.jit(lambda p: model.apply({"params": p}, tokens))(params))
+    assert np.allclose(got, want, atol=1e-4)
+
+
+def test_pipelined_trains_under_meshtrainer():
+    """MeshTrainer drives PipelinedLM unmodified; loss matches the unsharded
+    single-device step."""
+    from kungfu_tpu.trainer import MeshTrainer
+
+    tokens = _tokens(8)
+    mesh = _mesh(dp=2, pp=4)
+    cfg = _cfg(mesh, n_layers=4)
+
+    def loss_fn(model, params, toks):
+        return lm_loss(model.apply({"params": params}, toks), toks)
+
+    model = PipelinedLM(cfg, microbatches=2, remat=False)
+    trainer = MeshTrainer(model, loss_fn, optax.sgd(0.05), mesh=mesh)
+    state = trainer.init(jax.random.PRNGKey(0), tokens)
+    # stacked block leaves really live sharded over pp
+    leaf = jax.tree.leaves(state.params["blocks"])[0]
+    assert leaf.addressable_shards[0].data.shape[0] * mesh.shape["pp"] == leaf.shape[0]
+    batch = trainer.shard_batch(tokens)
+    losses = []
+    for _ in range(2):
+        state, metrics = trainer.train_step(state, batch)
+        losses.append(float(np.asarray(metrics["loss"])))
+
+    # unsharded reference: same init, same sgd
+    import dataclasses
+
+    plain = TransformerLM(dataclasses.replace(cfg, mesh=None))
+    params = nn.meta.unbox(plain.init(jax.random.PRNGKey(0), tokens)["params"])
+    tx = optax.sgd(0.05)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(p, s):
+        loss, g = jax.value_and_grad(
+            lambda pp: lm_loss(plain.apply({"params": pp}, tokens), tokens)
+        )(p)
+        u, s = tx.update(g, s, p)
+        return optax.apply_updates(p, u), s, loss
+
+    want = []
+    for _ in range(2):
+        params, opt, loss = step(params, opt)
+        want.append(float(loss))
+    assert np.allclose(losses, want, rtol=2e-4), (losses, want)
+
+
+def test_pipelined_rejects_bad_configs():
+    mesh = _mesh(pp=4)
+    with pytest.raises(ValueError, match="groups"):
+        PipelinedLM(_cfg(mesh, n_layers=6), repeats=1)  # 6 % 4 != 0
+    with pytest.raises(ValueError, match="mesh"):
+        PipelinedLM(_cfg(None, n_layers=4))
+    with pytest.raises(ValueError, match="ring"):
+        PipelinedLM(_cfg(mesh, n_layers=4, attention="ring"))
+    with pytest.raises(ValueError, match="microbatches >= stages"):
+        model = PipelinedLM(_cfg(mesh, n_layers=8), repeats=2, microbatches=2)
+        tokens = _tokens(8)
+        params = nn.meta.unbox(model.init(jax.random.PRNGKey(0), tokens)["params"])
+        with mesh:
+            jax.jit(lambda p: model.apply({"params": p}, tokens))(params)
