@@ -140,6 +140,7 @@ def simple_run(job: Job, cluster: Cluster, self_host: str, version: int = 0,
                         for other in pending:
                             other.terminate()
                         pending = []
+                        break  # snapshot is stale now: stop this sweep
             time.sleep(0.05)
     except KeyboardInterrupt:
         for r in runners:
