@@ -60,8 +60,12 @@ struct EpochPlan {
 };
 
 struct Loader {
-    const uint8_t* data;
-    const uint8_t* labels;
+    // chunk table: the dataset is a concatenation of n_chunks contiguous
+    // spans (one per memory-mapped file for the file-backed path; exactly
+    // one for the classic in-RAM path).  chunk_start[i] is the global index
+    // of chunk i's first sample; chunk_start.back() == n.
+    std::vector<const uint8_t*> chunk_data, chunk_labels;
+    std::vector<int64_t> chunk_start;
     int64_t n, sample_bytes, label_bytes, batch;
     uint64_t seed;
     int queue_cap;
@@ -127,6 +131,17 @@ struct Loader {
             idxs[(size_t)b] = plan[(size_t)((step * batch + b) % (int64_t)plan.size())];
     }
 
+    // global sample index -> (chunk base pointers, in-chunk offset)
+    inline size_t chunk_of(int64_t idx) const {
+        // upper_bound on starts: first chunk whose start is > idx, minus one
+        size_t lo = 0, hi = chunk_start.size() - 1;  // starts has n_chunks+1 entries
+        while (lo + 1 < hi) {
+            size_t mid = (lo + hi) / 2;
+            if (chunk_start[mid] <= idx) lo = mid; else hi = mid;
+        }
+        return lo;
+    }
+
     void gather(uint64_t seq, uint64_t g, int r, int s, Batch& out) {
         int64_t spe = steps_for(r, s);
         if (spe == 0) spe = 1;
@@ -138,10 +153,12 @@ struct Loader {
         batch_indices(epoch, step, g, r, s, idxs);
         for (int64_t b = 0; b < batch; ++b) {
             int64_t idx = idxs[(size_t)b];
+            size_t c = chunk_of(idx);
+            int64_t off = idx - chunk_start[c];
             std::memcpy(out.data.data() + b * sample_bytes,
-                        data + idx * sample_bytes, (size_t)sample_bytes);
+                        chunk_data[c] + off * sample_bytes, (size_t)sample_bytes);
             std::memcpy(out.labels.data() + b * label_bytes,
-                        labels + idx * label_bytes, (size_t)label_bytes);
+                        chunk_labels[c] + off * label_bytes, (size_t)label_bytes);
         }
     }
 
@@ -174,6 +191,39 @@ struct Loader {
 
 extern "C" {
 
+// Sharded-file path: the dataset is n_chunks memory-mapped spans.
+void* kft_loader_create_chunked(const void** datas, const void** labelses,
+                                const int64_t* chunk_ns, int n_chunks,
+                                int64_t sample_bytes, int64_t label_bytes,
+                                int64_t batch, uint64_t seed, int shard_rank,
+                                int shard_size, int threads, int queue_cap) {
+    if (n_chunks <= 0 || batch <= 0 || threads <= 0) return nullptr;
+    if (shard_size <= 0 || shard_rank < 0 || shard_rank >= shard_size) return nullptr;
+    int64_t n = 0;
+    for (int i = 0; i < n_chunks; ++i) {
+        if (chunk_ns[i] <= 0) return nullptr;
+        n += chunk_ns[i];
+    }
+    auto* L = new Loader();
+    for (int i = 0; i < n_chunks; ++i) {
+        L->chunk_data.push_back((const uint8_t*)datas[i]);
+        L->chunk_labels.push_back((const uint8_t*)labelses[i]);
+        L->chunk_start.push_back(L->n);
+        L->n += chunk_ns[i];
+    }
+    L->chunk_start.push_back(L->n);
+    L->sample_bytes = sample_bytes;
+    L->label_bytes = label_bytes;
+    L->batch = batch;
+    L->seed = seed;
+    L->shard_rank = shard_rank;
+    L->shard_size = shard_size;
+    L->queue_cap = queue_cap > 0 ? queue_cap : 4;
+    for (int t = 0; t < threads; ++t)
+        L->workers.emplace_back([L] { L->worker(); });
+    return L;
+}
+
 void* kft_loader_create(const void* data, const void* labels, int64_t n,
                         int64_t sample_bytes, int64_t label_bytes,
                         int64_t batch, uint64_t seed, int shard_rank,
@@ -181,8 +231,9 @@ void* kft_loader_create(const void* data, const void* labels, int64_t n,
     if (n <= 0 || batch <= 0 || threads <= 0) return nullptr;
     if (shard_size <= 0 || shard_rank < 0 || shard_rank >= shard_size) return nullptr;
     auto* L = new Loader();
-    L->data = (const uint8_t*)data;
-    L->labels = (const uint8_t*)labels;
+    L->chunk_data = {(const uint8_t*)data};
+    L->chunk_labels = {(const uint8_t*)labels};
+    L->chunk_start = {0, n};
     L->n = n;
     L->sample_bytes = sample_bytes;
     L->label_bytes = label_bytes;

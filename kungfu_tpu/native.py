@@ -222,7 +222,113 @@ def _shuffled_perm(seed: int, epoch: int, n: int) -> np.ndarray:
     return perm
 
 
-class BatchLoader:
+class StreamLoaderBase:
+    """Shared stream semantics for the batch loaders: deterministic
+    splitmix64 per-epoch plan, rank-strided sharding, generation-fenced
+    reshard, native/python bit-identical delivery.
+
+    Subclasses set ``self._handle`` (native loader or None) in __init__ and
+    provide ``_n`` (dataset size), ``_alloc()`` (batch output arrays) and
+    ``_take(indices)`` (host gather for the python fallback).
+    """
+
+    batch_size: int
+    seed: int
+    shard_rank: int
+    shard_size: int
+    _handle = None
+    _seq: int = 0
+    _plan_cache: Optional[Tuple[int, np.ndarray]] = None
+
+    def _init_stream(self, batch_size: int, seed: int, shard_rank: int,
+                     shard_size: int) -> None:
+        if not (0 <= shard_rank < shard_size):
+            raise ValueError(f"bad shard {shard_rank}/{shard_size}")
+        self.batch_size = batch_size
+        self.seed = seed
+        self.shard_rank = shard_rank
+        self.shard_size = shard_size
+        self._handle = None
+        self._seq = 0
+        self._plan_cache = None
+
+    # -- subclass surface --
+    @property
+    def _n(self) -> int:
+        raise NotImplementedError
+
+    def _alloc(self) -> Tuple[np.ndarray, np.ndarray]:
+        raise NotImplementedError
+
+    def _take(self, indices) -> Tuple[np.ndarray, np.ndarray]:
+        raise NotImplementedError
+
+    # -- stream --
+    @property
+    def steps_per_epoch(self) -> int:
+        if self._handle is not None:
+            return int(_load().kft_loader_steps_per_epoch(self._handle))
+        n = self._n
+        shard_n = n // self.shard_size + (1 if (n % self.shard_size) > self.shard_rank else 0)
+        return shard_n // self.batch_size
+
+    def reshard(self, shard_rank: int, shard_size: int) -> None:
+        if not (0 <= shard_rank < shard_size):
+            raise ValueError(f"bad shard {shard_rank}/{shard_size}")
+        self.shard_rank, self.shard_size = shard_rank, shard_size
+        self._plan_cache = None
+        if self._handle is not None:
+            if _load().kft_loader_reshard(self._handle, shard_rank, shard_size) != 0:
+                raise ValueError(f"bad shard {shard_rank}/{shard_size}")
+
+    def __next__(self) -> Tuple[np.ndarray, np.ndarray]:
+        out_d, out_l = self._alloc()
+        if self._handle is not None:
+            rc = _load().kft_loader_next(
+                self._handle,
+                out_d.ctypes.data_as(ctypes.c_void_p),
+                out_l.ctypes.data_as(ctypes.c_void_p),
+            )
+            if rc != 0:
+                raise StopIteration
+            return out_d, out_l
+        # fallback: same plan math as the C++ worker
+        spe = max(self.steps_per_epoch, 1)
+        epoch, step = divmod(self._seq, spe)
+        self._seq += 1
+        plan = self._fallback_plan(epoch)
+        idx = [plan[(step * self.batch_size + b) % len(plan)] for b in range(self.batch_size)]
+        d, l = self._take(idx)
+        out_d[...] = d
+        out_l[...] = l
+        return out_d, out_l
+
+    def __iter__(self):
+        return self
+
+    def _fallback_plan(self, epoch: int) -> np.ndarray:
+        if self._plan_cache is not None and self._plan_cache[0] == epoch:
+            return self._plan_cache[1]
+        perm = _shuffled_perm(self.seed, epoch, self._n)
+        plan = perm[self.shard_rank :: self.shard_size]
+        if len(plan) == 0:
+            plan = np.zeros(1, np.int64)
+        self._plan_cache = (epoch, plan)
+        return plan
+
+    def close(self) -> None:
+        if self._handle is not None:
+            _load().kft_loader_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class BatchLoader(StreamLoaderBase):
     """Deterministic shuffled-gather batch stream with threaded prefetch.
 
     Feeds (data, labels) numpy batches.  With the native library, gathering
@@ -244,20 +350,13 @@ class BatchLoader:
     ):
         if len(data) != len(labels):
             raise ValueError("data/labels length mismatch")
-        if not (0 <= shard_rank < shard_size):
-            raise ValueError(f"bad shard {shard_rank}/{shard_size}")
+        self._init_stream(batch_size, seed, shard_rank, shard_size)
         self.data = np.ascontiguousarray(data)
         self.labels = np.ascontiguousarray(labels)
-        self.batch_size = batch_size
-        self.seed = seed
-        self.shard_rank = shard_rank
-        self.shard_size = shard_size
         self._sample_shape = self.data.shape[1:]
         self._label_shape = self.labels.shape[1:]
         self._sample_bytes = int(self.data.dtype.itemsize * np.prod(self._sample_shape or (1,)))
         self._label_bytes = int(self.labels.dtype.itemsize * np.prod(self._label_shape or (1,)))
-        self._handle = None
-        self._seq = 0  # fallback cursor
         lib = _load()
         if lib is not None:
             h = lib.kft_loader_create(
@@ -269,66 +368,14 @@ class BatchLoader:
             self._handle = h or None
 
     @property
-    def steps_per_epoch(self) -> int:
-        if self._handle is not None:
-            return int(_load().kft_loader_steps_per_epoch(self._handle))
-        n = len(self.data)
-        shard_n = n // self.shard_size + (1 if (n % self.shard_size) > self.shard_rank else 0)
-        return shard_n // self.batch_size
+    def _n(self) -> int:
+        return len(self.data)
 
-    def reshard(self, shard_rank: int, shard_size: int) -> None:
-        if not (0 <= shard_rank < shard_size):
-            raise ValueError(f"bad shard {shard_rank}/{shard_size}")
-        self.shard_rank, self.shard_size = shard_rank, shard_size
-        self._plan_cache = None
-        if self._handle is not None:
-            if _load().kft_loader_reshard(self._handle, shard_rank, shard_size) != 0:
-                raise ValueError(f"bad shard {shard_rank}/{shard_size}")
+    def _alloc(self) -> Tuple[np.ndarray, np.ndarray]:
+        return (
+            np.empty((self.batch_size, *self._sample_shape), self.data.dtype),
+            np.empty((self.batch_size, *self._label_shape), self.labels.dtype),
+        )
 
-    def __next__(self) -> Tuple[np.ndarray, np.ndarray]:
-        out_d = np.empty((self.batch_size, *self._sample_shape), self.data.dtype)
-        out_l = np.empty((self.batch_size, *self._label_shape), self.labels.dtype)
-        if self._handle is not None:
-            rc = _load().kft_loader_next(
-                self._handle,
-                out_d.ctypes.data_as(ctypes.c_void_p),
-                out_l.ctypes.data_as(ctypes.c_void_p),
-            )
-            if rc != 0:
-                raise StopIteration
-            return out_d, out_l
-        # fallback: same plan math as the C++ worker
-        spe = max(self.steps_per_epoch, 1)
-        epoch, step = divmod(self._seq, spe)
-        self._seq += 1
-        perm = self._fallback_plan(epoch)
-        idx = [perm[(step * self.batch_size + b) % len(perm)] for b in range(self.batch_size)]
-        out_d[...] = self.data[idx]
-        out_l[...] = self.labels[idx]
-        return out_d, out_l
-
-    def __iter__(self):
-        return self
-
-    _plan_cache: Optional[Tuple[int, np.ndarray]] = None
-
-    def _fallback_plan(self, epoch: int) -> np.ndarray:
-        if self._plan_cache is not None and self._plan_cache[0] == epoch:
-            return self._plan_cache[1]
-        perm = _shuffled_perm(self.seed, epoch, len(self.data))
-        plan = perm[self.shard_rank :: self.shard_size]
-        if len(plan) == 0:
-            plan = np.zeros(1, np.int64)
-        self._plan_cache = (epoch, plan)
-        return plan
-
-    def close(self) -> None:
-        if self._handle is not None:
-            _load().kft_loader_destroy(self._handle)
-            self._handle = None
-
-    def __del__(self):  # pragma: no cover
-        try:
-            self.close()
-        except Exception:
-            pass
+    def _take(self, indices) -> Tuple[np.ndarray, np.ndarray]:
+        return self.data[indices], self.labels[indices]
