@@ -163,7 +163,7 @@ def _bench_dataset_dir(n_images: int):
     d = os.environ.get("KFT_BENCH_DATA_DIR", "/tmp/kft_bench_imagenet")
     if not os.path.isdir(d):
         rng = np.random.RandomState(0)
-        images = rng.randint(0, 255, size=(n_images, 224, 224, 3)).astype(np.uint8)
+        images = rng.randint(0, 256, size=(n_images, 224, 224, 3), dtype=np.uint8)
         labels = rng.randint(0, 1000, size=n_images).astype(np.int32)
         tmp = f"{d}.build.{os.getpid()}"
         df.write_chunks(tmp, images, labels, samples_per_chunk=256)
@@ -242,7 +242,13 @@ def run_files_train(batch_per_chip: int, steps: int):
 
     d = _bench_dataset_dir(n_images=1024)
     ds = df.FileDataset(d)
-    loader = df.FileBatchLoader(ds, batch_size=global_batch, threads=8, queue_cap=16)
+    # cap prefetch memory: queue + in-flight gathers stay under ~2 GB even
+    # at the sweep's largest global batch
+    batch_bytes = global_batch * 224 * 224 * 3
+    cap = max(2, min(16, int(2e9 // max(batch_bytes, 1))))
+    loader = df.FileBatchLoader(
+        ds, batch_size=global_batch, threads=min(8, cap), queue_cap=cap
+    )
     try:
         state, m = trainer.train_step(state, trainer.shard_batch(next(loader)))
         float(np.asarray(m["loss"]))  # compile + sync
@@ -319,7 +325,9 @@ def main():
         hbm_util = best["img_per_sec_per_chip"] * bytes_per_img / peak_hbm
 
     try:
-        input_pipeline = measure_file_loader(batch=best["global_batch"])
+        # fixed modest batch: the probe documents the loader's rate (it must
+        # exceed the step's image consumption), not the sweep's batch shape
+        input_pipeline = measure_file_loader(batch=256)
     except Exception as e:  # never let the input probe sink the headline
         input_pipeline = {"error": f"{type(e).__name__}: {e}"}
 

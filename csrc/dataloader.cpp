@@ -199,11 +199,8 @@ void* kft_loader_create_chunked(const void** datas, const void** labelses,
                                 int shard_size, int threads, int queue_cap) {
     if (n_chunks <= 0 || batch <= 0 || threads <= 0) return nullptr;
     if (shard_size <= 0 || shard_rank < 0 || shard_rank >= shard_size) return nullptr;
-    int64_t n = 0;
-    for (int i = 0; i < n_chunks; ++i) {
+    for (int i = 0; i < n_chunks; ++i)
         if (chunk_ns[i] <= 0) return nullptr;
-        n += chunk_ns[i];
-    }
     auto* L = new Loader();
     for (int i = 0; i < n_chunks; ++i) {
         L->chunk_data.push_back((const uint8_t*)datas[i]);
@@ -224,27 +221,14 @@ void* kft_loader_create_chunked(const void** datas, const void** labelses,
     return L;
 }
 
+// Classic in-RAM path: the 1-chunk special case.
 void* kft_loader_create(const void* data, const void* labels, int64_t n,
                         int64_t sample_bytes, int64_t label_bytes,
                         int64_t batch, uint64_t seed, int shard_rank,
                         int shard_size, int threads, int queue_cap) {
-    if (n <= 0 || batch <= 0 || threads <= 0) return nullptr;
-    if (shard_size <= 0 || shard_rank < 0 || shard_rank >= shard_size) return nullptr;
-    auto* L = new Loader();
-    L->chunk_data = {(const uint8_t*)data};
-    L->chunk_labels = {(const uint8_t*)labels};
-    L->chunk_start = {0, n};
-    L->n = n;
-    L->sample_bytes = sample_bytes;
-    L->label_bytes = label_bytes;
-    L->batch = batch;
-    L->seed = seed;
-    L->shard_rank = shard_rank;
-    L->shard_size = shard_size;
-    L->queue_cap = queue_cap > 0 ? queue_cap : 4;
-    for (int i = 0; i < threads; ++i)
-        L->workers.emplace_back([L] { L->worker(); });
-    return L;
+    return kft_loader_create_chunked(&data, &labels, &n, 1, sample_bytes,
+                                     label_bytes, batch, seed, shard_rank,
+                                     shard_size, threads, queue_cap);
 }
 
 // Blocking: copies the next batch (deterministic order) into caller buffers.
