@@ -242,12 +242,15 @@ def run_files_train(batch_per_chip: int, steps: int):
 
     d = _bench_dataset_dir(n_images=1024)
     ds = df.FileDataset(d)
-    # cap prefetch memory: queue + in-flight gathers stay under ~2 GB even
-    # at the sweep's largest global batch
+    # cap prefetch memory: each worker materializes a full batch before
+    # blocking on the queue, so resident <= (threads + queue_cap) batches;
+    # budget both against ~2 GB
     batch_bytes = global_batch * 224 * 224 * 3
-    cap = max(2, min(16, int(2e9 // max(batch_bytes, 1))))
+    budget = max(2, int(2e9 // max(batch_bytes, 1)))
+    threads = max(1, min(8, budget // 2))
+    queue_cap = max(1, budget - threads)
     loader = df.FileBatchLoader(
-        ds, batch_size=global_batch, threads=min(8, cap), queue_cap=cap
+        ds, batch_size=global_batch, threads=threads, queue_cap=queue_cap
     )
     try:
         state, m = trainer.train_step(state, trainer.shard_batch(next(loader)))

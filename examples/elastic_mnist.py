@@ -28,6 +28,8 @@ def main():
     ap.add_argument("--check-every", type=int, default=2)
     ap.add_argument("--checkpoint-dir", default="", help="durable resume dir")
     ap.add_argument("--checkpoint-every", type=int, default=20)
+    ap.add_argument("--gns", action="store_true",
+                    help="chain the gradient-noise-scale monitor into the step")
     args = ap.parse_args()
 
     def make_loss():
@@ -55,8 +57,14 @@ def main():
         import optax
 
         from kungfu_tpu.optimizers import synchronous_sgd
+        from kungfu_tpu.optimizers.monitor import gradient_noise_scale
 
-        return synchronous_sgd(optax.sgd(args.lr), axis_name=axes, impl=impl)
+        tx = synchronous_sgd(optax.sgd(args.lr), axis_name=axes, impl=impl)
+        if args.gns:
+            tx = gradient_noise_scale(
+                tx, local_batch_size=args.batch_size, axis_name=axes
+            )
+        return tx
 
     def make_data(rank, size, offset):
         import jax
@@ -83,9 +91,17 @@ def main():
             checkpoint_every=args.checkpoint_every,
         ),
     )
+    gns = ""
+    if args.gns:
+        import numpy as np
+
+        from kungfu_tpu.optimizers.monitor import get_noise_scale
+
+        gns = f" gns={float(np.asarray(get_noise_scale(out['state'].opt_state))):.4f}"
     print(
         f"RESULT: loss={out['loss']:.4f} trained={out['trained_samples']} "
-        f"resizes={out['resizes']} final_size={out['final_size']}",
+        f"resizes={out['resizes']} final_size={out['final_size']} "
+        f"seconds={out['seconds']:.1f}{gns}",
         flush=True,
     )
 

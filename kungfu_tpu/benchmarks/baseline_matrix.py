@@ -1,0 +1,336 @@
+"""BASELINE.json benchmark matrix — one measured line per reference config.
+
+The reference treats recorded benchmark results as a deliverable
+(benchmarks/__main__.py:112-120 RESULT-line contract; README.md:191-219
+published curves).  This harness measures every BASELINE.json config the
+single-chip + single-host environment can express and persists them:
+
+    python -m kungfu_tpu.benchmarks.baseline_matrix --out BENCH_CONFIGS.json
+
+Configs (BASELINE.json "configs", in order):
+  1 mnist-slp-ssgd     SLP + SynchronousSGD under the launcher, -np 1, CPU
+  2 resnet50-ssgd      ResNet-50 S-SGD throughput (bench.py harness; runs
+                       on the real chip when present)
+  3 bert-sma           BERT-base-shaped transformer LM + SynchronousAveraging
+  4 resnet50-gossip    ResNet-50 + PairAveraging (SPMD ppermute variant; the
+                       host-store async variant is measured per-step)
+  5 elastic-gns        resize drill (grow x4 then halve, the 8->32->16 shape
+                       scaled to the host; --full runs the literal sizes)
+                       with the gradient-noise-scale monitor on
+
+Configs needing the TPU degrade to an {"error": ...} record instead of
+sinking the matrix when the chip is unreachable.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _run(cmd, timeout, env_extra=None):
+    env = dict(os.environ)
+    env.setdefault("PYTHONPATH", "")
+    env["PYTHONPATH"] = _REPO + os.pathsep + env["PYTHONPATH"]
+    if env_extra:
+        env.update(env_extra)
+    return subprocess.run(
+        cmd, capture_output=True, text=True, timeout=timeout, env=env, cwd=_REPO
+    )
+
+
+def config_mnist_slp() -> dict:
+    """BASELINE config 1: tf2_mnist_gradient_tape.py analog, -np 1 CPU."""
+    r = _run(
+        [sys.executable, "-m", "kungfu_tpu.run", "-np", "1", "-platform", "cpu",
+         sys.executable, os.path.join(_REPO, "examples", "mnist_slp.py"),
+         "--steps", "100"],
+        timeout=600, env_extra={"JAX_PLATFORMS": "cpu"},
+    )
+    for line in r.stdout.splitlines():
+        if "RESULT:" in line:
+            kv = dict(
+                p.split("=") for p in line.split("RESULT:")[1].split() if "=" in p
+            )
+            return {
+                "config": "mnist-slp-ssgd--np1-cpu",
+                "metric": "mnist_slp_accuracy",
+                "value": float(kv["acc"]),
+                "unit": "accuracy",
+                "samples_per_sec": float(kv.get("throughput", "nan").split("samples")[0]),
+            }
+    return {"config": "mnist-slp-ssgd--np1-cpu",
+            "error": f"no RESULT line (rc={r.returncode}): {r.stderr[-400:]}"}
+
+
+def config_resnet50_ssgd() -> dict:
+    """BASELINE config 2: ResNet-50 S-SGD throughput via bench.py."""
+    r = _run(
+        [sys.executable, os.path.join(_REPO, "bench.py")],
+        timeout=1800,
+        env_extra={"KFT_BENCH_BATCH": "128", "KFT_BENCH_STEPS": "20"},
+    )
+    for line in r.stdout.splitlines():
+        if line.startswith("{"):
+            d = json.loads(line)
+            d["config"] = "resnet50-ssgd-dp"
+            return d
+    return {"config": "resnet50-ssgd-dp",
+            "error": f"bench.py failed (rc={r.returncode}): {r.stderr[-400:]}"}
+
+
+def _lm_throughput(tx, per_replica: bool, batch_per_chip: int, steps: int,
+                   seq_len: int = 128) -> dict:
+    """Measured tokens/sec for a BERT-base-shaped LM under a distributed
+    optimizer (compiled scan multi-step, real chip when present)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..models.transformer import TransformerConfig, TransformerLM, lm_loss
+    from ..train import DataParallelTrainer
+
+    cfg = TransformerConfig(
+        vocab_size=30522, d_model=768, n_layers=12, n_heads=12, d_ff=3072,
+        max_len=seq_len, dtype=jnp.bfloat16,
+    )
+    model = TransformerLM(cfg)
+    n_chips = len(jax.devices())
+    global_batch = batch_per_chip * n_chips
+
+    def loss_fn(params, batch):
+        return lm_loss(model.apply({"params": params}, batch), batch)
+
+    import flax.linen as nn
+
+    tokens0 = jnp.zeros((1, seq_len), jnp.int32)
+    params = nn.meta.unbox(model.init(jax.random.PRNGKey(0), tokens0)["params"])
+    trainer = DataParallelTrainer(loss_fn, tx, per_replica_params=per_replica)
+    state = trainer.init(params)
+    rng = np.random.RandomState(0)
+    batch = trainer.shard_batch(
+        rng.randint(0, cfg.vocab_size, size=(global_batch, seq_len)).astype(np.int32)
+    )
+    state, m = trainer.train_steps(state, batch, n=steps)
+    float(np.asarray(m["loss"]))  # compile+warm sync
+    t0 = time.perf_counter()
+    state, m = trainer.train_steps(state, batch, n=steps)
+    float(np.asarray(m["loss"]))
+    dt = time.perf_counter() - t0
+    toks = steps * global_batch * seq_len / dt
+    return {
+        "tokens_per_sec_per_chip": round(toks / n_chips, 1),
+        "seq_per_sec_per_chip": round(toks / seq_len / n_chips, 2),
+        "step_ms": round(dt / steps * 1e3, 2),
+        "batch_per_chip": batch_per_chip,
+        "seq_len": seq_len,
+        "n_chips": n_chips,
+        "backend": jax.default_backend(),
+    }
+
+
+def config_bert_sma(steps: int = 10) -> dict:
+    """BASELINE config 3: BERT-base pretraining shape + SynchronousAveraging."""
+    import optax
+
+    from ..optimizers import synchronous_averaging
+
+    try:
+        d = _lm_throughput(
+            synchronous_averaging(optax.adamw(1e-4)), per_replica=True,
+            batch_per_chip=int(os.environ.get("KFT_BERT_BATCH", "16")),
+            steps=steps,
+        )
+    except Exception as e:
+        return {"config": "bert-base-sma", "error": f"{type(e).__name__}: {e}"}
+    d.update(
+        config="bert-base-sma",
+        metric="bert_base_sma_tokens_per_sec_per_chip",
+        value=d["tokens_per_sec_per_chip"],
+        unit="tokens/sec/chip",
+    )
+    return d
+
+
+def config_resnet50_gossip(steps: int = 10) -> dict:
+    """BASELINE config 4: ResNet-50 + PairAveraging.
+
+    SPMD variant (ppermute randomized pairing) measured as throughput; the
+    host-store async variant's per-step gossip overhead (fuse + TCP pull +
+    native average + save) is measured separately on the same model size.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from ..models.resnet import ResNet50
+    from ..models.slp import softmax_cross_entropy
+    from ..optimizers import pair_averaging
+    from ..train import DataParallelTrainer
+
+    try:
+        n_chips = len(jax.devices())
+        batch = int(os.environ.get("KFT_BENCH_BATCH", "128"))
+        model = ResNet50(num_classes=1000, norm_dtype=jnp.bfloat16)
+
+        def loss_fn(params, model_state, b):
+            images, labels = b
+            logits, mut = model.apply(
+                {"params": params, **model_state}, images, train=True,
+                mutable=["batch_stats"],
+            )
+            return softmax_cross_entropy(logits, labels), mut
+
+        variables = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 224, 224, 3), jnp.bfloat16),
+            train=False,
+        )
+        tx = pair_averaging(optax.sgd(0.1, momentum=0.9), axis_size=n_chips)
+        trainer = DataParallelTrainer(
+            loss_fn, tx, per_replica_params=True, has_aux=True
+        )
+        state = trainer.init(
+            variables["params"],
+            model_state={"batch_stats": variables["batch_stats"]},
+        )
+        rng = np.random.RandomState(0)
+        images = jnp.asarray(
+            rng.randn(batch * n_chips, 224, 224, 3), jnp.bfloat16
+        )
+        labels = rng.randint(0, 1000, size=batch * n_chips).astype(np.int32)
+        b = trainer.shard_batch((images, labels))
+        state, m = trainer.train_steps(state, b, n=steps)
+        float(np.asarray(m["loss"]))
+        t0 = time.perf_counter()
+        state, m = trainer.train_steps(state, b, n=steps)
+        float(np.asarray(m["loss"]))
+        dt = time.perf_counter() - t0
+
+        # host-store variant: per-step mix() cost on the same parameter tree
+        from ..optimizers.gossip import HostPairAveraging
+
+        class _SoloPeer:  # size-1: measures fuse+save+defuse round trip
+            rank, size = 0, 1
+
+            def save(self, name, arr, version=""):
+                self._blob = np.asarray(arr)
+
+            def request(self, *a, **k):
+                return None
+
+        hpa = HostPairAveraging(_SoloPeer(), seed=0)
+        host_params = jax.tree.map(np.asarray, trainer.eval_params(state))
+        hpa.mix(host_params)  # warm (allocates fuse buffers)
+        t1 = time.perf_counter()
+        for _ in range(5):
+            hpa.mix(host_params)
+        host_ms = (time.perf_counter() - t1) / 5 * 1e3
+
+        img_s = steps * batch * n_chips / dt / n_chips
+        return {
+            "config": "resnet50-gossip",
+            "metric": "resnet50_pair_averaging_images_per_sec_per_chip",
+            "value": round(img_s, 2),
+            "unit": "images/sec/chip",
+            "step_ms": round(dt / steps * 1e3, 2),
+            "batch_per_chip": batch,
+            "host_variant_mix_ms_per_step": round(host_ms, 2),
+            "backend": jax.default_backend(),
+        }
+    except Exception as e:
+        return {"config": "resnet50-gossip", "error": f"{type(e).__name__}: {e}"}
+
+
+def config_elastic_gns(full: bool = False) -> dict:
+    """BASELINE config 5: elastic resize drill with the GNS monitor on.
+
+    The literal 8->32->16 needs 32 worker processes; on small hosts the
+    scaled drill keeps the shape (grow x4, then halve).
+    """
+    schedule = "8:20,32:20,16:10" if full else "2:20,8:20,4:10"
+    t0 = time.perf_counter()
+    r = _run(
+        [sys.executable, "-m", "kungfu_tpu.run", "-w", "-np",
+         schedule.split(":")[0], "-platform", "cpu", "--",
+         sys.executable, os.path.join(_REPO, "examples", "elastic_mnist.py"),
+         "--schedule", schedule, "--total-samples", "12800", "--gns"],
+        timeout=1800, env_extra={"JAX_PLATFORMS": "cpu"},
+    )
+    dt = time.perf_counter() - t0
+    for line in r.stdout.splitlines():
+        if "RESULT:" in line:
+            kv = dict(
+                p.split("=") for p in line.split("RESULT:")[1].split() if "=" in p
+            )
+            return {
+                "config": "elastic-resize-gns",
+                "metric": "elastic_resizes_completed",
+                "value": int(kv["resizes"]),
+                "unit": "resizes",
+                "schedule": schedule,
+                "final_size": int(kv["final_size"]),
+                "trained_samples": int(kv["trained"]),
+                "final_loss": float(kv["loss"]),
+                "gradient_noise_scale": float(kv.get("gns", "nan")),
+                "wall_seconds": round(dt, 1),
+            }
+    return {"config": "elastic-resize-gns",
+            "error": f"no RESULT (rc={r.returncode}): {r.stderr[-400:]}"}
+
+
+CONFIGS = {
+    "1": ("mnist-slp-ssgd", lambda args: config_mnist_slp()),
+    "2": ("resnet50-ssgd", lambda args: config_resnet50_ssgd()),
+    "3": ("bert-sma", lambda args: config_bert_sma()),
+    "4": ("resnet50-gossip", lambda args: config_resnet50_gossip()),
+    "5": ("elastic-gns", lambda args: config_elastic_gns(full=args.full)),
+}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="kungfu_tpu.benchmarks.baseline_matrix")
+    ap.add_argument("--only", default="", help="comma-separated config ids (1-5)")
+    ap.add_argument("--out", default="BENCH_CONFIGS.json")
+    ap.add_argument("--full", action="store_true",
+                    help="literal 8->32->16 elastic drill (needs a big host)")
+    args = ap.parse_args(argv)
+
+    want = [w for w in args.only.split(",") if w] or list(CONFIGS)
+    unknown = [w for w in want if w not in CONFIGS]
+    if unknown:
+        ap.error(f"unknown config ids {unknown}; valid: {sorted(CONFIGS)}")
+    existing = {}
+    if os.path.exists(args.out):
+        try:
+            with open(args.out) as f:
+                existing = {
+                    r.get("config"): r for r in json.load(f).get("results", [])
+                }
+        except (OSError, ValueError):
+            pass
+
+    def persist():
+        with open(args.out, "w") as f:
+            json.dump({"generated_by": "kungfu_tpu.benchmarks.baseline_matrix",
+                       "results": list(existing.values())}, f, indent=1)
+
+    for cid in want:
+        name, fn = CONFIGS[cid]
+        print(f"# running config {cid}: {name}", file=sys.stderr)
+        rec = fn(args)
+        existing[rec["config"]] = rec
+        print(json.dumps(rec), flush=True)
+        persist()  # after every config: a mid-matrix crash loses nothing
+
+    persist()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -134,7 +134,8 @@ def run_in_process(name: str, steps: int, batch: int, lr: float, log_every: int)
     }
 
 
-def run_host_gossip(steps: int, batch: int, lr: float, np_workers: int = 4):
+def run_host_gossip(steps: int, batch: int, lr: float, log_every: int = 50,
+                    np_workers: int = 4):
     """True-async AD-PSGD: np separate worker processes under the launcher,
     gossiping through their TCP blob stores (the reference deployment
     shape).  Returns rank 0's RESULT line."""
@@ -146,6 +147,7 @@ def run_host_gossip(steps: int, batch: int, lr: float, np_workers: int = 4):
         sys.executable, "-m", "kungfu_tpu.benchmarks.convergence",
         "--host-gossip-worker",
         "--steps", str(steps), "--batch", str(batch), "--lr", str(lr),
+        "--log-every", str(log_every),
     ]
     r = subprocess.run(
         cmd, capture_output=True, text=True, timeout=900, env=env,
@@ -161,7 +163,8 @@ def run_host_gossip(steps: int, batch: int, lr: float, np_workers: int = 4):
     )
 
 
-def host_gossip_worker(steps: int, batch: int, lr: float) -> None:
+def host_gossip_worker(steps: int, batch: int, lr: float,
+                       log_every: int = 50) -> None:
     """One AD-PSGD worker: local SGD + HostPairAveraging.mix() per step."""
     import kungfu_tpu
     from ..env import apply_platform_override
@@ -197,7 +200,7 @@ def host_gossip_worker(steps: int, batch: int, lr: float) -> None:
         d, l = next(loader)
         params = hpa.mix(params)  # gossip pull + average (pre-update)
         params, opt, loss = step_fn(params, opt, (d.reshape(-1, 28, 28, 1), l))
-        if step % 50 == 0 or step == steps - 1:
+        if step % log_every == 0 or step == steps - 1:
             curve.append([step, round(float(loss), 4)])
     kungfu_tpu.run_barrier()
     if peer.rank == 0:
@@ -242,7 +245,7 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     if args.host_gossip_worker:
-        host_gossip_worker(args.steps, args.batch, args.lr)
+        host_gossip_worker(args.steps, args.batch, args.lr, args.log_every)
         return 0
 
     _force_cpu_mesh(8)
@@ -254,9 +257,13 @@ def main(argv=None) -> int:
               file=sys.stderr)
         results.append(r)
     if not args.skip_host_gossip:
-        r = run_host_gossip(args.steps, args.batch, args.lr)
-        print(f"# gossip-host: loss {r['final_loss']} acc {r['eval_accuracy']}",
-              file=sys.stderr)
+        try:
+            r = run_host_gossip(args.steps, args.batch, args.lr, args.log_every)
+            print(f"# gossip-host: loss {r['final_loss']} acc {r['eval_accuracy']}",
+                  file=sys.stderr)
+        except Exception as e:  # never lose the 5 finished in-process runs
+            r = {"optimizer": "gossip-host", "error": f"{type(e).__name__}: {e}"}
+            print(f"# gossip-host FAILED: {r['error']}", file=sys.stderr)
         results.append(r)
 
     with open(args.out, "w") as f:
@@ -271,6 +278,9 @@ def main(argv=None) -> int:
             "|---|---|---|---|---|\n"
         )
         for r in results:
+            if "error" in r:
+                f.write(f"| {r['optimizer']} | - | - | FAILED | FAILED |\n")
+                continue
             f.write(
                 f"| {r['optimizer']} | {r['world']} | {r['steps']} "
                 f"| {r['final_loss']} | {r['eval_accuracy']} |\n"

@@ -26,6 +26,7 @@ peer.go:211-222, holds by construction).
 """
 from __future__ import annotations
 
+import os
 import dataclasses
 import sys
 import time
@@ -98,9 +99,18 @@ class _MeshPrograms:
         )
 
         def collapse(tree):  # stacked (identical rows) -> replicated
-            return jax.tree.map(
-                lambda p: lax.pmean(jnp.squeeze(p, 0), axis), tree
-            )
+            def one(p):
+                y = jnp.squeeze(p, 0)
+                if jnp.issubdtype(y.dtype, jnp.inexact):
+                    return lax.pmean(y, axis)
+                # integer leaves (e.g. EMA step counters in monitor optimizer
+                # state) must keep their dtype: pmean would promote to float
+                # and the next resize's sync program would then disagree with
+                # a fresh joiner's int leaves (Gloo size-mismatch crash).
+                # Rows are identical here, so pmax is a pure selection.
+                return lax.pmax(y, axis)
+
+            return jax.tree.map(one, tree)
 
         self._collapse = jax.jit(
             shard_map(collapse, mesh=mesh, in_specs=stacked, out_specs=P())
@@ -161,6 +171,9 @@ class _MeshPrograms:
 
         off = self._stack_local(np.asarray(list(counters), np.int64))
         stacked = jax.tree.map(self._stack_local, host_tree)
+        if os.environ.get("KFT_DEBUG_SYNC"):
+            sig = [(str(l.dtype), tuple(l.shape)) for l in jax.tree.leaves(stacked)]
+            log.info("sync_state sig: off=%s %s tree=%s", off.dtype, off.shape, sig)
         off_out, tree_out = self._sync(off, stacked)
         # rows are identical post-pmax; read this process's local shard
         row = np.asarray(off_out.addressable_shards[0].data).reshape(-1)

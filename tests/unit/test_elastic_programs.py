@@ -1,0 +1,62 @@
+"""_MeshPrograms.sync_state dtype contract: integer leaves must survive the
+broadcast+collapse unchanged (regression: pmean promoted int32 EMA counters
+to float32 on survivors, so the next resize's sync program disagreed with a
+fresh joiner's int leaves and Gloo died with a size mismatch)."""
+import numpy as np
+import optax
+
+from kungfu_tpu.elastic.trainer import _MeshPrograms
+from kungfu_tpu.train import DataParallelTrainer
+
+
+def _programs():
+    trainer = DataParallelTrainer(lambda p, b: 0.0, optax.sgd(0.1))
+    return _MeshPrograms(trainer)
+
+
+def test_sync_state_preserves_int_dtypes():
+    progs = _programs()
+    tree = {
+        "count": np.asarray(3, np.int32),
+        "value": np.asarray(1.5, np.float32),
+        "step64": np.asarray(9, np.int64),
+    }
+    counters, out = progs.sync_state((5, 7), tree)
+    assert counters == (5, 7)
+    assert np.asarray(out["count"]).dtype == np.int32
+    assert np.asarray(out["value"]).dtype == np.float32
+    # x64-disabled jax canonicalizes int64 inputs to int32 on placement —
+    # what matters is that the result stays an integer type
+    assert np.issubdtype(np.asarray(out["step64"]).dtype, np.integer)
+    assert int(np.asarray(out["count"])) == 3
+    assert float(np.asarray(out["value"])) == 1.5
+
+
+def test_sync_state_roundtrips_gns_state_shape():
+    """The exact optimizer-state tree from the GNS chain syncs unchanged."""
+    import jax
+    import jax.numpy as jnp
+
+    from kungfu_tpu.models.slp import SLP, softmax_cross_entropy
+    from kungfu_tpu.optimizers import synchronous_sgd
+    from kungfu_tpu.optimizers.monitor import gradient_noise_scale
+
+    model = SLP()
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))["params"]
+    tx = gradient_noise_scale(synchronous_sgd(optax.sgd(0.1)), local_batch_size=8)
+
+    def loss_fn(p, b):
+        x, y = b
+        return softmax_cross_entropy(model.apply({"params": p}, x), y)
+
+    trainer = DataParallelTrainer(loss_fn, tx)
+    state = trainer.init(params)
+    progs = _MeshPrograms(trainer)
+
+    def snap(tree):
+        return jax.tree.map(lambda x: np.asarray(x), tree)
+
+    before = [np.asarray(l).dtype for l in jax.tree.leaves(snap(state.opt_state))]
+    _, synced = progs.sync_state((0, 0), {"opt": snap(state.opt_state)})
+    after = [np.asarray(l).dtype for l in jax.tree.leaves(synced["opt"])]
+    assert before == after, (before, after)
