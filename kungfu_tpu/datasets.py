@@ -58,6 +58,53 @@ def mnist(data_dir: str = "./data") -> Tuple[np.ndarray, np.ndarray]:
     return got if got is not None else synthetic_mnist()
 
 
+def load_cifar10(data_dir: str) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Read the public CIFAR-10 binary batches if present, else None.
+
+    Reference helper parity (srcs/python/kungfu/tensorflow/v1/helpers/
+    cifar): each record in data_batch_{1..5}.bin is 1 label byte + 3072
+    CHW image bytes.  Returns NHWC float32 in [0, 1] + int32 labels.
+    For ImageNet-scale data use the chunked idx directories in
+    kungfu_tpu.data_files (memory-mapped, file-sharded, elastic reshard).
+    """
+    names = [f"data_batch_{i}.bin" for i in range(1, 6)]
+    paths = [os.path.join(data_dir, n) for n in names]
+    # also accept the cifar-10-batches-bin subdir layout of the tarball
+    sub = os.path.join(data_dir, "cifar-10-batches-bin")
+    if not all(os.path.exists(p) for p in paths) and os.path.isdir(sub):
+        paths = [os.path.join(sub, n) for n in names]
+    if not all(os.path.exists(p) for p in paths):
+        return None
+    record = 1 + 3072
+    images, labels = [], []
+    for p in paths:
+        raw = np.frombuffer(open(p, "rb").read(), np.uint8)
+        if raw.size % record:
+            raise ValueError(f"{p}: not a CIFAR-10 binary batch")
+        raw = raw.reshape(-1, record)
+        labels.append(raw[:, 0].astype(np.int32))
+        chw = raw[:, 1:].reshape(-1, 3, 32, 32)
+        images.append(chw.transpose(0, 2, 3, 1))  # -> NHWC
+    return (
+        np.concatenate(images).astype(np.float32) / 255.0,
+        np.concatenate(labels),
+    )
+
+
+def synthetic_cifar10(n: int = 8192, seed: int = 42) -> Tuple[np.ndarray, np.ndarray]:
+    """CIFAR-shaped synthetic data (same template trick as synthetic_mnist)."""
+    rng = np.random.RandomState(seed)
+    templates = rng.randn(10, 32 * 32 * 3).astype(np.float32)
+    labels = rng.randint(0, 10, size=n)
+    images = templates[labels] + 0.35 * rng.randn(n, 32 * 32 * 3).astype(np.float32)
+    return images.reshape(n, 32, 32, 3).astype(np.float32), labels.astype(np.int32)
+
+
+def cifar10(data_dir: str = "./data") -> Tuple[np.ndarray, np.ndarray]:
+    got = load_cifar10(data_dir)
+    return got if got is not None else synthetic_cifar10()
+
+
 @dataclass
 class ElasticDataAdaptor:
     """skip -> shard -> batch, resumable by global sample offset.

@@ -109,3 +109,27 @@ def test_missing_dir_and_mismatched_chunks(tmp_path):
     labels = np.zeros(3, np.int32)  # length mismatch
     with pytest.raises(ValueError):
         df.write_chunks(str(tmp_path), images, labels)
+
+
+def test_cifar10_binary_roundtrip(tmp_path):
+    from kungfu_tpu.datasets import load_cifar10, synthetic_cifar10
+
+    rng = np.random.RandomState(0)
+    # write 5 tiny CIFAR-format batches: 1 label byte + 3072 CHW bytes/record
+    all_labels, all_imgs = [], []
+    for i in range(1, 6):
+        labs = rng.randint(0, 10, size=4).astype(np.uint8)
+        imgs = rng.randint(0, 256, size=(4, 3, 32, 32), dtype=np.uint8)
+        rec = np.concatenate([labs[:, None], imgs.reshape(4, -1)], axis=1)
+        (tmp_path / f"data_batch_{i}.bin").write_bytes(rec.tobytes())
+        all_labels.append(labs)
+        all_imgs.append(imgs)
+    images, labels = load_cifar10(str(tmp_path))
+    assert images.shape == (20, 32, 32, 3) and images.dtype == np.float32
+    np.testing.assert_array_equal(labels, np.concatenate(all_labels))
+    want = np.concatenate(all_imgs).transpose(0, 2, 3, 1).astype(np.float32) / 255.0
+    np.testing.assert_allclose(images, want)
+    # absent dir -> None; synthetic fallback shapes
+    assert load_cifar10(str(tmp_path / "nope")) is None
+    x, y = synthetic_cifar10(n=32)
+    assert x.shape == (32, 32, 32, 3) and y.shape == (32,)
