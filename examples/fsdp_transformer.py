@@ -87,6 +87,7 @@ def main():
     tokens = rng.randint(0, cfg.vocab_size,
                          size=(args.batch * world, args.seq)).astype(np.int32)
     batch = trainer.shard_batch(tokens)
+    metrics = {"loss": float("nan")}
     for step in range(args.steps):
         state, metrics = trainer.train_step(state, batch)
         if step % 10 == 0 or step == args.steps - 1:

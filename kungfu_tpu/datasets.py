@@ -21,15 +21,23 @@ from typing import Iterator, Optional, Tuple
 import numpy as np
 
 
+def _synthetic_images(
+    shape: Tuple[int, ...], n: int, num_classes: int, seed: int, noise: float
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Deterministic classification data: per-class templates + noise."""
+    rng = np.random.RandomState(seed)
+    dim = int(np.prod(shape))
+    templates = rng.randn(num_classes, dim).astype(np.float32)
+    labels = rng.randint(0, num_classes, size=n)
+    images = templates[labels] + noise * rng.randn(n, dim).astype(np.float32)
+    return images.reshape((n,) + shape).astype(np.float32), labels.astype(np.int32)
+
+
 def synthetic_mnist(
     n: int = 8192, num_classes: int = 10, seed: int = 42, noise: float = 0.35
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Deterministic 28x28 classification data: class templates + noise."""
-    rng = np.random.RandomState(seed)
-    templates = rng.randn(num_classes, 28 * 28).astype(np.float32)
-    labels = rng.randint(0, num_classes, size=n)
-    images = templates[labels] + noise * rng.randn(n, 28 * 28).astype(np.float32)
-    return images.reshape(n, 28, 28, 1).astype(np.float32), labels.astype(np.int32)
+    return _synthetic_images((28, 28, 1), n, num_classes, seed, noise)
 
 
 def load_mnist_idx(data_dir: str) -> Optional[Tuple[np.ndarray, np.ndarray]]:
@@ -78,7 +86,8 @@ def load_cifar10(data_dir: str) -> Optional[Tuple[np.ndarray, np.ndarray]]:
     record = 1 + 3072
     images, labels = [], []
     for p in paths:
-        raw = np.frombuffer(open(p, "rb").read(), np.uint8)
+        with open(p, "rb") as f:
+            raw = np.frombuffer(f.read(), np.uint8)
         if raw.size % record:
             raise ValueError(f"{p}: not a CIFAR-10 binary batch")
         raw = raw.reshape(-1, record)
@@ -93,11 +102,7 @@ def load_cifar10(data_dir: str) -> Optional[Tuple[np.ndarray, np.ndarray]]:
 
 def synthetic_cifar10(n: int = 8192, seed: int = 42) -> Tuple[np.ndarray, np.ndarray]:
     """CIFAR-shaped synthetic data (same template trick as synthetic_mnist)."""
-    rng = np.random.RandomState(seed)
-    templates = rng.randn(10, 32 * 32 * 3).astype(np.float32)
-    labels = rng.randint(0, 10, size=n)
-    images = templates[labels] + 0.35 * rng.randn(n, 32 * 32 * 3).astype(np.float32)
-    return images.reshape(n, 32, 32, 3).astype(np.float32), labels.astype(np.int32)
+    return _synthetic_images((32, 32, 3), n, 10, seed, 0.35)
 
 
 def cifar10(data_dir: str = "./data") -> Tuple[np.ndarray, np.ndarray]:

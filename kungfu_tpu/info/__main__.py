@@ -9,6 +9,13 @@ import sys
 def main() -> int:
     info = {"framework": "kungfu_tpu", "version": "0.1.0"}
     try:
+        # honor JAX_PLATFORMS like launcher workers do (the TPU tunnel's
+        # sitecustomize overrides it via jax.config, so env alone is not
+        # enough) — `JAX_PLATFORMS=cpu python -m kungfu_tpu.info` must not
+        # touch the chip
+        from ..env import apply_platform_override
+
+        apply_platform_override()
         import jax
 
         info["jax"] = jax.__version__

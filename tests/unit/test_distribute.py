@@ -1,5 +1,6 @@
 """Distribute/rrun + platform adapters + info (reference kungfu-distribute,
 kungfu-rrun, platforms/modelarts, kungfu.info)."""
+import os
 import json
 import subprocess
 import sys
@@ -71,9 +72,12 @@ class TestPlatforms:
 
 
 def test_info_module():
+    # pin cpu: the unit suite must not depend on the TPU tunnel being up
+    # (kungfu_tpu.info honors JAX_PLATFORMS via apply_platform_override)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
     r = subprocess.run(
         [sys.executable, "-m", "kungfu_tpu.info"],
-        capture_output=True, text=True, timeout=120,
+        capture_output=True, text=True, timeout=120, env=env,
     )
     assert r.returncode == 0, r.stderr[-1000:]
     info = json.loads(r.stdout)
