@@ -284,12 +284,51 @@ def config_elastic_gns(full: bool = False) -> dict:
             "error": f"no RESULT (rc={r.returncode}): {r.stderr[-400:]}"}
 
 
+def config_attention() -> dict:
+    """Flash (Pallas) vs full (einsum) attention on-chip, fwd+grad, per
+    sequence length — the kernel-evidence record (ops/flash.py claim site).
+    """
+    import jax
+
+    from . import bench_attention
+
+    try:
+        rows = []
+        for L in (1024, 2048, 4096):
+            out = bench_attention(
+                batch=4, seq_len=L, heads=16, head_dim=64, steps=10, warmup=2,
+                grad=True,
+            )
+            rows.append(
+                {
+                    "seq_len": L,
+                    "flash_ms": round(out["flash"] * 1e3, 3),
+                    "full_ms": round(out["full"] * 1e3, 3),
+                    "flash_speedup": round(out["full"] / out["flash"], 3),
+                }
+            )
+        best = max(rows, key=lambda r: r["flash_speedup"])
+        return {
+            "config": "attention-flash-vs-full",
+            "metric": "flash_attention_speedup_vs_full",
+            "value": best["flash_speedup"],
+            "unit": "x (fwd+grad)",
+            "at_seq_len": best["seq_len"],
+            "rows": rows,
+            "backend": jax.default_backend(),
+        }
+    except Exception as e:
+        return {"config": "attention-flash-vs-full",
+                "error": f"{type(e).__name__}: {e}"}
+
+
 CONFIGS = {
     "1": ("mnist-slp-ssgd", lambda args: config_mnist_slp()),
     "2": ("resnet50-ssgd", lambda args: config_resnet50_ssgd()),
     "3": ("bert-sma", lambda args: config_bert_sma()),
     "4": ("resnet50-gossip", lambda args: config_resnet50_gossip()),
     "5": ("elastic-gns", lambda args: config_elastic_gns(full=args.full)),
+    "6": ("attention-flash", lambda args: config_attention()),
 }
 
 
