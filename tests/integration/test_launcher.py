@@ -93,6 +93,17 @@ class TestLauncherE2E:
         )
         assert r.returncode == 3
 
+    def test_all_workers_fail_fast_together(self):
+        """Several workers dead in the same poll sweep must fail-fast
+        cleanly (regression: pending.remove on the emptied list raised
+        ValueError instead of returning the worker's exit code)."""
+        r = run_launcher(
+            ["-np", "4", "--", sys.executable, "-c", "import sys; sys.exit(7)"],
+            timeout=60,
+        )
+        assert r.returncode == 7
+        assert "ValueError" not in r.stderr
+
     def test_strategy_env_forwarded(self):
         r = run_launcher(
             ["-np", "1", "-strategy", "RING", "--", sys.executable, "-c",
