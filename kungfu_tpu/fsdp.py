@@ -83,6 +83,7 @@ class FSDPTrainer:
         remat: bool = False,
         donate: bool = True,
     ):
+        self._donate = donate
         self.loss_fn = loss_fn
         self.tx = tx
         self.mesh = mesh if mesh is not None else make_mesh(fsdp=-1)
@@ -144,54 +145,66 @@ class FSDPTrainer:
 
         return jax.tree.map(scatter, grads)
 
-    def _build_step(self, donate: bool) -> Callable:
-        # NOTE on gradients: value_and_grad differentiates w.r.t. the chunk
-        # inputs THROUGH the all_gather — the autodiff transpose of a tiled
-        # all_gather is exactly psum_scatter, so grads arrive already
-        # reduce_scattered to this device's chunk; _scatter_grads is only
-        # exposed for callers composing manually.  The transpose SUMS the
-        # per-shard loss grads; S-SGD semantics average them (each shard's
-        # loss is the mean over its own batch slice), hence the /n below.
+    def _make_step_body(self, opt_spec) -> Callable:
+        """Per-device (inside-shard_map) step: (params, opt, batch) ->
+        (params, opt, loss), all in the sharded (1, chunk) leaf layout.
+
+        NOTE on gradients: value_and_grad differentiates w.r.t. the chunk
+        inputs THROUGH the all_gather — the autodiff transpose of a tiled
+        all_gather is exactly psum_scatter, so grads arrive already
+        reduce_scattered to this device's chunk; _scatter_grads is only
+        exposed for callers composing manually.  The transpose SUMS the
+        per-shard loss grads; S-SGD semantics average them (each shard's
+        loss is the mean over its own batch slice), hence the /n below.
+        """
         n_shard = self.n_shard
 
+        def squeeze_opt(o):
+            # sharded opt leaves arrive (1, chunk) per device; scalars whole
+            return jax.tree.map(
+                lambda l, s: jnp.squeeze(l, 0) if s == P("fsdp") else l,
+                o, opt_spec,
+            )
+
+        def expand_opt(o):
+            return jax.tree.map(
+                lambda l, s: l[None] if s == P("fsdp") else l, o, opt_spec
+            )
+
+        def step(params, opt_state, batch):
+            chunks = jax.tree.map(lambda c: jnp.squeeze(c, 0), params)
+            opt_state = squeeze_opt(opt_state)
+
+            def compute_loss(ch, b):
+                return self.loss_fn(self._gather_params(ch), b)
+
+            f = jax.checkpoint(compute_loss) if self.remat else compute_loss
+            loss, grads = jax.value_and_grad(f)(chunks, batch)
+            grads = jax.tree.map(
+                lambda g: lax.pmean(g / n_shard, "dp") if self.has_dp
+                else g / n_shard,
+                grads,
+            )
+            updates, opt_state = self.tx.update(grads, opt_state, chunks)
+            chunks = optax.apply_updates(chunks, updates)
+            loss = lax.pmean(loss, self.data_axes)
+            return (
+                jax.tree.map(lambda c: c[None], chunks),
+                expand_opt(opt_state),
+                loss,
+            )
+
+        return step
+
+    def _build_step(self, donate: bool) -> Callable:
         def build(params_template, opt_template):
             param_spec = jax.tree.map(lambda _: P("fsdp", None), params_template)
             opt_spec = self._state_specs(opt_template)
-
-            def squeeze_opt(o):
-                # sharded opt leaves arrive (1, chunk) per device; scalars whole
-                return jax.tree.map(
-                    lambda l, s: jnp.squeeze(l, 0) if s == P("fsdp") else l,
-                    o, opt_spec,
-                )
-
-            def expand_opt(o):
-                return jax.tree.map(
-                    lambda l, s: l[None] if s == P("fsdp") else l, o, opt_spec
-                )
+            single = self._make_step_body(opt_spec)
 
             def step(params, opt_state, batch):
-                chunks = jax.tree.map(lambda c: jnp.squeeze(c, 0), params)
-                opt_state = squeeze_opt(opt_state)
-
-                def compute_loss(ch, b):
-                    return self.loss_fn(self._gather_params(ch), b)
-
-                f = jax.checkpoint(compute_loss) if self.remat else compute_loss
-                loss, grads = jax.value_and_grad(f)(chunks, batch)
-                grads = jax.tree.map(
-                    lambda g: lax.pmean(g / n_shard, "dp") if self.has_dp
-                    else g / n_shard,
-                    grads,
-                )
-                updates, opt_state = self.tx.update(grads, opt_state, chunks)
-                chunks = optax.apply_updates(chunks, updates)
-                loss = lax.pmean(loss, self.data_axes)
-                return (
-                    jax.tree.map(lambda c: c[None], chunks),
-                    expand_opt(opt_state),
-                    {"loss": loss},
-                )
+                params, opt_state, loss = single(params, opt_state, batch)
+                return params, opt_state, {"loss": loss}
 
             fn = _shard_map(
                 step,
@@ -260,6 +273,42 @@ class FSDPTrainer:
             state.params, state.opt_state, batch
         )
         return TrainState(params, opt_state, state.step + 1), metrics
+
+    def train_steps(self, state: TrainState, batch: Any, n: int) -> Tuple[TrainState, Dict]:
+        """Run `n` steps on one device-resident batch in a single dispatch
+        (compiled lax.scan; cached per n) — DataParallelTrainer parity."""
+        if not hasattr(self, "_multi"):
+            self._multi: Dict[int, Callable] = {}
+        fn = self._multi.get(n)
+        if fn is None:
+            fn = self._multi[n] = self._build_multi(state.params, state.opt_state, n)
+        params, opt_state, metrics = fn(state.params, state.opt_state, batch)
+        return TrainState(params, opt_state, state.step + n), metrics
+
+    def _build_multi(self, params_template, opt_template, n: int) -> Callable:
+        param_spec = jax.tree.map(lambda _: P("fsdp", None), params_template)
+        opt_spec = self._state_specs(opt_template)
+        single = self._make_step_body(opt_spec)
+
+        def many(params, opt_state, batch):
+            def body(carry, _):
+                p, o = carry
+                p, o, loss = single(p, o, batch)
+                return (p, o), loss
+
+            (params, opt_state), losses = jax.lax.scan(
+                body, (params, opt_state), None, length=n
+            )
+            return params, opt_state, {"loss": losses[-1]}
+
+        fn = _shard_map(
+            many,
+            mesh=self.mesh,
+            in_specs=(param_spec, opt_spec, P(self.data_axes)),
+            out_specs=(param_spec, opt_spec, P()),
+            check_vma=False,
+        )
+        return jax.jit(fn, donate_argnums=(0, 1) if self._donate else ())
 
     def eval_params(self, state: TrainState) -> Any:
         """Reassemble full params on host from the sharded chunks."""

@@ -100,17 +100,20 @@ class MeshTrainer:
         self._step_fn = self._build_step()
         return TrainState(params=placed, opt_state=opt_state, step=0)
 
-    def _build_step(self):
-        model, tx, loss_fn = self.model, self.tx, self.loss_fn
-        rules = self.rules
+    def _step_body(self, params, opt_state, batch):
+        """One step under the logical rules: shared by the single-step jit
+        and the train_steps scan so the two can never diverge."""
+        with nn.logical_axis_rules(self.rules):
+            loss, grads = jax.value_and_grad(
+                lambda p: self.loss_fn(self.model, p, batch)
+            )(params)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
 
+    def _build_step(self):
         def step(params, opt_state, batch):
-            with nn.logical_axis_rules(rules):
-                loss, grads = jax.value_and_grad(
-                    lambda p: loss_fn(model, p, batch)
-                )(params)
-                updates, opt_state = tx.update(grads, opt_state, params)
-                params = optax.apply_updates(params, updates)
+            params, opt_state, loss = self._step_body(params, opt_state, batch)
             return params, opt_state, {"loss": loss}
 
         return jax.jit(step, donate_argnums=(0, 1) if self._donate else ())
@@ -135,6 +138,35 @@ class MeshTrainer:
                 state.params, state.opt_state, batch
             )
         return TrainState(params, opt_state, state.step + 1), metrics
+
+    def _build_multi_step(self, n: int):
+        def many(params, opt_state, batch):
+            def body(carry, _):
+                p, o = carry
+                p, o, loss = self._step_body(p, o, batch)
+                return (p, o), loss
+
+            (params, opt_state), losses = jax.lax.scan(
+                body, (params, opt_state), None, length=n
+            )
+            return params, opt_state, {"loss": losses[-1]}
+
+        return jax.jit(many, donate_argnums=(0, 1) if self._donate else ())
+
+    def train_steps(self, state: TrainState, batch: Any, n: int) -> Tuple[TrainState, Dict]:
+        """Run `n` steps on one device-resident batch in a single dispatch
+        (compiled lax.scan; cached per n) — same contract as
+        DataParallelTrainer.train_steps."""
+        if self._step_fn is None:
+            raise RuntimeError("call init() before train_steps()")
+        if not hasattr(self, "_multi"):
+            self._multi: Dict[int, Any] = {}
+        fn = self._multi.get(n)
+        if fn is None:
+            fn = self._multi[n] = self._build_multi_step(n)
+        with self.mesh:
+            params, opt_state, metrics = fn(state.params, state.opt_state, batch)
+        return TrainState(params, opt_state, state.step + n), metrics
 
     def eval_params(self, state: TrainState) -> Any:
         """Host copy of the fully materialized params.

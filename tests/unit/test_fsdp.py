@@ -124,3 +124,38 @@ def test_place_state_restore():
         lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
         st.params, st2.params,
     )
+
+
+def test_fsdp_train_steps_matches_single_steps():
+    import jax
+    import optax
+    from kungfu_tpu.fsdp import FSDPTrainer
+    from kungfu_tpu.models.slp import MLP, softmax_cross_entropy
+
+    model = MLP(hidden=(16,), num_classes=10)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 8, 1)))["params"]
+
+    def loss_fn(p, batch):
+        images, labels = batch
+        return softmax_cross_entropy(model.apply({"params": p}, images), labels)
+
+    rng = np.random.RandomState(0)
+    data = (rng.randn(16, 8, 8, 1).astype(np.float32),
+            rng.randint(0, 10, size=16).astype(np.int32))
+
+    a = FSDPTrainer(loss_fn, optax.adam(1e-2))
+    sa = a.init(params)
+    ba = a.shard_batch(data)
+    for _ in range(4):
+        sa, ma = a.train_step(sa, ba)
+
+    b = FSDPTrainer(loss_fn, optax.adam(1e-2))
+    sb = b.init(params)
+    bb = b.shard_batch(data)
+    sb, mb = b.train_steps(sb, bb, n=4)
+    assert sb.step == 4
+    la, lb = float(np.asarray(ma["loss"])), float(np.asarray(mb["loss"]))
+    assert np.isclose(la, lb, rtol=1e-5), (la, lb)
+    pa, pb = a.eval_params(sa), b.eval_params(sb)
+    for x, y in zip(jax.tree.leaves(pa), jax.tree.leaves(pb)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), rtol=1e-5)

@@ -195,3 +195,21 @@ def test_has_aux_requires_model_state():
     )
     with pytest.raises(ValueError, match="model_state"):
         trainer.init({"w": np.zeros(2, np.float32)})
+
+
+def test_mesh_trainer_train_steps_matches_single_steps():
+    tokens = _tokens(8)
+    mesh = make_mesh(MeshSpec.make(dp=8))
+    model = TransformerLM(_cfg(mesh=mesh))
+    a = MeshTrainer(model, _loss_fn, optax.sgd(0.05), mesh=mesh)
+    sa = a.init(jax.random.PRNGKey(0), tokens)
+    b = MeshTrainer(model, _loss_fn, optax.sgd(0.05), mesh=mesh)
+    sb = b.init(jax.random.PRNGKey(0), tokens)
+    batch_a = a.shard_batch(tokens)
+    batch_b = b.shard_batch(tokens)
+    for _ in range(3):
+        sa, ma = a.train_step(sa, batch_a)
+    sb, mb = b.train_steps(sb, batch_b, n=3)
+    assert sb.step == 3
+    la, lb = float(np.asarray(ma["loss"])), float(np.asarray(mb["loss"]))
+    assert np.isclose(la, lb, rtol=1e-5), (la, lb)
