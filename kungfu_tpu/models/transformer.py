@@ -44,14 +44,16 @@ class TransformerConfig:
     max_len: int = 2048
     dtype: Any = jnp.bfloat16
     # "auto" = flash kernel on TPU, plain einsum elsewhere (the Pallas
-    # kernel would run interpreted off-TPU); "ring" = sequence-parallel
-    attention: str = "auto"  # "auto" | "flash" | "full" | "ring"
+    # kernel would run interpreted off-TPU); "ring"/"ulysses" =
+    # sequence-parallel (K/V rotation vs head all_to_all; parallel/
+    # ring_attention.py and parallel/ulysses.py document the trade-off)
+    attention: str = "auto"  # "auto" | "flash" | "full" | "ring" | "ulysses"
     causal: bool = True
     # MoE: every `moe_every`-th block uses experts (0 = dense model)
     n_experts: int = 0
     moe_every: int = 2
     capacity_factor: float = 1.25
-    # mesh is needed only for attention="ring" (shard_map region)
+    # mesh is needed for attention="ring"/"ulysses" (shard_map region)
     mesh: Optional[Mesh] = None
     sp_axis: str = "sp"
 
@@ -91,7 +93,11 @@ class Attention(nn.Module):
         if kind == "auto":
             kind = "flash" if jax.default_backend() == "tpu" else "full"
 
-        if kind == "ring" and cfg.mesh is not None and cfg.sp_axis in cfg.mesh.axis_names:
+        if (
+            kind in ("ring", "ulysses")
+            and cfg.mesh is not None
+            and cfg.sp_axis in cfg.mesh.axis_names
+        ):
             names = cfg.mesh.axis_names
             # keep batch on dp and heads on tp inside the manual region —
             # omitting them would all-gather those dims onto every device
@@ -101,8 +107,16 @@ class Attention(nn.Module):
                 "tp" if "tp" in names else None,
                 None,
             )
+            if kind == "ulysses":
+                from ..parallel.ulysses import ulysses_attention
+
+                fn = partial(
+                    ulysses_attention, axis_name=cfg.sp_axis, causal=cfg.causal
+                )
+            else:
+                fn = partial(ring_attention, axis_name=cfg.sp_axis, causal=cfg.causal)
             attn = _shard_map(
-                partial(ring_attention, axis_name=cfg.sp_axis, causal=cfg.causal),
+                fn,
                 mesh=cfg.mesh,
                 in_specs=(spec, spec, spec),
                 out_specs=spec,

@@ -1,5 +1,6 @@
 """Parallelism beyond DP: TP sharding rules, SP ring attention, PP, EP MoE."""
 from .ring_attention import ring_attention, full_attention
+from .ulysses import ulysses_attention
 from .sharding import DEFAULT_RULES, rules_for_mesh, param_shardings, logical_constraint
 from .pp import (
     pipeline_apply,
@@ -11,7 +12,7 @@ from .pp import (
 from .moe import MoEMLP
 
 __all__ = [
-    "ring_attention", "full_attention",
+    "ring_attention", "full_attention", "ulysses_attention",
     "DEFAULT_RULES", "rules_for_mesh", "param_shardings", "logical_constraint",
     "pipeline_apply", "pipeline_apply_grouped", "pipeline_spmd",
     "stack_stage_params", "stack_group_params", "PipelinedLM",

@@ -66,10 +66,11 @@ class PipelinedLM:
             raise ValueError(f"PipelinedLM needs a mesh with a {pp_axis!r} axis")
         if cfg.n_experts > 0:
             raise ValueError("PipelinedLM supports dense blocks only (no MoE)")
-        if cfg.attention == "ring":
+        if cfg.attention in ("ring", "ulysses"):
             raise ValueError(
-                "ring attention cannot nest inside the pipeline's manual "
-                "region; use attention='auto'/'flash'/'full'"
+                f"{cfg.attention} attention opens its own shard_map and "
+                "cannot nest inside the pipeline's manual region; use "
+                "attention='auto'/'flash'/'full'"
             )
         self.mesh: Mesh = cfg.mesh
         self.pp_axis = pp_axis
