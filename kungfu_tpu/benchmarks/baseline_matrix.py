@@ -284,6 +284,63 @@ def config_elastic_gns(full: bool = False) -> dict:
             "error": f"no RESULT (rc={r.returncode}): {r.stderr[-400:]}"}
 
 
+def config_vgg16(steps: int = 10) -> dict:
+    """VGG-16 S-SGD throughput — the reference's second headline model
+    (README.md:203: ResNet-50 / VGG16 / InceptionV3 sync scalability)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from ..models.slp import softmax_cross_entropy
+    from ..models.vgg import VGG16
+    from ..optimizers import synchronous_sgd
+    from ..train import DataParallelTrainer
+
+    try:
+        n_chips = len(jax.devices())
+        batch = int(os.environ.get("KFT_VGG_BATCH", "64"))
+        model = VGG16(num_classes=1000)
+
+        def loss_fn(params, b):
+            images, labels = b
+            logits = model.apply({"params": params}, images, train=False)
+            return softmax_cross_entropy(logits, labels)
+
+        params = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 224, 224, 3), jnp.bfloat16),
+            train=False,
+        )["params"]
+        trainer = DataParallelTrainer(
+            loss_fn, synchronous_sgd(optax.sgd(0.01, momentum=0.9))
+        )
+        state = trainer.init(params)
+        rng = np.random.RandomState(0)
+        images = jnp.asarray(
+            rng.randn(batch * n_chips, 224, 224, 3), jnp.bfloat16
+        )
+        labels = rng.randint(0, 1000, size=batch * n_chips).astype(np.int32)
+        b = trainer.shard_batch((images, labels))
+        state, m = trainer.train_steps(state, b, n=steps)
+        float(np.asarray(m["loss"]))
+        t0 = time.perf_counter()
+        state, m = trainer.train_steps(state, b, n=steps)
+        float(np.asarray(m["loss"]))
+        dt = time.perf_counter() - t0
+        return {
+            "config": "vgg16-ssgd",
+            "metric": "vgg16_train_images_per_sec_per_chip",
+            "dropout_disabled": True,  # throughput config; no rng threading
+            "value": round(steps * batch / dt, 2),
+            "unit": "images/sec/chip",
+            "step_ms": round(dt / steps * 1e3, 2),
+            "batch_per_chip": batch,
+            "backend": jax.default_backend(),
+        }
+    except Exception as e:
+        return {"config": "vgg16-ssgd", "error": f"{type(e).__name__}: {e}"}
+
+
 def config_attention() -> dict:
     """Flash (Pallas) vs full (einsum) attention on-chip, fwd+grad, per
     sequence length — the kernel-evidence record (ops/flash.py claim site).
@@ -329,6 +386,7 @@ CONFIGS = {
     "4": ("resnet50-gossip", lambda args: config_resnet50_gossip()),
     "5": ("elastic-gns", lambda args: config_elastic_gns(full=args.full)),
     "6": ("attention-flash", lambda args: config_attention()),
+    "7": ("vgg16-ssgd", lambda args: config_vgg16()),
 }
 
 
