@@ -341,6 +341,67 @@ def config_vgg16(steps: int = 10) -> dict:
         return {"config": "vgg16-ssgd", "error": f"{type(e).__name__}: {e}"}
 
 
+def config_inception(steps: int = 10) -> dict:
+    """InceptionV3 S-SGD throughput — the reference's third headline model."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from ..models.inception import InceptionV3
+    from ..models.slp import softmax_cross_entropy
+    from ..optimizers import synchronous_sgd
+    from ..train import DataParallelTrainer
+
+    try:
+        n_chips = len(jax.devices())
+        batch = int(os.environ.get("KFT_INCEPTION_BATCH", "64"))
+        model = InceptionV3(num_classes=1000)
+
+        def loss_fn(params, model_state, b):
+            images, labels = b
+            logits, mut = model.apply(
+                {"params": params, **model_state}, images, train=True,
+                mutable=["batch_stats"],
+            )
+            return softmax_cross_entropy(logits, labels), mut
+
+        variables = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 299, 299, 3), jnp.bfloat16),
+            train=False,
+        )
+        trainer = DataParallelTrainer(
+            loss_fn, synchronous_sgd(optax.sgd(0.1, momentum=0.9)), has_aux=True
+        )
+        state = trainer.init(
+            variables["params"],
+            model_state={"batch_stats": variables["batch_stats"]},
+        )
+        rng = np.random.RandomState(0)
+        images = jnp.asarray(
+            rng.randn(batch * n_chips, 299, 299, 3), jnp.bfloat16
+        )
+        labels = rng.randint(0, 1000, size=batch * n_chips).astype(np.int32)
+        b = trainer.shard_batch((images, labels))
+        state, m = trainer.train_steps(state, b, n=steps)
+        float(np.asarray(m["loss"]))
+        t0 = time.perf_counter()
+        state, m = trainer.train_steps(state, b, n=steps)
+        float(np.asarray(m["loss"]))
+        dt = time.perf_counter() - t0
+        return {
+            "config": "inception-v3-ssgd",
+            "metric": "inception_v3_train_images_per_sec_per_chip",
+            "value": round(steps * batch / dt, 2),
+            "unit": "images/sec/chip",
+            "step_ms": round(dt / steps * 1e3, 2),
+            "batch_per_chip": batch,
+            "backend": jax.default_backend(),
+        }
+    except Exception as e:
+        return {"config": "inception-v3-ssgd", "error": f"{type(e).__name__}: {e}"}
+
+
 def config_attention() -> dict:
     """Flash (Pallas) vs full (einsum) attention on-chip, fwd+grad, per
     sequence length — the kernel-evidence record (ops/flash.py claim site).
@@ -387,6 +448,7 @@ CONFIGS = {
     "5": ("elastic-gns", lambda args: config_elastic_gns(full=args.full)),
     "6": ("attention-flash", lambda args: config_attention()),
     "7": ("vgg16-ssgd", lambda args: config_vgg16()),
+    "8": ("inception-ssgd", lambda args: config_inception()),
 }
 
 

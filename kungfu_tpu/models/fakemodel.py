@@ -46,6 +46,13 @@ def vgg16_imagenet() -> tuple:
 
 
 @functools.lru_cache(maxsize=None)
+def inception_v3_imagenet() -> tuple:
+    from .inception import InceptionV3
+
+    return tuple(_sizes_from_flax(InceptionV3(), (1, 299, 299, 3)))
+
+
+@functools.lru_cache(maxsize=None)
 def bert_base() -> tuple:
     """BERT-base grad sizes, generated analytically (L=12, H=768, A=12, V=30522)."""
     L, H, I, V, P, T = 12, 768, 3072, 30522, 512, 2
@@ -63,6 +70,7 @@ REGISTRY: Dict[str, callable] = {
     "slp-mnist": slp_mnist,
     "resnet50-imagenet": resnet50_imagenet,
     "vgg16-imagenet": vgg16_imagenet,
+    "inception-v3-imagenet": inception_v3_imagenet,
     "bert-base": bert_base,
 }
 

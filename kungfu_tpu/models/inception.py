@@ -1,0 +1,178 @@
+"""InceptionV3 in Flax, TPU-tuned (NHWC, bf16 compute, fp32 BN option).
+
+The reference's headline sync-throughput benchmark covers ResNet-50 /
+VGG16 / InceptionV3 (README.md:203-213) but ships no model code (it wraps
+tf.keras applications).  This is a from-scratch Flax implementation of the
+standard InceptionV3 topology (Szegedy et al. 2015; torchvision/keras
+channel structure): 299x299 input, stem, 3x InceptionA, InceptionB,
+4x InceptionC, InceptionD, 2x InceptionE, global pool, 1000-way head.
+The optional aux classifier head (training regularizer) is gated on
+`aux_logits`.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence, Tuple
+
+import jax.numpy as jnp
+import flax.linen as nn
+
+ModuleDef = Any
+
+
+class ConvBN(nn.Module):
+    features: int
+    kernel: Tuple[int, int]
+    strides: Tuple[int, int] = (1, 1)
+    padding: Any = "SAME"
+    dtype: Any = jnp.bfloat16
+    norm_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(
+            self.features, self.kernel, self.strides, padding=self.padding,
+            use_bias=False, dtype=self.dtype,
+        )(x)
+        x = nn.BatchNorm(
+            use_running_average=not train, momentum=0.9, epsilon=1e-3,
+            dtype=self.norm_dtype,
+        )(x)
+        return nn.relu(x)
+
+
+def _pool_avg(x):
+    return nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+    conv: ModuleDef
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        c = self.conv
+        b1 = c(64, (1, 1))(x, train)
+        b2 = c(48, (1, 1))(x, train)
+        b2 = c(64, (5, 5))(b2, train)
+        b3 = c(64, (1, 1))(x, train)
+        b3 = c(96, (3, 3))(b3, train)
+        b3 = c(96, (3, 3))(b3, train)
+        b4 = c(self.pool_features, (1, 1))(_pool_avg(x), train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionB(nn.Module):
+    conv: ModuleDef
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        c = self.conv
+        b1 = c(384, (3, 3), strides=(2, 2), padding="VALID")(x, train)
+        b2 = c(64, (1, 1))(x, train)
+        b2 = c(96, (3, 3))(b2, train)
+        b2 = c(96, (3, 3), strides=(2, 2), padding="VALID")(b2, train)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionC(nn.Module):
+    channels_7x7: int
+    conv: ModuleDef
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        c, c7 = self.conv, self.channels_7x7
+        b1 = c(192, (1, 1))(x, train)
+        b2 = c(c7, (1, 1))(x, train)
+        b2 = c(c7, (1, 7))(b2, train)
+        b2 = c(192, (7, 1))(b2, train)
+        b3 = c(c7, (1, 1))(x, train)
+        b3 = c(c7, (7, 1))(b3, train)
+        b3 = c(c7, (1, 7))(b3, train)
+        b3 = c(c7, (7, 1))(b3, train)
+        b3 = c(192, (1, 7))(b3, train)
+        b4 = c(192, (1, 1))(_pool_avg(x), train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionD(nn.Module):
+    conv: ModuleDef
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        c = self.conv
+        b1 = c(192, (1, 1))(x, train)
+        b1 = c(320, (3, 3), strides=(2, 2), padding="VALID")(b1, train)
+        b2 = c(192, (1, 1))(x, train)
+        b2 = c(192, (1, 7))(b2, train)
+        b2 = c(192, (7, 1))(b2, train)
+        b2 = c(192, (3, 3), strides=(2, 2), padding="VALID")(b2, train)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionE(nn.Module):
+    conv: ModuleDef
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        c = self.conv
+        b1 = c(320, (1, 1))(x, train)
+        b2 = c(384, (1, 1))(x, train)
+        b2 = jnp.concatenate(
+            [c(384, (1, 3))(b2, train), c(384, (3, 1))(b2, train)], axis=-1
+        )
+        b3 = c(448, (1, 1))(x, train)
+        b3 = c(384, (3, 3))(b3, train)
+        b3 = jnp.concatenate(
+            [c(384, (1, 3))(b3, train), c(384, (3, 1))(b3, train)], axis=-1
+        )
+        b4 = c(192, (1, 1))(_pool_avg(x), train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    norm_dtype: Any = jnp.bfloat16
+    aux_logits: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(ConvBN, dtype=self.dtype, norm_dtype=self.norm_dtype)
+        x = x.astype(self.dtype)
+        # stem (299x299x3 -> 35x35x192)
+        x = conv(32, (3, 3), strides=(2, 2), padding="VALID")(x, train)
+        x = conv(32, (3, 3), padding="VALID")(x, train)
+        x = conv(64, (3, 3))(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        x = conv(80, (1, 1), padding="VALID")(x, train)
+        x = conv(192, (3, 3), padding="VALID")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        # 35x35
+        x = InceptionA(32, conv)(x, train)
+        x = InceptionA(64, conv)(x, train)
+        x = InceptionA(64, conv)(x, train)
+        # 17x17
+        x = InceptionB(conv)(x, train)
+        x = InceptionC(128, conv)(x, train)
+        x = InceptionC(160, conv)(x, train)
+        x = InceptionC(160, conv)(x, train)
+        x = InceptionC(192, conv)(x, train)
+        aux = None
+        if self.aux_logits:
+            a = nn.avg_pool(x, (5, 5), strides=(3, 3), padding="VALID")
+            a = conv(128, (1, 1))(a, train)
+            a = conv(768, (5, 5), padding="VALID")(a, train)
+            a = jnp.mean(a, axis=(1, 2))
+            aux = nn.Dense(self.num_classes, dtype=jnp.float32, name="aux_head")(a)
+        # 8x8
+        x = InceptionD(conv)(x, train)
+        x = InceptionE(conv)(x, train)
+        x = InceptionE(conv)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        logits = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        if self.aux_logits:
+            return logits, aux
+        return logits

@@ -1,0 +1,46 @@
+"""InceptionV3: canonical topology (param count matches the public
+23.83M-parameter InceptionV3 without aux head) and a real tiny forward."""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from kungfu_tpu.models.inception import InceptionV3
+
+
+def test_param_count_matches_canonical():
+    m = InceptionV3(dtype=jnp.float32, norm_dtype=jnp.float32)
+    v = jax.eval_shape(
+        lambda: m.init(jax.random.PRNGKey(0), jnp.zeros((1, 299, 299, 3)), train=False)
+    )
+    n = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(v["params"]))
+    assert n == 23_834_568, n  # torchvision inception_v3(aux_logits=False)
+
+
+def test_forward_executes_and_shapes():
+    m = InceptionV3(num_classes=10, dtype=jnp.float32, norm_dtype=jnp.float32)
+    x = np.random.RandomState(0).randn(1, 299, 299, 3).astype(np.float32)
+    v = m.init(jax.random.PRNGKey(0), x, train=False)
+    logits = m.apply(v, x, train=False)
+    assert logits.shape == (1, 10)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_aux_head_shapes():
+    m = InceptionV3(num_classes=10, aux_logits=True,
+                    dtype=jnp.float32, norm_dtype=jnp.float32)
+    out = jax.eval_shape(
+        lambda: m.init(jax.random.PRNGKey(0), jnp.zeros((1, 299, 299, 3)), train=False)
+    )
+    shapes = jax.eval_shape(
+        lambda p: m.apply(p, jnp.zeros((2, 299, 299, 3)), train=False), out
+    )
+    logits, aux = shapes
+    assert logits.shape == (2, 10) and aux.shape == (2, 10)
+
+
+def test_fakemodel_registry_has_inception():
+    from kungfu_tpu.models.fakemodel import get_sizes
+
+    sizes = get_sizes("inception-v3-imagenet")
+    assert sum(sizes) == 23_834_568
