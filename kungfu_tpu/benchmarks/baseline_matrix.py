@@ -7,16 +7,21 @@ single-chip + single-host environment can express and persists them:
 
     python -m kungfu_tpu.benchmarks.baseline_matrix --out BENCH_CONFIGS.json
 
-Configs (BASELINE.json "configs", in order):
-  1 mnist-slp-ssgd     SLP + SynchronousSGD under the launcher, -np 1, CPU
-  2 resnet50-ssgd      ResNet-50 S-SGD throughput (bench.py harness; runs
-                       on the real chip when present)
-  3 bert-sma           BERT-base-shaped transformer LM + SynchronousAveraging
-  4 resnet50-gossip    ResNet-50 + PairAveraging (SPMD ppermute variant; the
-                       host-store async variant is measured per-step)
-  5 elastic-gns        resize drill (grow x4 then halve, the 8->32->16 shape
-                       scaled to the host; --full runs the literal sizes)
-                       with the gradient-noise-scale monitor on
+Configs (record keys; 1-5 are BASELINE.json "configs" in order, 6-8 extend
+to the kernel-evidence record and the reference's other headline models):
+  1 mnist-slp-ssgd--np1-cpu  SLP + SynchronousSGD under the launcher, -np 1, CPU
+  2 resnet50-ssgd-dp         ResNet-50 S-SGD throughput (bench.py harness; runs
+                             on the real chip when present)
+  3 bert-base-sma            BERT-base-shaped LM + SynchronousAveraging
+                             (measured at KFT_BERT_BATCH, default 64/chip)
+  4 resnet50-gossip          ResNet-50 + PairAveraging (SPMD ppermute variant;
+                             the host-store async variant is measured per-step)
+  5 elastic-resize-gns       resize drill (grow x4 then halve, the 8->32->16
+                             shape scaled to the host; --full runs the literal
+                             sizes) with the gradient-noise-scale monitor on
+  6 attention-flash-vs-full  Pallas flash vs einsum attention on-chip, fwd+grad
+  7 vgg16-ssgd               VGG-16 S-SGD throughput
+  8 inception-v3-ssgd        InceptionV3 S-SGD throughput
 
 Configs needing the TPU degrade to an {"error": ...} record instead of
 sinking the matrix when the chip is unreachable.
@@ -24,24 +29,105 @@ sinking the matrix when the chip is unreachable.
 from __future__ import annotations
 
 import argparse
+import glob
 import json
 import os
+import signal
 import subprocess
 import sys
+import tempfile
 import time
 
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _root_bench():
+    """Import the repo-root bench.py by explicit path (not `import bench`,
+    which a same-named third-party module in sys.modules would shadow)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "kungfu_tpu._root_bench", os.path.join(_REPO, "bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _descendants(pid: int) -> list:
+    """All live descendant pids of `pid`, depth-first via /proc.
+
+    Sessions/process groups are NOT enough here: nested _run calls each
+    start their own session (matrix child -> launcher -> workers), so a
+    killpg on the direct child's group misses grand-descendants.  The /proc
+    children files see through session boundaries.
+    """
+    out, stack = [], [pid]
+    while stack:
+        p = stack.pop()
+        try:
+            for f in glob.glob(f"/proc/{p}/task/*/children"):
+                with open(f) as fh:
+                    kids = [int(c) for c in fh.read().split()]
+                out.extend(kids)
+                stack.extend(kids)
+        except (OSError, ValueError):
+            pass
+    return out
+
+
+def _kill_tree(pid: int) -> None:
+    """SIGKILL `pid` and every descendant.
+
+    Everything is SIGSTOPped first (root before snapshot): a live watch-mode
+    launcher would otherwise respawn workers between the descendant snapshot
+    and its own kill, and the respawns would survive.
+    """
+    def _sig(p, s):
+        try:
+            os.kill(p, s)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+    _sig(pid, signal.SIGSTOP)  # freeze the root: no more forks
+    victims = _descendants(pid)
+    for v in victims:
+        _sig(v, signal.SIGSTOP)
+    # re-snapshot: anything forked between the root stop and child stops
+    victims = _descendants(pid)
+    for v in reversed(victims):
+        _sig(v, signal.SIGKILL)
+    try:
+        os.killpg(pid, signal.SIGKILL)  # belt and braces for same-group kids
+    except (OSError, PermissionError):
+        pass
+    _sig(pid, signal.SIGKILL)
+
+
 def _run(cmd, timeout, env_extra=None):
+    """Run `cmd` with a timeout that kills the WHOLE process tree.
+
+    Configs spawn grandchildren (bench.py, launcher workers); plain
+    subprocess.run(timeout=...) would kill only the direct child and leave a
+    wedged grandchild holding the TPU, cascading timeouts into every later
+    config.
+    """
     env = dict(os.environ)
     env.setdefault("PYTHONPATH", "")
     env["PYTHONPATH"] = _REPO + os.pathsep + env["PYTHONPATH"]
     if env_extra:
         env.update(env_extra)
-    return subprocess.run(
-        cmd, capture_output=True, text=True, timeout=timeout, env=env, cwd=_REPO
+    p = subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env, cwd=_REPO, start_new_session=True,
     )
+    try:
+        out, err = p.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        _kill_tree(p.pid)
+        p.wait()
+        raise
+    return subprocess.CompletedProcess(cmd, p.returncode, out, err)
 
 
 def config_mnist_slp() -> dict:
@@ -123,6 +209,20 @@ def _lm_throughput(tx, per_replica: bool, batch_per_chip: int, steps: int,
     float(np.asarray(m["loss"]))
     dt = time.perf_counter() - t0
     toks = steps * global_batch * seq_len / dt
+
+    # approximate model FLOPs per token: 6N (fwd 2N + bwd 4N) plus the
+    # attention-matrix term 12 * layers * seq * d_model (QK^T + AV, 3x for
+    # training) — the standard 6ND accounting, not XLA's padded count
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    flops_per_token = 6 * n_params + 12 * cfg.n_layers * seq_len * cfg.d_model
+    mfu = None
+    if jax.default_backend() == "tpu":
+        try:  # optional metric: never let a lookup failure sink the record
+            (peak, _), _kind = _root_bench()._peak_specs_per_chip()
+            if peak:
+                mfu = round(toks / n_chips * flops_per_token / peak, 4)
+        except Exception:
+            pass
     return {
         "tokens_per_sec_per_chip": round(toks / n_chips, 1),
         "seq_per_sec_per_chip": round(toks / seq_len / n_chips, 2),
@@ -130,6 +230,8 @@ def _lm_throughput(tx, per_replica: bool, batch_per_chip: int, steps: int,
         "batch_per_chip": batch_per_chip,
         "seq_len": seq_len,
         "n_chips": n_chips,
+        "n_params": int(n_params),
+        "mfu": mfu,
         "backend": jax.default_backend(),
     }
 
@@ -143,7 +245,7 @@ def config_bert_sma(steps: int = 10) -> dict:
     try:
         d = _lm_throughput(
             synchronous_averaging(optax.adamw(1e-4)), per_replica=True,
-            batch_per_chip=int(os.environ.get("KFT_BERT_BATCH", "16")),
+            batch_per_chip=int(os.environ.get("KFT_BERT_BATCH", "64")),
             steps=steps,
         )
     except Exception as e:
@@ -440,21 +542,60 @@ def config_attention() -> dict:
                 "error": f"{type(e).__name__}: {e}"}
 
 
+# id -> (record key — the exact "config" value the function emits, so error
+# records written by the parent replace/get replaced by real ones — , runner)
 CONFIGS = {
-    "1": ("mnist-slp-ssgd", lambda args: config_mnist_slp()),
-    "2": ("resnet50-ssgd", lambda args: config_resnet50_ssgd()),
-    "3": ("bert-sma", lambda args: config_bert_sma()),
+    "1": ("mnist-slp-ssgd--np1-cpu", lambda args: config_mnist_slp()),
+    "2": ("resnet50-ssgd-dp", lambda args: config_resnet50_ssgd()),
+    "3": ("bert-base-sma", lambda args: config_bert_sma()),
     "4": ("resnet50-gossip", lambda args: config_resnet50_gossip()),
-    "5": ("elastic-gns", lambda args: config_elastic_gns(full=args.full)),
-    "6": ("attention-flash", lambda args: config_attention()),
+    "5": ("elastic-resize-gns", lambda args: config_elastic_gns(full=args.full)),
+    "6": ("attention-flash-vs-full", lambda args: config_attention()),
     "7": ("vgg16-ssgd", lambda args: config_vgg16()),
-    "8": ("inception-ssgd", lambda args: config_inception()),
+    "8": ("inception-v3-ssgd", lambda args: config_inception()),
 }
+
+
+def _load_results(out_path: str) -> dict:
+    if os.path.exists(out_path):
+        try:
+            with open(out_path) as f:
+                return {
+                    r.get("config"): r for r in json.load(f).get("results", [])
+                }
+        except (OSError, ValueError):
+            pass
+    return {}
+
+
+def _persist_results(out_path: str, existing: dict) -> None:
+    """Atomic write (temp + rename): a kill mid-write can never truncate the
+    shared results file and lose previously recorded configs."""
+    d = os.path.dirname(os.path.abspath(out_path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump({"generated_by": "kungfu_tpu.benchmarks.baseline_matrix",
+                       "results": list(existing.values())}, f, indent=1)
+        os.replace(tmp, out_path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def _merge_into(out_path: str, rec: dict) -> None:
+    """Merge one record into the results file keyed by its config name."""
+    existing = _load_results(out_path)
+    existing[rec["config"]] = rec
+    _persist_results(out_path, existing)
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="kungfu_tpu.benchmarks.baseline_matrix")
-    ap.add_argument("--only", default="", help="comma-separated config ids (1-5)")
+    ap.add_argument("--only", default="", help="comma-separated config ids (1-8)")
     ap.add_argument("--out", default="BENCH_CONFIGS.json")
     ap.add_argument("--full", action="store_true",
                     help="literal 8->32->16 elastic drill (needs a big host)")
@@ -464,30 +605,65 @@ def main(argv=None) -> int:
     unknown = [w for w in want if w not in CONFIGS]
     if unknown:
         ap.error(f"unknown config ids {unknown}; valid: {sorted(CONFIGS)}")
-    existing = {}
-    if os.path.exists(args.out):
-        try:
-            with open(args.out) as f:
-                existing = {
-                    r.get("config"): r for r in json.load(f).get("results", [])
-                }
-        except (OSError, ValueError):
-            pass
 
-    def persist():
-        with open(args.out, "w") as f:
-            json.dump({"generated_by": "kungfu_tpu.benchmarks.baseline_matrix",
-                       "results": list(existing.values())}, f, indent=1)
+    # Run each config in its own subprocess when several were asked for: a
+    # wedged TPU-tunnel dispatch (observed: a single hung XLA compile) then
+    # costs one {"error": "timeout"} record instead of sinking the matrix.
+    # The child re-enters main() with a single config id and writes/merges
+    # into the same --out file.
+    # must EXCEED the largest inner _run timeout (1800s in config 2/5) plus
+    # interpreter startup, so a wedged grandchild hits the child's own
+    # timeout first and the child records real diagnostics; the parent kill
+    # is the backstop
+    per_cfg_timeout = float(os.environ.get("KFT_MATRIX_CONFIG_TIMEOUT", "2100"))
+    # children run with cwd=_REPO; resolve --out against the INVOKING cwd so
+    # parent and children agree on one file
+    out = os.path.abspath(args.out)
+    if len(want) > 1 and os.environ.get("KFT_MATRIX_SUBPROC", "1") != "0":
+        rc = 0
+        for cid in want:
+            name, _ = CONFIGS[cid]
+            print(f"# spawning config {cid}: {name}", file=sys.stderr)
+            cmd = [sys.executable, "-m", "kungfu_tpu.benchmarks.baseline_matrix",
+                   "--only", cid, "--out", out]
+            if args.full:
+                cmd.append("--full")
+            before = _load_results(out).get(name)
+
+            def fail_record(err: str):
+                # a failed child merged nothing — record the failure so the
+                # matrix never silently omits a config.  But a child can
+                # also merge its measurement and THEN die in teardown
+                # (observed: the TPU tunnel wedging the JAX runtime at
+                # exit); if the stored record changed during this spawn,
+                # keep the child's record.
+                if _load_results(out).get(name) != before:
+                    return
+                rec = {"config": name, "error": err}
+                _merge_into(out, rec)
+                print(json.dumps(rec), flush=True)
+
+            try:
+                r = _run(cmd, timeout=per_cfg_timeout)
+                sys.stdout.write(r.stdout)
+                sys.stdout.flush()
+                if r.returncode != 0:
+                    print(f"# config {cid} rc={r.returncode}: {r.stderr[-400:]}",
+                          file=sys.stderr)
+                    fail_record(f"child rc={r.returncode}: {r.stderr[-300:]}")
+                    rc = 1
+            except subprocess.TimeoutExpired:
+                fail_record(f"timeout after {per_cfg_timeout:.0f}s "
+                            "(TPU tunnel wedged)")
+                rc = 1
+        return rc
 
     for cid in want:
         name, fn = CONFIGS[cid]
         print(f"# running config {cid}: {name}", file=sys.stderr)
         rec = fn(args)
-        existing[rec["config"]] = rec
         print(json.dumps(rec), flush=True)
-        persist()  # after every config: a mid-matrix crash loses nothing
-
-    persist()
+        _merge_into(out, rec)  # after every config: a crash loses nothing
     return 0
 
 

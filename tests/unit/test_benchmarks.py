@@ -73,3 +73,27 @@ def test_cli_main(capsys):
     rc = main(["--bench", "p2p", "--p2p-size", "4096", "--steps", "5"])
     assert rc == 0
     assert "bench=p2p" in capsys.readouterr().out
+
+
+def test_baseline_matrix_merge(tmp_path):
+    """_merge_into keys records by config name and survives a corrupt file."""
+    from kungfu_tpu.benchmarks import baseline_matrix as bm
+
+    out = str(tmp_path / "m.json")
+    bm._merge_into(out, {"config": "a", "value": 1})
+    bm._merge_into(out, {"config": "b", "value": 2})
+    bm._merge_into(out, {"config": "a", "value": 3})  # overwrite, not append
+    import json
+
+    with open(out) as f:
+        recs = {r["config"]: r for r in json.load(f)["results"]}
+    assert recs["a"]["value"] == 3 and recs["b"]["value"] == 2
+
+    # writes are atomic (temp + os.replace), so our own kills can never
+    # truncate the file; an EXTERNALLY corrupted file degrades to fresh
+    with open(out, "w") as f:
+        f.write("{corrupt")
+    bm._merge_into(out, {"config": "c", "value": 4})
+    with open(out) as f:
+        assert [r["config"] for r in json.load(f)["results"]] == ["c"]
+    assert not [p for p in tmp_path.iterdir() if p.suffix == ".tmp"]
