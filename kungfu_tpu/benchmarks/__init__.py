@@ -16,6 +16,7 @@ Reported numbers:
 """
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence
@@ -184,16 +185,32 @@ def bench_attention(
 
     steps = max(1, steps)
     warmup = max(1, warmup)  # first call is compile; timing it is never wanted
+    impls = [("flash", flash_attention, None), ("full", full_attention, None)]
+    if grad:
+        # A/B the Pallas backward against the blocked-XLA backward (the
+        # KFT_FLASH_BWD switch is read at trace time, so it must be set
+        # while the impl compiles)
+        impls.append(("flash_xla_bwd", flash_attention, "xla"))
     out: Dict[str, float] = {}
-    for name, fn in (("flash", flash_attention), ("full", full_attention)):
-        f = make(fn)
-        for _ in range(warmup):
-            r = f(q, k, v)
-        sync(r)
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            r = f(q, k, v)
-        sync(r)
+    for name, fn, bwd in impls:
+        prev = os.environ.get("KFT_FLASH_BWD")
+        if bwd is not None:
+            os.environ["KFT_FLASH_BWD"] = bwd
+        try:
+            f = make(fn)
+            for _ in range(warmup):
+                r = f(q, k, v)
+            sync(r)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                r = f(q, k, v)
+            sync(r)
+        finally:
+            if bwd is not None:
+                if prev is None:
+                    os.environ.pop("KFT_FLASH_BWD", None)
+                else:
+                    os.environ["KFT_FLASH_BWD"] = prev
         dt = (time.perf_counter() - t0) / steps
         out[name] = dt
         print(

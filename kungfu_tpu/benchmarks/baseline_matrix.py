@@ -519,14 +519,15 @@ def config_attention() -> dict:
                 batch=4, seq_len=L, heads=16, head_dim=64, steps=10, warmup=2,
                 grad=True,
             )
-            rows.append(
-                {
-                    "seq_len": L,
-                    "flash_ms": round(out["flash"] * 1e3, 3),
-                    "full_ms": round(out["full"] * 1e3, 3),
-                    "flash_speedup": round(out["full"] / out["flash"], 3),
-                }
-            )
+            row = {
+                "seq_len": L,
+                "flash_ms": round(out["flash"] * 1e3, 3),
+                "full_ms": round(out["full"] * 1e3, 3),
+                "flash_speedup": round(out["full"] / out["flash"], 3),
+            }
+            if "flash_xla_bwd" in out:  # Pallas-vs-XLA backward A/B
+                row["flash_xla_bwd_ms"] = round(out["flash_xla_bwd"] * 1e3, 3)
+            rows.append(row)
         best = max(rows, key=lambda r: r["flash_speedup"])
         return {
             "config": "attention-flash-vs-full",
@@ -577,6 +578,13 @@ def _persist_results(out_path: str, existing: dict) -> None:
         with os.fdopen(fd, "w") as f:
             json.dump({"generated_by": "kungfu_tpu.benchmarks.baseline_matrix",
                        "results": list(existing.values())}, f, indent=1)
+        # mkstemp creates 0600; keep the destination's mode (0644 default)
+        # so the results file stays readable by CI/other users
+        try:
+            mode = os.stat(out_path).st_mode & 0o777
+        except OSError:
+            mode = 0o644
+        os.chmod(tmp, mode)
         os.replace(tmp, out_path)
     except BaseException:
         try:

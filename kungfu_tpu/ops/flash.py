@@ -3,8 +3,11 @@
 The reference has no attention kernels at all (it is model-agnostic DP;
 SURVEY.md §5); this is TPU-native capability: a fused online-softmax
 attention forward in Pallas (VMEM-resident blocks feeding the MXU, no
-[L, L] score matrix in HBM) with a blocked, rematerializing backward in
-XLA.  Layering with the parallelism stack: `parallel.ring_attention`
+[L, L] score matrix in HBM) and a Pallas backward (a dq kernel gridded
+over q blocks + a dk/dv kernel gridded over k/v blocks, fp32 accumulation,
+rematerialized probabilities).  A blocked XLA backward remains as the
+off-TPU path and as the KFT_FLASH_BWD=xla A/B switch for benchmarking.
+Layering with the parallelism stack: `parallel.ring_attention`
 rotates K/V shards across chips (ICI), and inside each chip this kernel
 computes the per-block attention; single-chip models call it directly.
 
@@ -19,6 +22,7 @@ the same code path is exercised by the CPU test suite.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional, Tuple
 
 import jax
@@ -163,6 +167,166 @@ def _flash_fwd(q, k, v, scale: float, causal: bool, block_q: int, block_k: int,
     return o[:, :seq_len], lse[:, :seq_len, 0]
 
 
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+                   scale: float, causal: bool, block_k: int, seq_len: int):
+    """dq for one q block: iterate k/v blocks, accumulate ds @ k.
+
+    q_ref/do_ref/dq_ref: [1, block_q, D]; k_ref/v_ref: [1, L_pad, D];
+    lse_ref/delta_ref: [1, block_q].
+    """
+    qi = pl.program_id(1)
+    block_q = q_ref.shape[1]
+    d = q_ref.shape[2]
+    nk = k_ref.shape[1] // block_k
+
+    q = q_ref[0].astype(jnp.float32) * scale          # [block_q, D]
+    do = do_ref[0].astype(jnp.float32)                # [block_q, D]
+    lse = lse_ref[0].astype(jnp.float32)[:, None]     # [block_q, 1]
+    delta = delta_ref[0].astype(jnp.float32)[:, None]
+    q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+
+    def body(j, dq):
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        k_pos = j * block_k + lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+        valid = k_pos < seq_len
+        if causal:
+            valid = jnp.logical_and(valid, q_pos >= k_pos)
+        p = jnp.where(valid, jnp.exp(s - lse), 0.0)    # [block_q, block_k]
+        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return dq + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
+
+    dq0 = jnp.zeros((block_q, d), jnp.float32)
+    if causal:
+        nk_needed = lax.min(nk, pl.cdiv((qi + 1) * block_q, block_k))
+        dq = lax.fori_loop(0, nk_needed, body, dq0)
+    else:
+        dq = lax.fori_loop(0, nk, body, dq0)
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, scale: float, causal: bool,
+                    block_q: int, seq_len: int):
+    """dk, dv for one k/v block: iterate q blocks, accumulate ds.T @ q and
+    p.T @ do.
+
+    k_ref/v_ref/dk_ref/dv_ref: [1, block_k, D]; q_ref/do_ref: [1, L_pad, D];
+    lse_ref/delta_ref: [1, L_pad].  Padded q rows carry a REAL lse (they
+    attend real keys in the forward), so they must be masked out here by
+    q position, not by lse value.
+    """
+    ki = pl.program_id(1)
+    block_k = k_ref.shape[1]
+    d = k_ref.shape[2]
+    nq = q_ref.shape[1] // block_q
+
+    k_blk = k_ref[0].astype(jnp.float32)              # [block_k, D]
+    v_blk = v_ref[0].astype(jnp.float32)
+    k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+
+    def body(i, carry):
+        dk, dv = carry
+        q_blk = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32) * scale
+        do_blk = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse_blk = lse_ref[0, pl.ds(i * block_q, block_q)].astype(jnp.float32)[:, None]
+        delta_blk = delta_ref[0, pl.ds(i * block_q, block_q)].astype(jnp.float32)[:, None]
+        s = jnp.dot(q_blk, k_blk.T, preferred_element_type=jnp.float32)
+        q_pos = i * block_q + lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+        valid = jnp.logical_and(q_pos < seq_len, k_pos < seq_len)
+        if causal:
+            valid = jnp.logical_and(valid, q_pos >= k_pos)
+        p = jnp.where(valid, jnp.exp(s - lse_blk), 0.0)  # [block_q, block_k]
+        dv = dv + jnp.dot(p.T, do_blk, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do_blk, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_blk)
+        dk = dk + jnp.dot(ds.T, q_blk, preferred_element_type=jnp.float32)
+        return dk, dv
+
+    zeros = jnp.zeros((block_k, d), jnp.float32)
+    if causal:
+        # q blocks strictly before this k block see none of it
+        start = (ki * block_k) // block_q
+        dk, dv = lax.fori_loop(start, nq, body, (zeros, zeros))
+    else:
+        dk, dv = lax.fori_loop(0, nq, body, (zeros, zeros))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd_pallas(q, k, v, o, lse, g, scale: float, causal: bool,
+                block_q: int, block_k: int, interpret: bool, g_lse=None):
+    """Pallas flash backward: a dq kernel gridded over q blocks and a dk/dv
+    kernel gridded over k/v blocks, both streaming the opposite operand from
+    VMEM — no [L, L] matrix, fp32 accumulation, MXU matmuls throughout."""
+    bh, seq_len, d = q.shape
+    qp = _pad_to(q, block_q, 1)
+    kp = _pad_to(k, block_k, 1)
+    vp = _pad_to(v, block_k, 1)
+    dop = _pad_to(g.astype(q.dtype), block_q, 1)
+    lq, lk = qp.shape[1], kp.shape[1]
+    nq, nk = lq // block_q, lk // block_k
+
+    delta = jnp.sum(o.astype(jnp.float32) * g.astype(jnp.float32), axis=-1)
+    if g_lse is not None:
+        delta = delta - g_lse.astype(jnp.float32)
+    lse_p = _pad_to(lse.astype(jnp.float32), block_q, 1)    # [bh, lq]
+    delta_p = _pad_to(delta, block_q, 1)
+
+    vma = frozenset().union(
+        *(getattr(jax.typeof(x), "vma", frozenset())
+          for x in (qp, kp, vp, dop, lse_p, delta_p))
+    )
+    dq_kern = functools.partial(
+        _bwd_dq_kernel, scale=scale, causal=causal, block_k=block_k,
+        seq_len=seq_len,
+    )
+    dq = pl.pallas_call(
+        dq_kern,
+        grid=(bh, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, lk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, lk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, lq, d), q.dtype, vma=vma),
+        interpret=interpret,
+    )(qp, kp, vp, dop, lse_p, delta_p)
+
+    dkv_kern = functools.partial(
+        _bwd_dkv_kernel, scale=scale, causal=causal, block_q=block_q,
+        seq_len=seq_len,
+    )
+    dk, dv = pl.pallas_call(
+        dkv_kern,
+        grid=(bh, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, lq, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, lq, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, lq), lambda b, j: (b, 0)),
+            pl.BlockSpec((1, lq), lambda b, j: (b, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, lk, d), k.dtype, vma=vma),
+            jax.ShapeDtypeStruct((bh, lk, d), v.dtype, vma=vma),
+        ],
+        interpret=interpret,
+    )(kp, vp, qp, dop, lse_p, delta_p)
+    return dq[:, :seq_len], dk[:, :seq_len], dv[:, :seq_len]
+
+
 def _bwd_blocked(q, k, v, o, lse, g, scale: float, causal: bool,
                  block_k: int, g_lse=None):
     """Rematerializing backward in XLA: scan over k/v blocks, never holding
@@ -228,9 +392,31 @@ def _flash_bhld_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
     return o, (q, k, v, o, lse)
 
 
+def _dispatch_bwd(q, k, v, o, lse, g, scale, causal, block_q, block_k,
+                  interpret, g_lse=None):
+    """Pallas backward wherever the forward ran the kernel (TPU, or explicit
+    interpret=True in tests); the XLA blocked backward off-TPU and under
+    KFT_FLASH_BWD=xla (the A/B switch the attention bench flips)."""
+    # explicit interpret (True OR False) means the caller forced the kernel
+    # in the forward — mirror it in the backward; None auto-selects by
+    # backend like the forward does
+    use_kernel = True if interpret is not None else not _use_interpret()
+    if os.environ.get("KFT_FLASH_BWD") == "xla":
+        use_kernel = False
+    if use_kernel:
+        return _bwd_pallas(
+            q, k, v, o, lse, g, scale, causal, block_q, block_k,
+            interpret=_use_interpret() if interpret is None else interpret,
+            g_lse=g_lse,
+        )
+    return _bwd_blocked(q, k, v, o, lse, g, scale, causal, block_k,
+                        g_lse=g_lse)
+
+
 def _flash_bhld_bwd(scale, causal, block_q, block_k, interpret, res, g):
     q, k, v, o, lse = res
-    return _bwd_blocked(q, k, v, o, lse, g, scale, causal, block_k)
+    return _dispatch_bwd(q, k, v, o, lse, g, scale, causal, block_q, block_k,
+                         interpret)
 
 
 _flash_bhld.defvjp(_flash_bhld_fwd, _flash_bhld_bwd)
@@ -251,8 +437,8 @@ def _flash_bhld_lse_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
 def _flash_bhld_lse_bwd(scale, causal, block_q, block_k, interpret, res, g):
     q, k, v, o, lse = res
     g_o, g_lse = g
-    return _bwd_blocked(q, k, v, o, lse, g_o, scale, causal, block_k,
-                        g_lse=g_lse)
+    return _dispatch_bwd(q, k, v, o, lse, g_o, scale, causal, block_q,
+                         block_k, interpret, g_lse=g_lse)
 
 
 _flash_bhld_lse.defvjp(_flash_bhld_lse_fwd, _flash_bhld_lse_bwd)
