@@ -196,6 +196,11 @@ def bench_attention(
         prev = os.environ.get("KFT_FLASH_BWD")
         if bwd is not None:
             os.environ["KFT_FLASH_BWD"] = bwd
+        else:
+            # pin the default arms too: a stray KFT_FLASH_BWD=xla in the
+            # environment would silently turn the "flash" row into the XLA
+            # backward and void the A/B
+            os.environ.pop("KFT_FLASH_BWD", None)
         try:
             f = make(fn)
             for _ in range(warmup):
@@ -206,11 +211,10 @@ def bench_attention(
                 r = f(q, k, v)
             sync(r)
         finally:
-            if bwd is not None:
-                if prev is None:
-                    os.environ.pop("KFT_FLASH_BWD", None)
-                else:
-                    os.environ["KFT_FLASH_BWD"] = prev
+            if prev is None:
+                os.environ.pop("KFT_FLASH_BWD", None)
+            else:
+                os.environ["KFT_FLASH_BWD"] = prev
         dt = (time.perf_counter() - t0) / steps
         out[name] = dt
         print(

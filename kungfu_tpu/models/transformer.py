@@ -1,5 +1,5 @@
 """Flagship decoder-only transformer LM — TP/SP/DP-shardable, ring-attention
-capable, optional MoE layers.
+capable, optional MoE layers, GQA/MQA (n_kv_heads), RoPE, SwiGLU.
 
 The reference is model-agnostic DP (it ships no transformer); this is the
 TPU-first flagship exercising every parallelism axis the framework offers:
@@ -49,6 +49,19 @@ class TransformerConfig:
     # ring_attention.py and parallel/ulysses.py document the trade-off)
     attention: str = "auto"  # "auto" | "flash" | "full" | "ring" | "ulysses"
     causal: bool = True
+    # grouped-query attention: number of K/V heads (0 = n_heads, i.e. MHA;
+    # 1 = MQA).  K/V are projected to n_kv_heads and broadcast to the query
+    # heads before the kernel, so every attention impl (full/flash/ring/
+    # ulysses) works unchanged.  Under TP, n_kv_heads must divide the tp
+    # axis like n_heads does.
+    n_kv_heads: int = 0
+    # rotary position embeddings instead of the learned pos_embed table.
+    # Applied to q/k on the GLOBAL sequence positions before any
+    # sequence-parallel region, so ring/ulysses shards see correct offsets.
+    rope: bool = False
+    rope_theta: float = 10000.0
+    # feed-forward flavor: "gelu" (2-matmul) or "swiglu" (gated, 3-matmul)
+    ffn: str = "gelu"
     # MoE: every `moe_every`-th block uses experts (0 = dense model)
     n_experts: int = 0
     moe_every: int = 2
@@ -59,6 +72,38 @@ class TransformerConfig:
 
     def __post_init__(self):
         assert self.d_model % self.n_heads == 0
+        if self.n_kv_heads:
+            assert self.n_heads % self.n_kv_heads == 0, (
+                "query heads must be a multiple of kv heads"
+            )
+        if self.rope:
+            assert (self.d_model // self.n_heads) % 2 == 0, (
+                "rope rotates feature pairs: head_dim must be even"
+            )
+        assert self.ffn in ("gelu", "swiglu"), self.ffn
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
+
+
+def apply_rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary position embedding on [B, L, H, D] with positions [L].
+
+    Rotates pairs (x[..., :D/2], x[..., D/2:]) in fp32, casts back.  Called
+    with GLOBAL positions before any sequence-parallel sharding region, so
+    each sp shard's rows carry their true absolute position.
+    """
+    d = x.shape[-1]
+    half = d // 2
+    freqs = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) / half))
+    ang = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # [L, half]
+    cos = jnp.cos(ang)[None, :, None, :]
+    sin = jnp.sin(ang)[None, :, None, :]
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., :half], xf[..., half:]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
 
 
 def _dense(features, name, kernel_axes, dtype):
@@ -80,11 +125,21 @@ class Attention(nn.Module):
     def __call__(self, x):
         cfg = self.cfg
         H, D = cfg.n_heads, cfg.d_model // cfg.n_heads
+        Hkv = cfg.kv_heads
         B, L, _ = x.shape
         qkv_axes = ("embed", "heads")
         q = _dense(cfg.d_model, "q", qkv_axes, cfg.dtype)(x).reshape(B, L, H, D)
-        k = _dense(cfg.d_model, "k", qkv_axes, cfg.dtype)(x).reshape(B, L, H, D)
-        v = _dense(cfg.d_model, "v", qkv_axes, cfg.dtype)(x).reshape(B, L, H, D)
+        k = _dense(Hkv * D, "k", qkv_axes, cfg.dtype)(x).reshape(B, L, Hkv, D)
+        v = _dense(Hkv * D, "v", qkv_axes, cfg.dtype)(x).reshape(B, L, Hkv, D)
+        if cfg.rope:
+            # global positions: L here is the full (logical) sequence even
+            # when seq is sharded — the constraint below keeps the sharding
+            pos = jnp.arange(L)
+            q = apply_rope(q, pos, cfg.rope_theta)
+            k = apply_rope(k, pos, cfg.rope_theta)
+        if Hkv != H:  # GQA/MQA: broadcast kv heads up to the query heads
+            k = jnp.repeat(k, H // Hkv, axis=2)
+            v = jnp.repeat(v, H // Hkv, axis=2)
         q = flax_spmd.with_logical_constraint(q, ("batch", "seq", "heads", "kv"))
         k = flax_spmd.with_logical_constraint(k, ("batch", "seq", "heads", "kv"))
         v = flax_spmd.with_logical_constraint(v, ("batch", "seq", "heads", "kv"))
@@ -159,7 +214,11 @@ class MLP(nn.Module):
     def __call__(self, x):
         cfg = self.cfg
         h = _dense(cfg.d_ff, "in", ("embed", "mlp"), cfg.dtype)(x)
-        h = nn.gelu(h)
+        if cfg.ffn == "swiglu":
+            gate = _dense(cfg.d_ff, "gate", ("embed", "mlp"), cfg.dtype)(x)
+            h = nn.silu(gate) * h
+        else:
+            h = nn.gelu(h)
         h = flax_spmd.with_logical_constraint(h, ("batch", "seq", "mlp"))
         return _dense(cfg.d_model, "out", ("mlp", "embed"), cfg.dtype)(h)
 
@@ -196,13 +255,15 @@ class TransformerLM(nn.Module):
                 nn.initializers.normal(stddev=0.02), ("vocab", "embed")
             ),
         )
-        pos = self.param(
-            "pos_embed",
-            nn.with_logical_partitioning(nn.initializers.normal(stddev=0.02), ("seq", "embed")),
-            (cfg.max_len, cfg.d_model),
-            jnp.float32,
-        )
-        x = emb(tokens) + pos[None, :L].astype(cfg.dtype)
+        x = emb(tokens)
+        if not cfg.rope:  # rope applies per-layer in Attention instead
+            pos = self.param(
+                "pos_embed",
+                nn.with_logical_partitioning(nn.initializers.normal(stddev=0.02), ("seq", "embed")),
+                (cfg.max_len, cfg.d_model),
+                jnp.float32,
+            )
+            x = x + pos[None, :L].astype(cfg.dtype)
         x = flax_spmd.with_logical_constraint(x, ("batch", "seq", "embed"))
         for i in range(cfg.n_layers):
             use_moe = cfg.n_experts > 0 and (i % cfg.moe_every == cfg.moe_every - 1)
