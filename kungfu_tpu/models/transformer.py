@@ -50,10 +50,10 @@ class TransformerConfig:
     attention: str = "auto"  # "auto" | "flash" | "full" | "ring" | "ulysses"
     causal: bool = True
     # grouped-query attention: number of K/V heads (0 = n_heads, i.e. MHA;
-    # 1 = MQA).  K/V are projected to n_kv_heads and broadcast to the query
-    # heads before the kernel, so every attention impl (full/flash/ring/
-    # ulysses) works unchanged.  Under TP, n_kv_heads must divide the tp
-    # axis like n_heads does.
+    # 1 = MQA).  The flash kernels consume GQA K/V natively (index-mapped,
+    # no repeats in HBM) when tp divides n_kv_heads; ring/ulysses/full — and
+    # flash with tp not dividing n_kv_heads — broadcast K/V heads up to the
+    # query heads first.
     n_kv_heads: int = 0
     # rotary position embeddings instead of the learned pos_embed table.
     # Applied to q/k on the GLOBAL sequence positions before any
@@ -137,16 +137,20 @@ class Attention(nn.Module):
             pos = jnp.arange(L)
             q = apply_rope(q, pos, cfg.rope_theta)
             k = apply_rope(k, pos, cfg.rope_theta)
-        if Hkv != H:  # GQA/MQA: broadcast kv heads up to the query heads
-            k = jnp.repeat(k, H // Hkv, axis=2)
-            v = jnp.repeat(v, H // Hkv, axis=2)
-        q = flax_spmd.with_logical_constraint(q, ("batch", "seq", "heads", "kv"))
-        k = flax_spmd.with_logical_constraint(k, ("batch", "seq", "heads", "kv"))
-        v = flax_spmd.with_logical_constraint(v, ("batch", "seq", "heads", "kv"))
-
         kind = cfg.attention
         if kind == "auto":
             kind = "flash" if jax.default_backend() == "tpu" else "full"
+        if Hkv != H:
+            # the flash kernels take GQA kv natively (index-mapped, no
+            # repeat in HBM) as long as any tp sharding still divides the
+            # kv-head axis; other impls get broadcast kv heads
+            tp = cfg.mesh.shape.get("tp", 1) if cfg.mesh is not None else 1
+            if not (kind == "flash" and Hkv % tp == 0):
+                k = jnp.repeat(k, H // Hkv, axis=2)
+                v = jnp.repeat(v, H // Hkv, axis=2)
+        q = flax_spmd.with_logical_constraint(q, ("batch", "seq", "heads", "kv"))
+        k = flax_spmd.with_logical_constraint(k, ("batch", "seq", "heads", "kv"))
+        v = flax_spmd.with_logical_constraint(v, ("batch", "seq", "heads", "kv"))
 
         if (
             kind in ("ring", "ulysses")

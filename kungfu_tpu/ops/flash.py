@@ -125,11 +125,36 @@ def _fwd_reference(q, k, v, scale: float, causal: bool):
     return o.astype(q.dtype), lse
 
 
+def _kv_row(b, h: int, hkv: int):
+    """Row of the [B*Hkv, ...] k/v array serving q row `b` of [B*H, ...].
+
+    GQA: consecutive groups of `h // hkv` query heads share one kv head.
+    Identity when h == hkv.  Used inside BlockSpec index maps (traced)."""
+    if h == hkv:
+        return b
+    group = h // hkv
+    return (b // h) * hkv + (b % h) // group
+
+
+def _expand_kv(x, h: int, hkv: int):
+    """[B*Hkv, L, D] -> [B*H, L, D] by repeating each kv head over its
+    query-head group (the XLA-path equivalent of _kv_row indexing)."""
+    if h == hkv:
+        return x
+    bhkv, l, d = x.shape
+    b = bhkv // hkv
+    return jnp.repeat(
+        x.reshape(b, hkv, l, d), h // hkv, axis=1
+    ).reshape(b * h, l, d)
+
+
 def _flash_fwd(q, k, v, scale: float, causal: bool, block_q: int, block_k: int,
-               interpret: Optional[bool]):
-    """q,k,v: [BH, L, D] -> (o [BH, L, D], lse [BH, L])."""
+               interpret: Optional[bool], h: int = 1, hkv: int = 1):
+    """q: [B*H, L, D]; k,v: [B*Hkv, L, D] -> (o [B*H, L, D], lse [B*H, L])."""
     if interpret is None and _use_interpret():
-        return _fwd_reference(q, k, v, scale, causal)
+        return _fwd_reference(
+            q, _expand_kv(k, h, hkv), _expand_kv(v, h, hkv), scale, causal
+        )
     bh, seq_len, d = q.shape
     qp = _pad_to(q, block_q, 1)
     kp = _pad_to(k, block_k, 1)
@@ -146,13 +171,14 @@ def _flash_fwd(q, k, v, scale: float, causal: bool, block_q: int, block_k: int,
     vma = frozenset().union(
         *(getattr(jax.typeof(x), "vma", frozenset()) for x in (qp, kp, vp))
     )
+    kv_spec = pl.BlockSpec((1, lk, d), lambda b, i: (_kv_row(b, h, hkv), 0, 0))
     o, lse = pl.pallas_call(
         kern,
         grid=(bh, nq),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, lk, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, lk, d), lambda b, i: (b, 0, 0)),
+            kv_spec,
+            kv_spec,
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
@@ -207,18 +233,15 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, scale: float, causal: bool,
-                    block_q: int, seq_len: int):
-    """dk, dv for one k/v block: iterate q blocks, accumulate ds.T @ q and
-    p.T @ do.
+def _dkv_accum(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, ki: int, *,
+               scale: float, causal: bool, block_q: int, seq_len: int):
+    """Shared dk/dv accumulation over all q blocks for one k/v block.
 
-    k_ref/v_ref/dk_ref/dv_ref: [1, block_k, D]; q_ref/do_ref: [1, L_pad, D];
+    k_ref/v_ref: [1, block_k, D]; q_ref/do_ref: [1, L_pad, D];
     lse_ref/delta_ref: [1, L_pad].  Padded q rows carry a REAL lse (they
     attend real keys in the forward), so they must be masked out here by
-    q position, not by lse value.
+    q position, not by lse value.  Returns (dk, dv) fp32 [block_k, D].
     """
-    ki = pl.program_id(1)
     block_k = k_ref.shape[1]
     d = k_ref.shape[2]
     nq = q_ref.shape[1] // block_q
@@ -249,18 +272,56 @@ def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
     if causal:
         # q blocks strictly before this k block see none of it
         start = (ki * block_k) // block_q
-        dk, dv = lax.fori_loop(start, nq, body, (zeros, zeros))
-    else:
-        dk, dv = lax.fori_loop(0, nq, body, (zeros, zeros))
+        return lax.fori_loop(start, nq, body, (zeros, zeros))
+    return lax.fori_loop(0, nq, body, (zeros, zeros))
+
+
+def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, scale: float, causal: bool,
+                    block_q: int, seq_len: int):
+    """dk, dv for one k/v block (MHA: one q row per kv row)."""
+    dk, dv = _dkv_accum(
+        k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, pl.program_id(1),
+        scale=scale, causal=causal, block_q=block_q, seq_len=seq_len,
+    )
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
+def _bwd_dkv_gqa_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
+                        dk_ref, dv_ref, *, scale: float, causal: bool,
+                        block_q: int, seq_len: int):
+    """GQA dk/dv: grid (B*Hkv, nk, group), group FASTEST so the consecutive
+    revisits of the same (kv row, k block) output accumulate the query-head
+    group in VMEM.  The index maps select q row = base + g for grid step g;
+    outputs are fp32 (cast outside) so cross-g accumulation is exact."""
+    g = pl.program_id(2)
+    dk, dv = _dkv_accum(
+        k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, pl.program_id(1),
+        scale=scale, causal=causal, block_q=block_q, seq_len=seq_len,
+    )
+
+    @pl.when(g == 0)
+    def _init():
+        dk_ref[0] = dk
+        dv_ref[0] = dv
+
+    @pl.when(g > 0)
+    def _accum():
+        dk_ref[0] = dk_ref[0] + dk
+        dv_ref[0] = dv_ref[0] + dv
+
+
 def _bwd_pallas(q, k, v, o, lse, g, scale: float, causal: bool,
-                block_q: int, block_k: int, interpret: bool, g_lse=None):
+                block_q: int, block_k: int, interpret: bool, g_lse=None,
+                h: int = 1, hkv: int = 1):
     """Pallas flash backward: a dq kernel gridded over q blocks and a dk/dv
     kernel gridded over k/v blocks, both streaming the opposite operand from
-    VMEM — no [L, L] matrix, fp32 accumulation, MXU matmuls throughout."""
+    VMEM — no [L, L] matrix, fp32 accumulation, MXU matmuls throughout.
+
+    GQA (hkv < h): k/v stay [B*Hkv, L, D]; the dq kernel index-maps its kv
+    operand, and dk/dv accumulate the query-head group over a third
+    (fastest) grid axis revisiting the same fp32 output block."""
     bh, seq_len, d = q.shape
     qp = _pad_to(q, block_q, 1)
     kp = _pad_to(k, block_k, 1)
@@ -268,6 +329,8 @@ def _bwd_pallas(q, k, v, o, lse, g, scale: float, causal: bool,
     dop = _pad_to(g.astype(q.dtype), block_q, 1)
     lq, lk = qp.shape[1], kp.shape[1]
     nq, nk = lq // block_q, lk // block_k
+    bhkv = kp.shape[0]
+    group = h // hkv if hkv else 1
 
     delta = jnp.sum(o.astype(jnp.float32) * g.astype(jnp.float32), axis=-1)
     if g_lse is not None:
@@ -283,13 +346,14 @@ def _bwd_pallas(q, k, v, o, lse, g, scale: float, causal: bool,
         _bwd_dq_kernel, scale=scale, causal=causal, block_k=block_k,
         seq_len=seq_len,
     )
+    kv_spec = pl.BlockSpec((1, lk, d), lambda b, i: (_kv_row(b, h, hkv), 0, 0))
     dq = pl.pallas_call(
         dq_kern,
         grid=(bh, nq),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, lk, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, lk, d), lambda b, i: (b, 0, 0)),
+            kv_spec,
+            kv_spec,
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
             pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
@@ -299,31 +363,63 @@ def _bwd_pallas(q, k, v, o, lse, g, scale: float, causal: bool,
         interpret=interpret,
     )(qp, kp, vp, dop, lse_p, delta_p)
 
-    dkv_kern = functools.partial(
-        _bwd_dkv_kernel, scale=scale, causal=causal, block_q=block_q,
-        seq_len=seq_len,
-    )
-    dk, dv = pl.pallas_call(
-        dkv_kern,
-        grid=(bh, nk),
-        in_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, lq, d), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, lq, d), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, lq), lambda b, j: (b, 0)),
-            pl.BlockSpec((1, lq), lambda b, j: (b, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((bh, lk, d), k.dtype, vma=vma),
-            jax.ShapeDtypeStruct((bh, lk, d), v.dtype, vma=vma),
-        ],
-        interpret=interpret,
-    )(kp, vp, qp, dop, lse_p, delta_p)
+    if group == 1:
+        dkv_kern = functools.partial(
+            _bwd_dkv_kernel, scale=scale, causal=causal, block_q=block_q,
+            seq_len=seq_len,
+        )
+        dk, dv = pl.pallas_call(
+            dkv_kern,
+            grid=(bh, nk),
+            in_specs=[
+                pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+                pl.BlockSpec((1, lq, d), lambda b, j: (b, 0, 0)),
+                pl.BlockSpec((1, lq, d), lambda b, j: (b, 0, 0)),
+                pl.BlockSpec((1, lq), lambda b, j: (b, 0)),
+                pl.BlockSpec((1, lq), lambda b, j: (b, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((bh, lk, d), k.dtype, vma=vma),
+                jax.ShapeDtypeStruct((bh, lk, d), v.dtype, vma=vma),
+            ],
+            interpret=interpret,
+        )(kp, vp, qp, dop, lse_p, delta_p)
+    else:
+        def qrow(b, g_):
+            return (b // hkv) * h + (b % hkv) * group + g_
+
+        dkv_kern = functools.partial(
+            _bwd_dkv_gqa_kernel, scale=scale, causal=causal, block_q=block_q,
+            seq_len=seq_len,
+        )
+        dk, dv = pl.pallas_call(
+            dkv_kern,
+            grid=(bhkv, nk, group),
+            in_specs=[
+                pl.BlockSpec((1, block_k, d), lambda b, j, g_: (b, j, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, j, g_: (b, j, 0)),
+                pl.BlockSpec((1, lq, d), lambda b, j, g_: (qrow(b, g_), 0, 0)),
+                pl.BlockSpec((1, lq, d), lambda b, j, g_: (qrow(b, g_), 0, 0)),
+                pl.BlockSpec((1, lq), lambda b, j, g_: (qrow(b, g_), 0)),
+                pl.BlockSpec((1, lq), lambda b, j, g_: (qrow(b, g_), 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_k, d), lambda b, j, g_: (b, j, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, j, g_: (b, j, 0)),
+            ],
+            out_shape=[  # fp32: cross-group accumulation must be exact
+                jax.ShapeDtypeStruct((bhkv, lk, d), jnp.float32, vma=vma),
+                jax.ShapeDtypeStruct((bhkv, lk, d), jnp.float32, vma=vma),
+            ],
+            interpret=interpret,
+        )(kp, vp, qp, dop, lse_p, delta_p)
+        dk = dk.astype(k.dtype)
+        dv = dv.astype(v.dtype)
     return dq[:, :seq_len], dk[:, :seq_len], dv[:, :seq_len]
 
 
@@ -380,20 +476,23 @@ def _bwd_blocked(q, k, v, o, lse, g, scale: float, causal: bool,
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9)
 )
-def _flash_bhld(q, k, v, scale, causal, block_q, block_k, interpret):
-    o, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+def _flash_bhld(q, k, v, scale, causal, block_q, block_k, interpret, h, hkv):
+    o, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
+                      h, hkv)
     return o
 
 
-def _flash_bhld_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
-    o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+def _flash_bhld_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
+                    h, hkv):
+    o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
+                        h, hkv)
     return o, (q, k, v, o, lse)
 
 
 def _dispatch_bwd(q, k, v, o, lse, g, scale, causal, block_q, block_k,
-                  interpret, g_lse=None):
+                  interpret, g_lse=None, h=1, hkv=1):
     """Pallas backward wherever the forward ran the kernel (TPU, or explicit
     interpret=True in tests); the XLA blocked backward off-TPU and under
     KFT_FLASH_BWD=xla (the A/B switch the attention bench flips)."""
@@ -407,38 +506,58 @@ def _dispatch_bwd(q, k, v, o, lse, g, scale, causal, block_q, block_k,
         return _bwd_pallas(
             q, k, v, o, lse, g, scale, causal, block_q, block_k,
             interpret=_use_interpret() if interpret is None else interpret,
-            g_lse=g_lse,
+            g_lse=g_lse, h=h, hkv=hkv,
         )
+    if h != hkv:
+        # XLA path: expand kv over the group, then reduce dk/dv back
+        dq, dk, dv = _bwd_blocked(
+            q, _expand_kv(k, h, hkv), _expand_kv(v, h, hkv), o, lse, g,
+            scale, causal, block_k, g_lse=g_lse,
+        )
+        group = h // hkv
+        bh, l, d = dk.shape
+        b = bh // h
+        # fp32 group reduction — matches the Pallas path's exact accumulation
+        reduce = lambda x: x.astype(jnp.float32).reshape(
+            b, hkv, group, l, d
+        ).sum(2).reshape(b * hkv, l, d)
+        return dq, reduce(dk).astype(k.dtype), reduce(dv).astype(v.dtype)
     return _bwd_blocked(q, k, v, o, lse, g, scale, causal, block_k,
                         g_lse=g_lse)
 
 
-def _flash_bhld_bwd(scale, causal, block_q, block_k, interpret, res, g):
+def _flash_bhld_bwd(scale, causal, block_q, block_k, interpret, h, hkv,
+                    res, g):
     q, k, v, o, lse = res
     return _dispatch_bwd(q, k, v, o, lse, g, scale, causal, block_q, block_k,
-                         interpret)
+                         interpret, h=h, hkv=hkv)
 
 
 _flash_bhld.defvjp(_flash_bhld_fwd, _flash_bhld_bwd)
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9)
 )
-def _flash_bhld_lse(q, k, v, scale, causal, block_q, block_k, interpret):
-    return _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+def _flash_bhld_lse(q, k, v, scale, causal, block_q, block_k, interpret,
+                    h, hkv):
+    return _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
+                      h, hkv)
 
 
-def _flash_bhld_lse_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
-    o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+def _flash_bhld_lse_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
+                        h, hkv):
+    o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
+                        h, hkv)
     return (o, lse), (q, k, v, o, lse)
 
 
-def _flash_bhld_lse_bwd(scale, causal, block_q, block_k, interpret, res, g):
+def _flash_bhld_lse_bwd(scale, causal, block_q, block_k, interpret, h, hkv,
+                        res, g):
     q, k, v, o, lse = res
     g_o, g_lse = g
     return _dispatch_bwd(q, k, v, o, lse, g_o, scale, causal, block_q,
-                         block_k, interpret, g_lse=g_lse)
+                         block_k, interpret, g_lse=g_lse, h=h, hkv=hkv)
 
 
 _flash_bhld_lse.defvjp(_flash_bhld_lse_fwd, _flash_bhld_lse_bwd)
@@ -458,17 +577,23 @@ def flash_attention(
 
     Exact (not approximate): numerically the online-softmax refactoring of
     softmax(qk^T)v.  `interpret=None` auto-selects interpreter mode off-TPU.
+    GQA/MQA: k/v may carry Hkv < H heads (H % Hkv == 0) — the kernels
+    index-map the shared kv heads instead of materializing repeats.
     """
     b, l, h, d = q.shape
+    hkv = k.shape[2]
+    assert h % hkv == 0 and v.shape[2] == hkv, (q.shape, k.shape, v.shape)
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
     bq = min(block_q, max(8, l))
     bk = min(block_k, max(8, l))
 
     def to_bhld(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, l, d)
+        hh = x.shape[2]
+        return x.transpose(0, 2, 1, 3).reshape(b * hh, l, d)
 
     o = _flash_bhld(
-        to_bhld(q), to_bhld(k), to_bhld(v), scale, causal, bq, bk, interpret
+        to_bhld(q), to_bhld(k), to_bhld(v), scale, causal, bq, bk, interpret,
+        h, hkv,
     )
     return o.reshape(b, h, l, d).transpose(0, 2, 1, 3)
 
@@ -492,15 +617,19 @@ def flash_attention_with_lse(
     differentiable: the VJP folds the lse cotangent into the flash backward.
     """
     b, l, h, d = q.shape
+    hkv = k.shape[2]
+    assert h % hkv == 0 and v.shape[2] == hkv, (q.shape, k.shape, v.shape)
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
     bq = min(block_q, max(8, l))
     bk = min(block_k, max(8, l))
 
     def to_bhld(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, l, d)
+        hh = x.shape[2]
+        return x.transpose(0, 2, 1, 3).reshape(b * hh, l, d)
 
     o, lse = _flash_bhld_lse(
-        to_bhld(q), to_bhld(k), to_bhld(v), scale, causal, bq, bk, interpret
+        to_bhld(q), to_bhld(k), to_bhld(v), scale, causal, bq, bk, interpret,
+        h, hkv,
     )
     o = o.reshape(b, h, l, d).transpose(0, 2, 1, 3)
     return o, lse.reshape(b, h, l)
