@@ -1,0 +1,144 @@
+"""End-to-end LLM pretraining: a modern GPT-style decoder (GQA, rotary
+embeddings, SwiGLU) trained with MeshTrainer over a dp x sp mesh, batches
+from the chunked file dataset or synthetic tokens, async orbax
+checkpointing with kill-and-resume.
+
+This is the "switch from the reference" showcase: every piece — the
+launcher-compatible env contract, distributed optimizer, sequence
+parallelism, flash kernels (on TPU), the C++ file loader, checkpoints —
+is the framework's own. The reference (model-agnostic DP) has no LM
+example; reference analog for the training-loop shape is
+examples/tf2_mnist_gradient_tape.py.
+
+Run (8-virtual-device CPU mesh):
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/gpt_train.py --dp 4 --sp 2 --steps 30
+
+or single real TPU chip:  python examples/gpt_train.py --steps 50
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from kungfu_tpu.env import apply_platform_override
+
+apply_platform_override()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dp", type=int, default=0, help="0 = all devices")
+    ap.add_argument("--sp", type=int, default=1)
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--batch", type=int, default=8, help="global batch (sequences)")
+    ap.add_argument("--seq-len", type=int, default=128)
+    ap.add_argument("--d-model", type=int, default=128)
+    ap.add_argument("--n-layers", type=int, default=4)
+    ap.add_argument("--n-heads", type=int, default=8)
+    ap.add_argument("--n-kv-heads", type=int, default=2)
+    ap.add_argument("--vocab", type=int, default=512)
+    ap.add_argument("--ckpt-dir", default="", help="enable checkpointing")
+    ap.add_argument("--ckpt-every", type=int, default=10)
+    args = ap.parse_args()
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from kungfu_tpu.models.transformer import (
+        TransformerConfig, TransformerLM, lm_loss,
+    )
+    from kungfu_tpu.plan import make_mesh
+    from kungfu_tpu.trainer import MeshTrainer
+
+    n_dev = len(jax.devices())
+    dp = args.dp or max(1, n_dev // args.sp)
+    mesh = make_mesh(dp=dp, sp=args.sp) if args.sp > 1 else make_mesh(dp=dp)
+    cfg = TransformerConfig(
+        vocab_size=args.vocab, d_model=args.d_model, n_layers=args.n_layers,
+        n_heads=args.n_heads, n_kv_heads=args.n_kv_heads, rope=True,
+        ffn="swiglu", d_ff=4 * args.d_model, max_len=args.seq_len,
+        dtype=jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32,
+        attention="ring" if args.sp > 1 else "auto", mesh=mesh,
+    )
+    model = TransformerLM(cfg)
+    trainer = MeshTrainer(
+        model,
+        lambda m, p, t: lm_loss(m.apply({"params": p}, t), t),
+        optax.adamw(3e-4, weight_decay=0.01),
+        mesh=mesh,
+    )
+
+    rng = np.random.RandomState(0)
+
+    def batches():
+        # synthetic token stream with learnable bigram structure so the
+        # loss visibly falls; swap in data_files.FileBatchLoader for a
+        # real corpus
+        while True:
+            start = rng.randint(0, args.vocab // 2, size=(args.batch, 1))
+            ramp = (start + np.arange(args.seq_len)[None, :]) % args.vocab
+            yield ramp.astype(np.int32)
+
+    it = batches()
+    state = trainer.init(jax.random.PRNGKey(0), next(it))
+
+    manager = None
+    start_step = 0
+    if args.ckpt_dir:
+        from kungfu_tpu.checkpoint import CheckpointManager
+
+        manager = CheckpointManager(args.ckpt_dir)
+        if manager.latest_step() is not None:
+            # checkpoints hold plain pytrees; rebuild the TrainState around
+            # the restored leaves (placed onto the current mesh via `like`)
+            like = {"params": state.params, "opt_state": state.opt_state}
+            tree, meta = manager.restore(like=like)
+            # re-place every leaf onto the live state's sharding (restore
+            # can drop the mesh placement of scalar leaves)
+            tree = jax.tree.map(
+                lambda x, ref: jax.device_put(x, ref.sharding), tree, like
+            )
+            start_step = int(meta.get("step", 0))
+            state = type(state)(
+                params=tree["params"], opt_state=tree["opt_state"],
+                step=start_step,
+            )
+            print(f"# resumed from step {start_step}")
+
+    if start_step >= args.steps:
+        print(f"# checkpoint already at step {start_step} >= --steps "
+              f"{args.steps}; nothing to train")
+        return 0
+    t0 = time.perf_counter()
+    loss = float("nan")
+    for i in range(start_step, args.steps):
+        state, metrics = trainer.train_step(state, trainer.shard_batch(next(it)))
+        if (i + 1) % 10 == 0 or i + 1 == args.steps:
+            loss = float(np.asarray(metrics["loss"]))
+            print(f"# step {i + 1} loss {loss:.4f}", flush=True)
+        if manager is not None and (i + 1) % args.ckpt_every == 0:
+            manager.save(
+                i + 1,
+                {"params": state.params, "opt_state": state.opt_state},
+                meta={"step": i + 1},
+            )
+    if manager is not None:
+        manager.wait()
+    dt = time.perf_counter() - t0
+    tok_s = (args.steps - start_step) * args.batch * args.seq_len / dt
+    print(
+        f"RESULT: example=gpt_train loss={loss:.4f} steps={args.steps} "
+        f"mesh={dict(mesh.shape)} tokens_per_sec={tok_s:.0f}",
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -259,7 +259,7 @@ def config_bert_sma(steps: int = 10) -> dict:
     return d
 
 
-def config_resnet50_gossip(steps: int = 10) -> dict:
+def config_resnet50_gossip(steps: int = 5) -> dict:
     """BASELINE config 4: ResNet-50 + PairAveraging.
 
     SPMD variant (ppermute randomized pairing) measured as throughput; the
@@ -278,7 +278,10 @@ def config_resnet50_gossip(steps: int = 10) -> dict:
 
     try:
         n_chips = len(jax.devices())
-        batch = int(os.environ.get("KFT_BENCH_BATCH", "128"))
+        # smaller default batch than the S-SGD bench: the per-replica gossip
+        # program is the one observed wedging the TPU tunnel at batch 128 —
+        # keep the compiled program small (KFT_GOSSIP_BATCH overrides)
+        batch = int(os.environ.get("KFT_GOSSIP_BATCH", "64"))
         model = ResNet50(num_classes=1000, norm_dtype=jnp.bfloat16)
 
         def loss_fn(params, model_state, b):

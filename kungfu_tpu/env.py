@@ -69,14 +69,20 @@ class Config:
 
 
 def apply_platform_override() -> None:
-    """Honor an explicit non-TPU JAX_PLATFORMS request (e.g. cpu).
+    """Honor an explicit non-TPU platform request (JAX_PLATFORMS or the
+    launcher's KFT_PLATFORM worker contract, e.g. ``-platform cpu``).
 
     The TPU tunnel's sitecustomize forces jax_platforms via jax.config in
     every process, so the env var alone is not enough — scripts that want
     the virtual CPU mesh must route through jax.config too.  Call before
     any backend use.
     """
-    plat = os.environ.get("JAX_PLATFORMS", "")
+    # KFT_PLATFORM is the launcher's EXPLICIT per-worker contract (set by
+    # `-platform cpu`) and wins over an inherited JAX_PLATFORMS (the tunnel
+    # environment exports axon globally)
+    plat = os.environ.get("KFT_PLATFORM", "") or os.environ.get(
+        "JAX_PLATFORMS", ""
+    )
     if plat and "axon" not in plat and "tpu" not in plat:
         import jax
 

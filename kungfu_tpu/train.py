@@ -105,6 +105,11 @@ class DataParallelTrainer:
         In replicated mode the new model_state is pmean'd across the data
         axis each step (cross-replica BN stat sync); in per_replica mode
         each replica keeps its own.
+      accum_steps: gradient accumulation — the batch's leading dim splits
+        into `accum_steps` microbatches, grads average over a lax.scan, and
+        the optimizer applies once.  Trains global batches whose activations
+        don't fit HBM; the distributed reduce still happens once per step
+        (inside tx), exactly like fused-gradient S-SGD.
     """
 
     def __init__(
@@ -116,6 +121,7 @@ class DataParallelTrainer:
         per_replica_params: bool = False,
         donate: bool = True,
         has_aux: bool = False,
+        accum_steps: int = 1,
     ):
         self.loss_fn = loss_fn
         self.tx = tx
@@ -123,6 +129,7 @@ class DataParallelTrainer:
         self.axis_name = axis_name
         self.per_replica = per_replica_params
         self.has_aux = has_aux
+        self.accum_steps = accum_steps
         self._donate = donate
         self._step_fn = self._build_step(donate)
 
@@ -148,20 +155,29 @@ class DataParallelTrainer:
             params = jax.tree.map(unstack, params)
             opt_state = jax.tree.map(unstack, opt_state)
             model_state = jax.tree.map(unstack, model_state)
-        if self.has_aux:
+        def sync_model_state(ms):
+            # cross-replica sync of e.g. BN running stats so replicated
+            # state stays identical on every device; non-float leaves
+            # (counters, PRNG keys) must not be averaged
+            return jax.tree.map(
+                lambda x: jax.lax.pmean(x, axis)
+                if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+                else x,
+                ms,
+            )
+
+        if self.accum_steps > 1:
+            loss, model_state, grads = self._accum_grads(
+                params, model_state, batch
+            )
+            if self.has_aux and not self.per_replica:
+                model_state = sync_model_state(model_state)
+        elif self.has_aux:
             (loss, model_state), grads = jax.value_and_grad(
                 self.loss_fn, has_aux=True
             )(params, model_state, batch)
             if not self.per_replica:
-                # cross-replica sync of e.g. BN running stats so replicated
-                # state stays identical on every device; non-float leaves
-                # (counters, PRNG keys) must not be averaged
-                model_state = jax.tree.map(
-                    lambda x: jax.lax.pmean(x, axis)
-                    if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
-                    else x,
-                    model_state,
-                )
+                model_state = sync_model_state(model_state)
         else:
             loss, grads = jax.value_and_grad(self.loss_fn)(params, batch)
         updates, opt_state = self.tx.update(grads, opt_state, params)
@@ -173,6 +189,41 @@ class DataParallelTrainer:
             opt_state = jax.tree.map(stack, opt_state)
             model_state = jax.tree.map(stack, model_state)
         return params, opt_state, model_state, loss
+
+    def _accum_grads(self, params, model_state, batch):
+        """Microbatch scan: mean loss/grads over accum_steps slices of the
+        replica-local batch; model_state (BN stats) threads sequentially."""
+        a = self.accum_steps
+
+        def split(x):
+            n = x.shape[0]
+            if n % a:
+                raise ValueError(
+                    f"replica-local batch dim {n} not divisible by "
+                    f"accum_steps={a}"
+                )
+            return x.reshape((a, n // a) + x.shape[1:])
+
+        micro = jax.tree.map(split, batch)
+        gzero = jax.tree.map(jnp.zeros_like, params)
+
+        def body(carry, mb):
+            ms, gsum, lsum = carry
+            if self.has_aux:
+                (loss, ms), g = jax.value_and_grad(self.loss_fn, has_aux=True)(
+                    params, ms, mb
+                )
+            else:
+                loss, g = jax.value_and_grad(self.loss_fn)(params, mb)
+            gsum = jax.tree.map(jnp.add, gsum, g)
+            return (ms, gsum, lsum + loss.astype(jnp.float32)), None
+
+        (model_state, gsum, lsum), _ = jax.lax.scan(
+            body, (model_state, gzero, jnp.zeros((), jnp.float32)), micro
+        )
+        inv = 1.0 / a
+        grads = jax.tree.map(lambda g: g * inv, gsum)
+        return lsum * inv, model_state, grads
 
     def _build_step(self, donate: bool) -> Callable:
         state_spec = P(self.axis_name) if self.per_replica else P()

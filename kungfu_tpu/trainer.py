@@ -97,6 +97,22 @@ class MeshTrainer:
             placed = jax.jit(lambda p: p, out_shardings=self._shardings)(params)
             # let propagation shard the optimizer state like the params
             opt_state = jax.jit(self.tx.init)(placed)
+            # leaves tx.init created fresh (step counters, scalar
+            # schedules) come back default-placed on ONE device, not the
+            # mesh — harmless for the (uncommitted) train step but a
+            # committed single-device sharding after checkpoint restore
+            # conflicts with the mesh.  Pin them replicated on the mesh.
+            mesh_devs = set(self.mesh.devices.flat)
+            replicated = NamedSharding(self.mesh, P())
+
+            def on_mesh(x):
+                if getattr(x, "sharding", None) is None:
+                    return x
+                if set(x.sharding.device_set) != mesh_devs:
+                    return jax.device_put(x, replicated)
+                return x
+
+            opt_state = jax.tree.map(on_mesh, opt_state)
         self._step_fn = self._build_step()
         return TrainState(params=placed, opt_state=opt_state, step=0)
 

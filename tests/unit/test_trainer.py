@@ -213,3 +213,75 @@ def test_mesh_trainer_train_steps_matches_single_steps():
     assert sb.step == 3
     la, lb = float(np.asarray(ma["loss"])), float(np.asarray(mb["loss"]))
     assert np.isclose(la, lb, rtol=1e-5), (la, lb)
+
+
+class TestGradAccumulation:
+    def _data(self, n=64, seed=0):
+        rng = np.random.RandomState(seed)
+        return (rng.randn(n, 8, 8, 1).astype(np.float32),
+                rng.randint(0, 10, size=n).astype(np.int32))
+
+    def test_accum_matches_single_step(self):
+        """accum_steps=4 on one batch == accum_steps=1 (mean-based loss)."""
+        import optax
+
+        from kungfu_tpu.models.slp import MLP, softmax_cross_entropy
+        from kungfu_tpu.optimizers import synchronous_sgd
+        from kungfu_tpu.train import DataParallelTrainer
+
+        model = MLP(hidden=(16,), num_classes=10)
+        params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 8, 1)))["params"]
+
+        def loss_fn(p, batch):
+            images, labels = batch
+            return softmax_cross_entropy(model.apply({"params": p}, images), labels)
+
+        def run(accum):
+            tr = DataParallelTrainer(
+                loss_fn, synchronous_sgd(optax.sgd(0.1)), accum_steps=accum
+            )
+            st = tr.init(jax.tree.map(jnp.array, params))
+            for seed in range(3):
+                st, m = tr.train_step(st, tr.shard_batch(self._data(seed=seed)))
+            return jax.tree.map(np.asarray, st.params), float(np.asarray(m["loss"]))
+
+        p1, l1 = run(1)
+        p4, l4 = run(4)
+        assert abs(l1 - l4) < 1e-5
+        for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p4)):
+            np.testing.assert_allclose(a, b, atol=1e-5)
+
+    def test_accum_threads_model_state(self):
+        """has_aux path: BN-style state threads through the microbatch scan."""
+        import optax
+
+        from kungfu_tpu.train import DataParallelTrainer
+
+        def loss_fn(p, state, batch):
+            x, _ = batch
+            mean = jnp.mean(x)
+            new_state = {"count": state["count"] + 1.0,
+                         "running": 0.9 * state["running"] + 0.1 * mean}
+            return jnp.mean((x * p["w"]) ** 2), new_state
+
+        tr = DataParallelTrainer(
+            loss_fn, optax.sgd(0.01), has_aux=True, accum_steps=4
+        )
+        st = tr.init({"w": jnp.ones(())}, model_state={"count": jnp.zeros(()),
+                                                       "running": jnp.zeros(())})
+        st, _ = tr.train_step(st, tr.shard_batch(self._data()))
+        # the counter advanced once per MICROBATCH, not once per step
+        assert float(np.asarray(st.model_state["count"])) == 4.0
+
+    def test_accum_indivisible_raises(self):
+        import optax
+
+        from kungfu_tpu.train import DataParallelTrainer
+
+        tr = DataParallelTrainer(
+            lambda p, b: jnp.sum(p["w"] * jnp.mean(b[0])), optax.sgd(0.1),
+            accum_steps=3,
+        )
+        st = tr.init({"w": jnp.ones(())})
+        with pytest.raises(ValueError, match="not divisible"):
+            tr.train_step(st, tr.shard_batch(self._data(n=64)))
