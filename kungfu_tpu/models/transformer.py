@@ -17,6 +17,7 @@ parallel/sharding.py's rules.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from functools import partial
 from typing import Any, Optional, Tuple
 
@@ -69,9 +70,17 @@ class TransformerConfig:
     # mesh is needed for attention="ring"/"ulysses" (shard_map region)
     mesh: Optional[Mesh] = None
     sp_axis: str = "sp"
+    # autoregressive decode mode: attention keeps a KV cache ("cache"
+    # collection) of max_len positions and consumes 1..n new tokens per
+    # call.  Training parallelism axes don't apply; requires rope (the
+    # cache index supplies absolute positions).  See `generate`.
+    decode: bool = False
 
     def __post_init__(self):
         assert self.d_model % self.n_heads == 0
+        if self.decode:
+            assert self.rope, "decode mode requires rope positions"
+            assert self.n_experts == 0, "decode mode supports dense models"
         if self.n_kv_heads:
             assert self.n_heads % self.n_kv_heads == 0, (
                 "query heads must be a multiple of kv heads"
@@ -131,6 +140,59 @@ class Attention(nn.Module):
         q = _dense(cfg.d_model, "q", qkv_axes, cfg.dtype)(x).reshape(B, L, H, D)
         k = _dense(Hkv * D, "k", qkv_axes, cfg.dtype)(x).reshape(B, L, Hkv, D)
         v = _dense(Hkv * D, "v", qkv_axes, cfg.dtype)(x).reshape(B, L, Hkv, D)
+
+        if cfg.decode:
+            # KV-cache decode: write this call's k/v at the cache cursor,
+            # attend q against the whole cache, advance the cursor
+            cache_k = self.variable(
+                "cache", "cached_k", jnp.zeros, (B, cfg.max_len, Hkv, D), cfg.dtype
+            )
+            cache_v = self.variable(
+                "cache", "cached_v", jnp.zeros, (B, cfg.max_len, Hkv, D), cfg.dtype
+            )
+            cache_idx = self.variable(
+                "cache", "idx", lambda: jnp.zeros((), jnp.int32)
+            )
+            idx0 = cache_idx.value
+            pos = idx0 + jnp.arange(L)
+            q = apply_rope(q, pos, cfg.rope_theta)
+            k = apply_rope(k, pos, cfg.rope_theta)
+            if not self.is_initializing():
+                # init() traces the module once to create the cache — it
+                # must not write tokens or advance the cursor
+                cache_k.value = jax.lax.dynamic_update_slice(
+                    cache_k.value, k.astype(cache_k.value.dtype),
+                    (0, idx0, 0, 0),
+                )
+                cache_v.value = jax.lax.dynamic_update_slice(
+                    cache_v.value, v.astype(cache_v.value.dtype),
+                    (0, idx0, 0, 0),
+                )
+                cache_idx.value = idx0 + L
+            kf = cache_k.value
+            vf = cache_v.value
+            if Hkv != H:
+                kf = jnp.repeat(kf, H // Hkv, axis=2)
+                vf = jnp.repeat(vf, H // Hkv, axis=2)
+            scale = 1.0 / (D ** 0.5)
+            s = jnp.einsum(
+                "blhd,bmhd->bhlm",
+                q.astype(jnp.float32) * scale, kf.astype(jnp.float32),
+            )
+            q_pos = pos[:, None]                       # [L, 1]
+            c_pos = jnp.arange(cfg.max_len)[None, :]   # [1, max_len]
+            s = jnp.where((c_pos <= q_pos)[None, None], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bhlm,bmhd->blhd", p, vf.astype(jnp.float32))
+            # cursor past max_len would clamp the cache write and unmask
+            # clobbered slots — poison those rows with NaN so overflow is
+            # LOUD instead of silently-wrong logits (generate() bounds the
+            # total; this guards the raw decode apply() surface)
+            o = jnp.where((pos >= cfg.max_len)[None, :, None, None],
+                          jnp.nan, o)
+            o = o.astype(cfg.dtype).reshape(B, L, cfg.d_model)
+            return _dense(cfg.d_model, "out", ("heads", "embed"), cfg.dtype)(o)
+
         if cfg.rope:
             # global positions: L here is the full (logical) sequence even
             # when seq is sharded — the constraint below keeps the sharding
@@ -276,6 +338,84 @@ class TransformerLM(nn.Module):
                          scale_init=nn.with_logical_partitioning(nn.initializers.ones, ("embed",)))(x)
         logits = _dense(cfg.vocab_size, "lm_head", ("embed", "vocab"), jnp.float32)(x)
         return logits
+
+
+def generate(
+    cfg: TransformerConfig,
+    params,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Autoregressive generation with a KV cache (prefill + jitted scan).
+
+    `params` are ordinary trained TransformerLM params (rope configs carry
+    no position table, so train and decode share them verbatim).  Greedy at
+    temperature 0, categorical sampling otherwise.  Returns
+    [B, prompt_len + max_new_tokens] tokens.  Beyond-parity capability: the
+    reference is training-only.
+    """
+    assert prompt.ndim == 2
+    b, prompt_len = prompt.shape
+    assert cfg.rope, (
+        "generate() requires a rope-trained model: a learned pos_embed "
+        "table has no decode-cursor equivalent here"
+    )
+    assert prompt_len + max_new_tokens <= cfg.max_len, (
+        f"{prompt_len}+{max_new_tokens} exceeds max_len={cfg.max_len}"
+    )
+    dcfg = dataclasses.replace(cfg, decode=True, attention="full", mesh=None)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    run = _generate_compiled(dcfg, b, prompt_len, max_new_tokens, temperature)
+    model = TransformerLM(dcfg)
+    cache = model.init(jax.random.PRNGKey(0), prompt[:, :1])["cache"]
+    return run(params, cache, prompt, rng)
+
+
+@functools.lru_cache(maxsize=64)
+def _generate_compiled(dcfg: TransformerConfig, b: int, prompt_len: int,
+                       max_new_tokens: int, temperature: float):
+    """One jitted prefill+scan program per (config, shape) — repeat
+    generate() calls with the same shapes hit the jit cache instead of
+    retracing."""
+    model = TransformerLM(dcfg)
+
+    def pick(logits, r):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            r, logits.astype(jnp.float32) / temperature, axis=-1
+        ).astype(jnp.int32)
+
+    @jax.jit
+    def run(params, cache, prompt, rng):
+        logits, st = model.apply(
+            {"params": params, "cache": cache}, prompt, mutable=["cache"]
+        )
+        rng, r0 = jax.random.split(rng)
+        tok = pick(logits[:, -1], r0)
+
+        def step(carry, _):
+            cache, tok, rng = carry
+            logits, st = model.apply(
+                {"params": params, "cache": cache}, tok[:, None],
+                mutable=["cache"],
+            )
+            rng, r = jax.random.split(rng)
+            nxt = pick(logits[:, -1], r)
+            return (st["cache"], nxt, rng), tok
+
+        (_, last, _), toks = jax.lax.scan(
+            step, (st["cache"], tok, rng), None, length=max_new_tokens - 1
+        )
+        return jnp.concatenate(
+            [prompt.astype(jnp.int32), jnp.moveaxis(toks, 0, 1),
+             last[:, None]], axis=1
+        )
+
+    return run
 
 
 def lm_loss(logits: jax.Array, tokens: jax.Array) -> jax.Array:
