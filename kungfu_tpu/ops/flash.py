@@ -198,7 +198,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     """dq for one q block: iterate k/v blocks, accumulate ds @ k.
 
     q_ref/do_ref/dq_ref: [1, block_q, D]; k_ref/v_ref: [1, L_pad, D];
-    lse_ref/delta_ref: [1, block_q, 128] (value broadcast across lanes).
+    lse_ref/delta_ref: [1, 1, block_q] (sequence on lanes).
     """
     qi = pl.program_id(1)
     block_q = q_ref.shape[1]
@@ -207,10 +207,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
 
     q = q_ref[0].astype(jnp.float32) * scale          # [block_q, D]
     do = do_ref[0].astype(jnp.float32)                # [block_q, D]
-    # lse/delta ride 128 lanes (TPU tiling: block last-two dims must be
-    # 8/128-aligned — same layout the forward emits); lane 0 is the value
-    lse = lse_ref[0, :, 0:1].astype(jnp.float32)      # [block_q, 1]
-    delta = delta_ref[0, :, 0:1].astype(jnp.float32)
+    # lse/delta are [1, 1, block_q] lane vectors (seq on lanes — the
+    # layout upstream TPU flash kernels use); [:, None] relayouts to a
+    # per-sublane column
+    lse = lse_ref[0, 0, :].astype(jnp.float32)[:, None]   # [block_q, 1]
+    delta = delta_ref[0, 0, :].astype(jnp.float32)[:, None]
     q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
 
     def body(j, dq):
@@ -240,7 +241,7 @@ def _dkv_accum(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, ki: int, *,
     """Shared dk/dv accumulation over all q blocks for one k/v block.
 
     k_ref/v_ref: [1, block_k, D]; q_ref/do_ref: [1, L_pad, D];
-    lse_ref/delta_ref: [1, L_pad, 128] (lane-broadcast).  Padded q rows
+    lse_ref/delta_ref: [1, 1, L_pad] (sequence on lanes).  Padded q rows
     carry a REAL lse (they attend real keys in the forward), so they must
     be masked out here by q position, not by lse value.  Returns (dk, dv)
     fp32 [block_k, D].
@@ -257,8 +258,12 @@ def _dkv_accum(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, ki: int, *,
         dk, dv = carry
         q_blk = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32) * scale
         do_blk = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lse_blk = lse_ref[0, pl.ds(i * block_q, block_q), 0:1].astype(jnp.float32)
-        delta_blk = delta_ref[0, pl.ds(i * block_q, block_q), 0:1].astype(jnp.float32)
+        lse_blk = lse_ref[0, 0, pl.ds(i * block_q, block_q)].astype(
+            jnp.float32
+        )[:, None]
+        delta_blk = delta_ref[0, 0, pl.ds(i * block_q, block_q)].astype(
+            jnp.float32
+        )[:, None]
         s = jnp.dot(q_blk, k_blk.T, preferred_element_type=jnp.float32)
         q_pos = i * block_q + lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
         valid = jnp.logical_and(q_pos < seq_len, k_pos < seq_len)
@@ -338,17 +343,14 @@ def _bwd_pallas(q, k, v, o, lse, g, scale: float, causal: bool,
     delta = jnp.sum(o.astype(jnp.float32) * g.astype(jnp.float32), axis=-1)
     if g_lse is not None:
         delta = delta - g_lse.astype(jnp.float32)
-    # ride 128 lanes (TPU tiling wants 8/128-aligned trailing block dims,
-    # matching the forward's lse layout); kernels read lane 0.  The
-    # residual contract is 2D [bh, L] (shared with the XLA backward), so
-    # the forward's own lane-broadcast lse buffer is re-derived here —
-    # delta needs the broadcast regardless.
-    def lanes(x):
-        xp = _pad_to(x.astype(jnp.float32), block_q, 1)     # [bh, lq]
-        return jnp.broadcast_to(xp[:, :, None], xp.shape + (128,))
+    # [bh, 1, lq] lane-vector layout: sequence on lanes, one tiled row per
+    # bh (the upstream TPU flash layout) — lq*4 bytes per operand instead
+    # of a 128-lane broadcast
+    def rows(x):
+        return _pad_to(x.astype(jnp.float32), block_q, 1)[:, None, :]
 
-    lse_p = lanes(lse)
-    delta_p = lanes(delta)
+    lse_p = rows(lse)
+    delta_p = rows(delta)
 
     vma = frozenset().union(
         *(getattr(jax.typeof(x), "vma", frozenset())
@@ -367,8 +369,8 @@ def _bwd_pallas(q, k, v, o, lse, g, scale: float, causal: bool,
             kv_spec,
             kv_spec,
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 128), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 128), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, lq, d), q.dtype, vma=vma),
@@ -388,8 +390,8 @@ def _bwd_pallas(q, k, v, o, lse, g, scale: float, causal: bool,
                 pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
                 pl.BlockSpec((1, lq, d), lambda b, j: (b, 0, 0)),
                 pl.BlockSpec((1, lq, d), lambda b, j: (b, 0, 0)),
-                pl.BlockSpec((1, lq, 128), lambda b, j: (b, 0, 0)),
-                pl.BlockSpec((1, lq, 128), lambda b, j: (b, 0, 0)),
+                pl.BlockSpec((1, 1, lq), lambda b, j: (b, 0, 0)),
+                pl.BlockSpec((1, 1, lq), lambda b, j: (b, 0, 0)),
             ],
             out_specs=[
                 pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
@@ -417,8 +419,8 @@ def _bwd_pallas(q, k, v, o, lse, g, scale: float, causal: bool,
                 pl.BlockSpec((1, block_k, d), lambda b, j, g_: (b, j, 0)),
                 pl.BlockSpec((1, lq, d), lambda b, j, g_: (qrow(b, g_), 0, 0)),
                 pl.BlockSpec((1, lq, d), lambda b, j, g_: (qrow(b, g_), 0, 0)),
-                pl.BlockSpec((1, lq, 128), lambda b, j, g_: (qrow(b, g_), 0, 0)),
-                pl.BlockSpec((1, lq, 128), lambda b, j, g_: (qrow(b, g_), 0, 0)),
+                pl.BlockSpec((1, 1, lq), lambda b, j, g_: (qrow(b, g_), 0, 0)),
+                pl.BlockSpec((1, 1, lq), lambda b, j, g_: (qrow(b, g_), 0, 0)),
             ],
             out_specs=[
                 pl.BlockSpec((1, block_k, d), lambda b, j, g_: (b, j, 0)),

@@ -6,8 +6,6 @@ kernels (e.g. non-8/128-aligned trailing block dims) fail HERE instead of
 on the chip.  This is the strongest kernel evidence available off-chip;
 the attention bench records the on-chip numbers.
 """
-import numpy as np
-import pytest
 
 import jax
 import jax.numpy as jnp
