@@ -48,7 +48,6 @@ def main() -> int:
     import numpy as np
     import jax
     import jax.numpy as jnp
-    import optax
 
     from kungfu_tpu.models.transformer import (
         TransformerConfig, TransformerLM, lm_loss,
@@ -62,15 +61,19 @@ def main() -> int:
     cfg = TransformerConfig(
         vocab_size=args.vocab, d_model=args.d_model, n_layers=args.n_layers,
         n_heads=args.n_heads, n_kv_heads=args.n_kv_heads, rope=True,
-        ffn="swiglu", d_ff=4 * args.d_model, max_len=args.seq_len,
+        ffn="swiglu", tie_embeddings=True, d_ff=4 * args.d_model,
+        max_len=args.seq_len,
         dtype=jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32,
         attention="ring" if args.sp > 1 else "auto", mesh=mesh,
     )
     model = TransformerLM(cfg)
+    from kungfu_tpu.optimizers import lm_adamw
+
     trainer = MeshTrainer(
         model,
         lambda m, p, t: lm_loss(m.apply({"params": p}, t), t),
-        optax.adamw(3e-4, weight_decay=0.01),
+        lm_adamw(3e-4, warmup_steps=max(2, args.steps // 10),
+                 total_steps=max(args.steps, 10)),
         mesh=mesh,
     )
 

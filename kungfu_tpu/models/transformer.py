@@ -63,6 +63,8 @@ class TransformerConfig:
     rope_theta: float = 10000.0
     # feed-forward flavor: "gelu" (2-matmul) or "swiglu" (gated, 3-matmul)
     ffn: str = "gelu"
+    # share the input embedding matrix with the lm_head (logits = x @ E^T)
+    tie_embeddings: bool = False
     # MoE: every `moe_every`-th block uses experts (0 = dense model)
     n_experts: int = 0
     moe_every: int = 2
@@ -336,7 +338,19 @@ class TransformerLM(nn.Module):
             x = Block(cfg, use_moe=use_moe, name=f"block_{i}")(x)
         x = nn.LayerNorm(dtype=jnp.float32, use_bias=False, name="ln_f",
                          scale_init=nn.with_logical_partitioning(nn.initializers.ones, ("embed",)))(x)
-        logits = _dense(cfg.vocab_size, "lm_head", ("embed", "vocab"), jnp.float32)(x)
+        if cfg.tie_embeddings:
+            # logits = x @ E^T with the INPUT embedding, in f32 to match
+            # the untied lm_head's precision (bf16 logits would noisily
+            # round the loss over a large vocab)
+            e = emb.variables["params"]["embedding"]
+            logits = jnp.einsum(
+                "bld,vd->blv", x.astype(jnp.float32),
+                nn.meta.unbox(e).astype(jnp.float32),
+            )
+        else:
+            logits = _dense(
+                cfg.vocab_size, "lm_head", ("embed", "vocab"), jnp.float32
+            )(x)
         return logits
 
 

@@ -14,6 +14,7 @@ Reference-named aliases (for users migrating from KungFu):
 from .sync import all_reduce_gradients, synchronous_sgd, synchronous_averaging, SMAState
 from .gossip import pair_averaging, GossipState
 from .adaptive import adaptive_sgd, AdaptiveSGDState
+from .presets import lm_adamw
 from .monitor import (
     gradient_noise_scale,
     gradient_variance,
@@ -39,4 +40,5 @@ __all__ = [
     "SynchronousSGDOptimizer", "SynchronousAveragingOptimizer",
     "PairAveragingOptimizer", "AdaptiveSGDOptimizer",
     "MonitorGradientNoiseScaleOptimizer", "MonitorGradientVarianceOptimizer",
+    "lm_adamw",
 ]

@@ -1,0 +1,38 @@
+"""Optimizer presets — sensible defaults for the model families shipped
+in kungfu_tpu.models.  These compose with the distributed wrappers the
+same way any optax transform does:
+
+    tx = synchronous_sgd(lm_adamw(3e-4, warmup_steps=2000, total_steps=100_000))
+
+(The reference wraps TF optimizers; presets have no reference analog.)
+"""
+from __future__ import annotations
+
+import jax
+import optax
+
+
+def lm_adamw(
+    lr: float,
+    warmup_steps: int,
+    total_steps: int,
+    weight_decay: float = 0.1,
+    b1: float = 0.9,
+    b2: float = 0.95,
+    min_lr_ratio: float = 0.1,
+    clip_norm: float = 1.0,
+) -> optax.GradientTransformation:
+    """The standard LLM-pretraining recipe: global-norm clip, AdamW with
+    b2=0.95, linear warmup -> cosine decay, and weight decay masked to
+    rank>=2 parameters (matrices decay; LayerNorm scales and other vectors
+    do not)."""
+    sched = optax.warmup_cosine_decay_schedule(
+        init_value=0.0, peak_value=lr, warmup_steps=warmup_steps,
+        decay_steps=total_steps, end_value=lr * min_lr_ratio,
+    )
+    decay_mask = lambda params: jax.tree.map(lambda p: p.ndim >= 2, params)
+    return optax.chain(
+        optax.clip_by_global_norm(clip_norm),
+        optax.adamw(sched, b1=b1, b2=b2, weight_decay=weight_decay,
+                    mask=decay_mask),
+    )

@@ -88,6 +88,12 @@ class PipelinedLM:
             raise ValueError(
                 f"n_layers={cfg.n_layers} must divide into S*R={groups} groups"
             )
+        if cfg.tie_embeddings:
+            raise ValueError(
+                "tie_embeddings is not supported under pipeline parallelism: "
+                "the embedding lives on the first stage and the head on the "
+                "last; use an untied lm_head"
+            )
         self.layers_per_group = cfg.n_layers // groups
         self.cfg = cfg
         # blocks run inside the manual pp region: their internal attention

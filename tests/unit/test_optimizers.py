@@ -308,3 +308,32 @@ class TestInitializer:
 
         assert np.asarray(run_spmd(mesh, step, same)).all()
         assert not np.asarray(run_spmd(mesh, step, diff)).any()
+
+
+def test_lm_adamw_preset():
+    """Warmup->cosine schedule, rank>=2 weight-decay mask, global clip."""
+    import optax
+
+    from kungfu_tpu.optimizers import lm_adamw
+
+    params = {"w": jnp.ones((4, 4)), "scale": jnp.ones((4,))}
+    tx = lm_adamw(1e-2, warmup_steps=2, total_steps=10)
+    st = tx.init(params)
+    g = {"w": jnp.ones((4, 4)), "scale": jnp.ones((4,))}
+    p = params
+    for _ in range(3):
+        upd, st = tx.update(g, st, p)
+        p = optax.apply_updates(p, upd)
+    # matrices decayed toward zero faster than the (undecayed) vector moved
+    assert float(p["w"].mean()) < 1.0
+    # the vector saw NO weight decay: with constant grads its update is the
+    # pure adam step; verify by comparing against weight_decay=0
+    tx0 = lm_adamw(1e-2, warmup_steps=2, total_steps=10, weight_decay=0.0)
+    st0 = tx0.init(params)
+    p0 = params
+    for _ in range(3):
+        upd, st0 = tx0.update(g, st0, p0)
+        p0 = optax.apply_updates(p0, upd)
+    np.testing.assert_allclose(np.asarray(p["scale"]), np.asarray(p0["scale"]),
+                               atol=1e-7)
+    assert not np.allclose(np.asarray(p["w"]), np.asarray(p0["w"]))

@@ -139,3 +139,21 @@ def test_pipelined_rejects_bad_configs():
         params = nn.meta.unbox(model.init(jax.random.PRNGKey(0), tokens)["params"])
         with mesh:
             jax.jit(lambda p: model.apply({"params": p}, tokens))(params)
+
+
+def test_pp_rejects_tied_embeddings():
+    import dataclasses
+
+    import pytest as _pytest
+
+    from kungfu_tpu.models.transformer import TransformerConfig
+    from kungfu_tpu.parallel.pp_transformer import PipelinedLM
+    from kungfu_tpu.plan import MeshSpec, make_mesh
+
+    mesh = make_mesh(MeshSpec.make(pp=4), devices=jax.devices()[:4])
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=4, n_heads=4, d_ff=64,
+        max_len=32, dtype=jnp.float32, tie_embeddings=True, mesh=mesh,
+    )
+    with _pytest.raises(ValueError, match="tie_embeddings"):
+        PipelinedLM(cfg, microbatches=2)

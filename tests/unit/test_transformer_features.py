@@ -249,3 +249,22 @@ def test_decode_overflow_poisons():
             assert np.isfinite(np.asarray(o)).all(), i
         else:
             assert np.isnan(np.asarray(o)).all(), i
+
+
+def test_tied_embeddings():
+    """tie_embeddings drops lm_head and decodes with the embedding matrix."""
+    cfg = _base(tie_embeddings=True, rope=True, attention="full")
+    tokens = np.random.RandomState(0).randint(0, 64, (2, 12)).astype(np.int32)
+    model = TransformerLM(cfg)
+    params = nn.meta.unbox(model.init(jax.random.PRNGKey(0), tokens)["params"])
+    assert "lm_head" not in params
+    logits = model.apply({"params": params}, tokens)
+    assert logits.shape == (2, 12, 64) and np.isfinite(np.asarray(logits)).all()
+    # gradient flows into the shared matrix from BOTH uses
+    g = jax.grad(lambda p: lm_loss(model.apply({"params": p}, tokens), tokens))(params)
+    assert float(np.abs(np.asarray(g["embed"]["embedding"])).sum()) > 0
+    # and generate() works with tied weights
+    from kungfu_tpu.models.transformer import generate
+
+    out = generate(cfg, params, jnp.asarray(tokens[:, :4]), 3)
+    assert out.shape == (2, 7)
