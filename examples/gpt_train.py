@@ -43,6 +43,10 @@ def main() -> int:
     ap.add_argument("--vocab", type=int, default=512)
     ap.add_argument("--ckpt-dir", default="", help="enable checkpointing")
     ap.add_argument("--ckpt-every", type=int, default=10)
+    ap.add_argument("--data", default="synthetic", choices=["synthetic", "files"],
+                    help="files = stream token chunks via the C++ loader")
+    ap.add_argument("--data-dir", default="/tmp/kft_gpt_tokens",
+                    help="token-chunk dir for --data files (built if missing)")
     args = ap.parse_args()
 
     import numpy as np
@@ -79,16 +83,52 @@ def main() -> int:
 
     rng = np.random.RandomState(0)
 
-    def batches():
+    def synthetic_batches():
         # synthetic token stream with learnable bigram structure so the
-        # loss visibly falls; swap in data_files.FileBatchLoader for a
-        # real corpus
+        # loss visibly falls
         while True:
             start = rng.randint(0, args.vocab // 2, size=(args.batch, 1))
             ramp = (start + np.arange(args.seq_len)[None, :]) % args.vocab
             yield ramp.astype(np.int32)
 
-    it = batches()
+    def file_batches():
+        # token sequences as a chunked idx dir streamed by the C++ loader
+        # (the idx machinery is shape-generic: [N, seq_len] int32 works the
+        # same as [N, H, W, C] images; labels carry the sample index)
+        from kungfu_tpu import data_files as df
+
+        if not os.path.isdir(args.data_dir):
+            n = 4096
+            start = rng.randint(0, args.vocab // 2, size=(n, 1))
+            toks = ((start + np.arange(args.seq_len)) % args.vocab).astype(
+                np.int32
+            )
+            df.write_chunks(args.data_dir, toks,
+                            np.arange(n, dtype=np.int32),
+                            samples_per_chunk=512)
+        ds = df.FileDataset(args.data_dir)
+        if tuple(ds.sample_shape) != (args.seq_len,):
+            raise SystemExit(
+                f"--data-dir {args.data_dir} holds seq_len "
+                f"{ds.sample_shape} chunks but --seq-len is {args.seq_len}; "
+                "delete the dir or point at a matching one"
+            )
+        vmax = max(int(c.max()) for c in ds.images)
+        if vmax >= args.vocab:
+            raise SystemExit(
+                f"--data-dir tokens reach id {vmax} but --vocab is "
+                f"{args.vocab}; delete the dir or raise --vocab"
+            )
+        loader = df.FileBatchLoader(ds, batch_size=args.batch, threads=2,
+                                    queue_cap=4)
+        try:
+            while True:
+                toks, _ = next(loader)
+                yield toks
+        finally:
+            loader.close()
+
+    it = file_batches() if args.data == "files" else synthetic_batches()
     state = trainer.init(jax.random.PRNGKey(0), next(it))
 
     manager = None
