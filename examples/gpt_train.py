@@ -113,7 +113,12 @@ def main() -> int:
                 f"{ds.sample_shape} chunks but --seq-len is {args.seq_len}; "
                 "delete the dir or point at a matching one"
             )
-        vmax = max(int(c.max()) for c in ds.images)
+        # bounded sample: a full scan of a multi-GB memmapped corpus
+        # would block startup for minutes
+        vmax = max(
+            int(c[: max(1, 65536 // max(1, c.shape[-1]))].max())
+            for c in ds.images
+        )
         if vmax >= args.vocab:
             raise SystemExit(
                 f"--data-dir tokens reach id {vmax} but --vocab is "

@@ -61,6 +61,10 @@ class TransformerConfig:
     # sequence-parallel region, so ring/ulysses shards see correct offsets.
     rope: bool = False
     rope_theta: float = 10000.0
+    # sliding-window (local) attention: 0 = unlimited; >0 = each query
+    # attends only the last `window` positions (flash kernels skip the
+    # dead blocks).  Supported by the "flash"/"full" paths; requires causal
+    window: int = 0
     # feed-forward flavor: "gelu" (2-matmul) or "swiglu" (gated, 3-matmul)
     ffn: str = "gelu"
     # share the input embedding matrix with the lm_head (logits = x @ E^T)
@@ -90,6 +94,12 @@ class TransformerConfig:
         if self.rope:
             assert (self.d_model // self.n_heads) % 2 == 0, (
                 "rope rotates feature pairs: head_dim must be even"
+            )
+        if self.window:
+            assert self.window > 0, "window must be positive (0 = unlimited)"
+            assert self.causal, "sliding window requires causal attention"
+            assert self.attention in ("auto", "flash", "full"), (
+                "sliding window is supported on the flash/full paths"
             )
         assert self.ffn in ("gelu", "swiglu"), self.ffn
 
@@ -183,7 +193,10 @@ class Attention(nn.Module):
             )
             q_pos = pos[:, None]                       # [L, 1]
             c_pos = jnp.arange(cfg.max_len)[None, :]   # [1, max_len]
-            s = jnp.where((c_pos <= q_pos)[None, None], s, -1e30)
+            valid = c_pos <= q_pos
+            if cfg.window:  # sliding-window models decode windowed too
+                valid = jnp.logical_and(valid, q_pos - c_pos < cfg.window)
+            s = jnp.where(valid[None, None], s, -1e30)
             p = jax.nn.softmax(s, axis=-1)
             o = jnp.einsum("bhlm,bmhd->blhd", p, vf.astype(jnp.float32))
             # cursor past max_len would clamp the cache write and unmask
@@ -260,16 +273,19 @@ class Attention(nn.Module):
                     None,
                 )
                 attn = _shard_map(
-                    partial(flash_attention, causal=cfg.causal),
+                    partial(flash_attention, causal=cfg.causal,
+                            window=cfg.window or None),
                     mesh=cfg.mesh,
                     in_specs=(spec, spec, spec),
                     out_specs=spec,
                 )
                 o = attn(q, k, v)
             else:
-                o = flash_attention(q, k, v, causal=cfg.causal)
+                o = flash_attention(q, k, v, causal=cfg.causal,
+                                    window=cfg.window or None)
         else:
-            o = full_attention(q, k, v, causal=cfg.causal)
+            o = full_attention(q, k, v, causal=cfg.causal,
+                               window=cfg.window or None)
 
         o = o.reshape(B, L, cfg.d_model)
         return _dense(cfg.d_model, "out", ("heads", "embed"), cfg.dtype)(o)

@@ -39,7 +39,7 @@ def _use_interpret() -> bool:
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
-                causal: bool, block_k: int, seq_len: int):
+                causal: bool, block_k: int, seq_len: int, window: int):
     """One q block vs all (needed) k blocks; online softmax in fp32.
 
     q_ref: [1, block_q, D]; k_ref/v_ref: [1, L_pad, D];
@@ -63,6 +63,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
         valid = k_pos < seq_len  # mask the padded tail
         if causal:
             valid = jnp.logical_and(valid, q_pos >= k_pos)
+        if window > 0:  # sliding window: only the last `window` positions
+            valid = jnp.logical_and(valid, q_pos - k_pos < window)
         s = jnp.where(valid, s, NEG_INF)
         m_blk = jnp.max(s, axis=-1, keepdims=True)  # [block_q, 1]
         m_new = jnp.maximum(m, m_blk)
@@ -77,9 +79,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
     acc0 = jnp.zeros((block_q, d), jnp.float32)
     if causal:
         # blocks strictly above the diagonal contribute nothing: stop after
-        # the block containing this q block's last position
+        # the block containing this q block's last position; a sliding
+        # window also skips blocks entirely below q_start - window + 1
         nk_needed = lax.min(nk, pl.cdiv((qi + 1) * block_q, block_k))
-        m, l, acc = lax.fori_loop(0, nk_needed, body, (m0, l0, acc0))
+        start = (
+            lax.max(0, (qi * block_q - window + 1) // block_k)
+            if window > 0 else 0
+        )
+        m, l, acc = lax.fori_loop(start, nk_needed, body, (m0, l0, acc0))
     else:
         m, l, acc = lax.fori_loop(0, nk, body, (m0, l0, acc0))
 
@@ -101,7 +108,7 @@ def _pad_to(x, multiple: int, axis: int):
     return jnp.pad(x, pad)
 
 
-def _fwd_reference(q, k, v, scale: float, causal: bool):
+def _fwd_reference(q, k, v, scale: float, causal: bool, window: int = 0):
     """Pure-XLA forward with identical (o, lse) semantics to the kernel.
 
     Used when auto-selection lands off-TPU: the Pallas interpreter is slow
@@ -114,9 +121,11 @@ def _fwd_reference(q, k, v, scale: float, causal: bool):
         "bqd,bkd->bqk", q.astype(jnp.float32) * scale, k.astype(jnp.float32),
         preferred_element_type=jnp.float32,
     )
+    pos = jnp.arange(seq_len)
     if causal:
-        pos = jnp.arange(seq_len)
         s = jnp.where((pos[:, None] >= pos[None, :])[None], s, NEG_INF)
+    if window > 0:
+        s = jnp.where((pos[:, None] - pos[None, :] < window)[None], s, NEG_INF)
     m = jnp.max(s, axis=-1, keepdims=True)
     p = jnp.exp(s - m)
     l = jnp.sum(p, axis=-1, keepdims=True)
@@ -149,11 +158,13 @@ def _expand_kv(x, h: int, hkv: int):
 
 
 def _flash_fwd(q, k, v, scale: float, causal: bool, block_q: int, block_k: int,
-               interpret: Optional[bool], h: int = 1, hkv: int = 1):
+               interpret: Optional[bool], h: int = 1, hkv: int = 1,
+               window: int = 0):
     """q: [B*H, L, D]; k,v: [B*Hkv, L, D] -> (o [B*H, L, D], lse [B*H, L])."""
     if interpret is None and _use_interpret():
         return _fwd_reference(
-            q, _expand_kv(k, h, hkv), _expand_kv(v, h, hkv), scale, causal
+            q, _expand_kv(k, h, hkv), _expand_kv(v, h, hkv), scale, causal,
+            window,
         )
     bh, seq_len, d = q.shape
     qp = _pad_to(q, block_q, 1)
@@ -164,7 +175,7 @@ def _flash_fwd(q, k, v, scale: float, causal: bool, block_q: int, block_k: int,
 
     kern = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_k=block_k,
-        seq_len=seq_len,
+        seq_len=seq_len, window=window,
     )
     # under shard_map (check_vma) outputs must declare how they vary across
     # mesh axes: they vary exactly as the union of the inputs
@@ -194,7 +205,8 @@ def _flash_fwd(q, k, v, scale: float, causal: bool, block_q: int, block_k: int,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-                   scale: float, causal: bool, block_k: int, seq_len: int):
+                   scale: float, causal: bool, block_k: int, seq_len: int,
+                   window: int):
     """dq for one q block: iterate k/v blocks, accumulate ds @ k.
 
     q_ref/do_ref/dq_ref: [1, block_q, D]; k_ref/v_ref: [1, L_pad, D];
@@ -222,6 +234,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
         valid = k_pos < seq_len
         if causal:
             valid = jnp.logical_and(valid, q_pos >= k_pos)
+        if window > 0:
+            valid = jnp.logical_and(valid, q_pos - k_pos < window)
         p = jnp.where(valid, jnp.exp(s - lse), 0.0)    # [block_q, block_k]
         dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
@@ -230,14 +244,19 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     dq0 = jnp.zeros((block_q, d), jnp.float32)
     if causal:
         nk_needed = lax.min(nk, pl.cdiv((qi + 1) * block_q, block_k))
-        dq = lax.fori_loop(0, nk_needed, body, dq0)
+        start = (
+            lax.max(0, (qi * block_q - window + 1) // block_k)
+            if window > 0 else 0
+        )
+        dq = lax.fori_loop(start, nk_needed, body, dq0)
     else:
         dq = lax.fori_loop(0, nk, body, dq0)
     dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
 
 
 def _dkv_accum(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, ki: int, *,
-               scale: float, causal: bool, block_q: int, seq_len: int):
+               scale: float, causal: bool, block_q: int, seq_len: int,
+               window: int):
     """Shared dk/dv accumulation over all q blocks for one k/v block.
 
     k_ref/v_ref: [1, block_k, D]; q_ref/do_ref: [1, L_pad, D];
@@ -269,6 +288,8 @@ def _dkv_accum(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, ki: int, *,
         valid = jnp.logical_and(q_pos < seq_len, k_pos < seq_len)
         if causal:
             valid = jnp.logical_and(valid, q_pos >= k_pos)
+        if window > 0:
+            valid = jnp.logical_and(valid, q_pos - k_pos < window)
         p = jnp.where(valid, jnp.exp(s - lse_blk), 0.0)  # [block_q, block_k]
         dv = dv + jnp.dot(p.T, do_blk, preferred_element_type=jnp.float32)
         dp = jnp.dot(do_blk, v_blk.T, preferred_element_type=jnp.float32)
@@ -278,19 +299,25 @@ def _dkv_accum(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, ki: int, *,
 
     zeros = jnp.zeros((block_k, d), jnp.float32)
     if causal:
-        # q blocks strictly before this k block see none of it
+        # q blocks strictly before this k block see none of it; a sliding
+        # window also bounds how far past it they can sit
         start = (ki * block_k) // block_q
-        return lax.fori_loop(start, nq, body, (zeros, zeros))
+        end = (
+            lax.min(nq, pl.cdiv((ki + 1) * block_k + window - 1, block_q))
+            if window > 0 else nq
+        )
+        return lax.fori_loop(start, end, body, (zeros, zeros))
     return lax.fori_loop(0, nq, body, (zeros, zeros))
 
 
 def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, *, scale: float, causal: bool,
-                    block_q: int, seq_len: int):
+                    block_q: int, seq_len: int, window: int):
     """dk, dv for one k/v block (MHA: one q row per kv row)."""
     dk, dv = _dkv_accum(
         k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, pl.program_id(1),
         scale=scale, causal=causal, block_q=block_q, seq_len=seq_len,
+        window=window,
     )
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
@@ -298,7 +325,7 @@ def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_dkv_gqa_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
                         dk_ref, dv_ref, *, scale: float, causal: bool,
-                        block_q: int, seq_len: int):
+                        block_q: int, seq_len: int, window: int):
     """GQA dk/dv: grid (B*Hkv, nk, group), group FASTEST so the consecutive
     revisits of the same (kv row, k block) output accumulate the query-head
     group in VMEM.  The index maps select q row = base + g for grid step g;
@@ -307,6 +334,7 @@ def _bwd_dkv_gqa_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
     dk, dv = _dkv_accum(
         k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, pl.program_id(1),
         scale=scale, causal=causal, block_q=block_q, seq_len=seq_len,
+        window=window,
     )
 
     @pl.when(g == 0)
@@ -322,7 +350,7 @@ def _bwd_dkv_gqa_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_pallas(q, k, v, o, lse, g, scale: float, causal: bool,
                 block_q: int, block_k: int, interpret: bool, g_lse=None,
-                h: int = 1, hkv: int = 1):
+                h: int = 1, hkv: int = 1, window: int = 0):
     """Pallas flash backward: a dq kernel gridded over q blocks and a dk/dv
     kernel gridded over k/v blocks, both streaming the opposite operand from
     VMEM — no [L, L] matrix, fp32 accumulation, MXU matmuls throughout.
@@ -358,7 +386,7 @@ def _bwd_pallas(q, k, v, o, lse, g, scale: float, causal: bool,
     )
     dq_kern = functools.partial(
         _bwd_dq_kernel, scale=scale, causal=causal, block_k=block_k,
-        seq_len=seq_len,
+        seq_len=seq_len, window=window,
     )
     kv_spec = pl.BlockSpec((1, lk, d), lambda b, i: (_kv_row(b, h, hkv), 0, 0))
     dq = pl.pallas_call(
@@ -380,7 +408,7 @@ def _bwd_pallas(q, k, v, o, lse, g, scale: float, causal: bool,
     if group == 1:
         dkv_kern = functools.partial(
             _bwd_dkv_kernel, scale=scale, causal=causal, block_q=block_q,
-            seq_len=seq_len,
+            seq_len=seq_len, window=window,
         )
         dk, dv = pl.pallas_call(
             dkv_kern,
@@ -409,7 +437,7 @@ def _bwd_pallas(q, k, v, o, lse, g, scale: float, causal: bool,
 
         dkv_kern = functools.partial(
             _bwd_dkv_gqa_kernel, scale=scale, causal=causal, block_q=block_q,
-            seq_len=seq_len,
+            seq_len=seq_len, window=window,
         )
         dk, dv = pl.pallas_call(
             dkv_kern,
@@ -438,7 +466,7 @@ def _bwd_pallas(q, k, v, o, lse, g, scale: float, causal: bool,
 
 
 def _bwd_blocked(q, k, v, o, lse, g, scale: float, causal: bool,
-                 block_k: int, g_lse=None):
+                 block_k: int, g_lse=None, window: int = 0):
     """Rematerializing backward in XLA: scan over k/v blocks, never holding
     the full [L, L] probability matrix (standard flash backward formula).
 
@@ -469,6 +497,10 @@ def _bwd_blocked(q, k, v, o, lse, g, scale: float, causal: bool,
         valid = (k_pos < seq_len)[None, :]
         if causal:
             valid = jnp.logical_and(valid, q_pos[:, None] >= k_pos[None, :])
+        if window > 0:
+            valid = jnp.logical_and(
+                valid, q_pos[:, None] - k_pos[None, :] < window
+            )
         p = jnp.where(valid[None], jnp.exp(s - lse[:, :, None]), 0.0)
         dv = jnp.einsum("bqk,bqd->bkd", p, gf)
         dp = jnp.einsum("bqd,bkd->bqk", gf, vf)
@@ -490,23 +522,24 @@ def _bwd_blocked(q, k, v, o, lse, g, scale: float, causal: bool,
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9)
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10)
 )
-def _flash_bhld(q, k, v, scale, causal, block_q, block_k, interpret, h, hkv):
+def _flash_bhld(q, k, v, scale, causal, block_q, block_k, interpret, h, hkv,
+                window):
     o, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
-                      h, hkv)
+                      h, hkv, window)
     return o
 
 
 def _flash_bhld_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
-                    h, hkv):
+                    h, hkv, window):
     o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
-                        h, hkv)
+                        h, hkv, window)
     return o, (q, k, v, o, lse)
 
 
 def _dispatch_bwd(q, k, v, o, lse, g, scale, causal, block_q, block_k,
-                  interpret, g_lse=None, h=1, hkv=1):
+                  interpret, g_lse=None, h=1, hkv=1, window=0):
     """Pallas backward wherever the forward ran the kernel (TPU, or explicit
     interpret=True in tests); the XLA blocked backward off-TPU and under
     KFT_FLASH_BWD=xla (the A/B switch the attention bench flips)."""
@@ -520,13 +553,13 @@ def _dispatch_bwd(q, k, v, o, lse, g, scale, causal, block_q, block_k,
         return _bwd_pallas(
             q, k, v, o, lse, g, scale, causal, block_q, block_k,
             interpret=_use_interpret() if interpret is None else interpret,
-            g_lse=g_lse, h=h, hkv=hkv,
+            g_lse=g_lse, h=h, hkv=hkv, window=window,
         )
     if h != hkv:
         # XLA path: expand kv over the group, then reduce dk/dv back
         dq, dk, dv = _bwd_blocked(
             q, _expand_kv(k, h, hkv), _expand_kv(v, h, hkv), o, lse, g,
-            scale, causal, block_k, g_lse=g_lse,
+            scale, causal, block_k, g_lse=g_lse, window=window,
         )
         group = h // hkv
         bh, l, d = dk.shape
@@ -537,41 +570,42 @@ def _dispatch_bwd(q, k, v, o, lse, g, scale, causal, block_q, block_k,
         ).sum(2).reshape(b * hkv, l, d)
         return dq, reduce(dk).astype(k.dtype), reduce(dv).astype(v.dtype)
     return _bwd_blocked(q, k, v, o, lse, g, scale, causal, block_k,
-                        g_lse=g_lse)
+                        g_lse=g_lse, window=window)
 
 
 def _flash_bhld_bwd(scale, causal, block_q, block_k, interpret, h, hkv,
-                    res, g):
+                    window, res, g):
     q, k, v, o, lse = res
     return _dispatch_bwd(q, k, v, o, lse, g, scale, causal, block_q, block_k,
-                         interpret, h=h, hkv=hkv)
+                         interpret, h=h, hkv=hkv, window=window)
 
 
 _flash_bhld.defvjp(_flash_bhld_fwd, _flash_bhld_bwd)
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9)
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10)
 )
 def _flash_bhld_lse(q, k, v, scale, causal, block_q, block_k, interpret,
-                    h, hkv):
+                    h, hkv, window):
     return _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
-                      h, hkv)
+                      h, hkv, window)
 
 
 def _flash_bhld_lse_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
-                        h, hkv):
+                        h, hkv, window):
     o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
-                        h, hkv)
+                        h, hkv, window)
     return (o, lse), (q, k, v, o, lse)
 
 
 def _flash_bhld_lse_bwd(scale, causal, block_q, block_k, interpret, h, hkv,
-                        res, g):
+                        window, res, g):
     q, k, v, o, lse = res
     g_o, g_lse = g
     return _dispatch_bwd(q, k, v, o, lse, g_o, scale, causal, block_q,
-                         block_k, interpret, g_lse=g_lse, h=h, hkv=hkv)
+                         block_k, interpret, g_lse=g_lse, h=h, hkv=hkv,
+                         window=window)
 
 
 _flash_bhld_lse.defvjp(_flash_bhld_lse_fwd, _flash_bhld_lse_bwd)
@@ -586,6 +620,7 @@ def flash_attention(
     block_q: int = 128,
     block_k: int = 128,
     interpret: Optional[bool] = None,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Fused attention, [B, L, H, D] -> [B, L, H, D] in q's dtype.
 
@@ -593,10 +628,16 @@ def flash_attention(
     softmax(qk^T)v.  `interpret=None` auto-selects interpreter mode off-TPU.
     GQA/MQA: k/v may carry Hkv < H heads (H % Hkv == 0) — the kernels
     index-map the shared kv heads instead of materializing repeats.
+    `window` (sliding-window / local attention, requires causal): each
+    query attends only the last `window` positions; masked AND skipped at
+    block granularity, so compute is O(L*window) not O(L^2).
     """
     b, l, h, d = q.shape
     hkv = k.shape[2]
     assert h % hkv == 0 and v.shape[2] == hkv, (q.shape, k.shape, v.shape)
+    w = int(window) if window else 0
+    assert w >= 0, "window must be positive (None/0 = unlimited)"
+    assert w == 0 or causal, "sliding window requires causal attention"
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
     bq = min(block_q, max(8, l))
     bk = min(block_k, max(8, l))
@@ -607,7 +648,7 @@ def flash_attention(
 
     o = _flash_bhld(
         to_bhld(q), to_bhld(k), to_bhld(v), scale, causal, bq, bk, interpret,
-        h, hkv,
+        h, hkv, w,
     )
     return o.reshape(b, h, l, d).transpose(0, 2, 1, 3)
 
@@ -621,6 +662,7 @@ def flash_attention_with_lse(
     block_q: int = 128,
     block_k: int = 128,
     interpret: Optional[bool] = None,
+    window: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Fused attention also returning the log-sum-exp of each softmax row.
 
@@ -633,6 +675,9 @@ def flash_attention_with_lse(
     b, l, h, d = q.shape
     hkv = k.shape[2]
     assert h % hkv == 0 and v.shape[2] == hkv, (q.shape, k.shape, v.shape)
+    w = int(window) if window else 0
+    assert w >= 0, "window must be positive (None/0 = unlimited)"
+    assert w == 0 or causal, "sliding window requires causal attention"
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
     bq = min(block_q, max(8, l))
     bk = min(block_k, max(8, l))
@@ -643,7 +688,7 @@ def flash_attention_with_lse(
 
     o, lse = _flash_bhld_lse(
         to_bhld(q), to_bhld(k), to_bhld(v), scale, causal, bq, bk, interpret,
-        h, hkv,
+        h, hkv, w,
     )
     o = o.reshape(b, h, l, d).transpose(0, 2, 1, 3)
     return o, lse.reshape(b, h, l)

@@ -188,13 +188,24 @@ def _ring_attention_flash(q, k, v, axis_name, causal, scale):
     return o.astype(q.dtype)
 
 
-def full_attention(q, k, v, causal: bool = True, scale: Optional[float] = None):
-    """Single-device reference implementation (for tests and small models)."""
+def full_attention(q, k, v, causal: bool = True, scale: Optional[float] = None,
+                   window: Optional[int] = None):
+    """Single-device reference implementation (for tests and small models).
+
+    `window` (requires causal): sliding-window mask — each query sees only
+    the last `window` positions (masked here; the flash kernels also SKIP
+    the dead blocks)."""
     B, L, H, D = q.shape
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    pos = jnp.arange(L)
     if causal:
-        mask = jnp.tril(jnp.ones((L, L), bool))
-        s = jnp.where(mask[None, None], s, NEG_INF)
+        s = jnp.where((pos[:, None] >= pos[None, :])[None, None], s, NEG_INF)
+    if window:
+        assert window > 0, "window must be positive (None/0 = unlimited)"
+        assert causal, "sliding window requires causal attention"
+        s = jnp.where(
+            (pos[:, None] - pos[None, :] < window)[None, None], s, NEG_INF
+        )
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)

@@ -253,3 +253,55 @@ def test_gqa_xla_bwd_matches(monkeypatch):
     g_xla = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
     for a, b_ in zip(g_pallas, g_xla):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=1e-5)
+
+
+def _windowed_reference(q, k, v, window):
+    """Masked full attention: causal AND within the last `window` keys."""
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    pos = jnp.arange(q.shape[1])
+    m = (pos[:, None] >= pos[None, :]) & (pos[:, None] - pos[None, :] < window)
+    s = jnp.where(m[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+@pytest.mark.parametrize("l,window", [(96, 32), (100, 17), (128, 64)])
+def test_sliding_window_matches_reference(l, window):
+    q, k, v = _rand(2, l, 2, 16, seed=31)
+    out = flash_attention(q, k, v, causal=True, window=window,
+                          block_q=32, block_k=32, interpret=True)
+    ref = _windowed_reference(q, k, v, window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("hkv", [1, 4])
+def test_sliding_window_gradients(hkv):
+    """Windowed grads (dq block-start skip + dkv block-end skip) vs the
+    masked reference, incl. GQA."""
+    b, l, h, d, w = 1, 96, 4, 16, 40
+    ks = jax.random.split(jax.random.PRNGKey(33), 3)
+    q = jax.random.normal(ks[0], (b, l, h, d))
+    k = jax.random.normal(ks[1], (b, l, hkv, d))
+    v = jax.random.normal(ks[2], (b, l, hkv, d))
+
+    def f_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True, window=w,
+                                       block_q=32, block_k=32,
+                                       interpret=True) ** 2)
+
+    def f_ref(q, k, v):
+        kk = jnp.repeat(k, h // hkv, 2) if hkv != h else k
+        vv = jnp.repeat(v, h // hkv, 2) if hkv != h else v
+        return jnp.sum(_windowed_reference(q, kk, vv, w) ** 2)
+
+    g_flash = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=5e-4)
+
+
+def test_window_requires_causal():
+    q, k, v = _rand(1, 32, 1, 16)
+    with pytest.raises(AssertionError, match="causal"):
+        flash_attention(q, k, v, causal=False, window=8, interpret=True)

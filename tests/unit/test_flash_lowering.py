@@ -53,3 +53,16 @@ def test_unpadded_length_lowers_for_tpu():
         return flash_attention(q, k, v, causal=False, interpret=False)
 
     _export_ok(f, q, q, q)
+
+
+def test_sliding_window_lowers_for_tpu():
+    """Windowed kernels (block-skip loop bounds) lower to Mosaic."""
+    q = jnp.zeros((1, 1024, 4, 64), jnp.bfloat16)
+
+    def loss(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, causal=True, window=256,
+                            interpret=False).astype(jnp.float32) ** 2
+        )
+
+    _export_ok(jax.grad(loss, argnums=(0, 1, 2)), q, q, q)

@@ -268,3 +268,30 @@ def test_tied_embeddings():
 
     out = generate(cfg, params, jnp.asarray(tokens[:, :4]), 3)
     assert out.shape == (2, 7)
+
+
+def test_windowed_model_train_and_decode_agree():
+    """window=8: training forward == prefill+decode logits position by
+    position (the cache mask honors the window)."""
+    import dataclasses
+
+    cfg = _base(rope=True, window=8, attention="full", max_len=48)
+    tokens = np.random.RandomState(3).randint(0, 64, (1, 20)).astype(np.int32)
+    model = TransformerLM(cfg)
+    params = nn.meta.unbox(model.init(jax.random.PRNGKey(0), tokens)["params"])
+    ref = np.asarray(model.apply({"params": params}, tokens))
+
+    dmodel = TransformerLM(dataclasses.replace(cfg, decode=True))
+    cache = dmodel.init(jax.random.PRNGKey(0), tokens[:, :1])["cache"]
+    o, st = dmodel.apply(
+        {"params": params, "cache": cache}, tokens[:, :10], mutable=["cache"]
+    )
+    np.testing.assert_allclose(np.asarray(o), ref[:, :10], atol=2e-4)
+    cache = st["cache"]
+    for t in range(10, 20):
+        o, st = dmodel.apply(
+            {"params": params, "cache": cache}, tokens[:, t : t + 1],
+            mutable=["cache"],
+        )
+        cache = st["cache"]
+        np.testing.assert_allclose(np.asarray(o[:, 0]), ref[:, t], atol=2e-4)
