@@ -43,6 +43,9 @@ def main() -> int:
     ap.add_argument("--vocab", type=int, default=512)
     ap.add_argument("--ckpt-dir", default="", help="enable checkpointing")
     ap.add_argument("--ckpt-every", type=int, default=10)
+    ap.add_argument("--generate", type=int, default=0, metavar="N",
+                    help="after training, greedily generate N tokens from a "
+                         "training-distribution prompt (KV-cache decode)")
     ap.add_argument("--data", default="synthetic", choices=["synthetic", "files"],
                     help="files = stream token chunks via the C++ loader")
     ap.add_argument("--data-dir", default="/tmp/kft_gpt_tokens",
@@ -159,9 +162,28 @@ def main() -> int:
             )
             print(f"# resumed from step {start_step}")
 
+    def maybe_generate():
+        if args.generate <= 0:
+            return
+        from kungfu_tpu.models.transformer import generate
+
+        prompt = jnp.asarray(next(it)[:1, :8])
+        # KV cache holds max_len positions; clamp instead of crashing
+        n = min(args.generate, cfg.max_len - int(prompt.shape[1]))
+        if n < args.generate:
+            print(f"# --generate clamped to {n} (max_len {cfg.max_len})")
+        # decode runs single-device: pull one replica's params off the mesh
+        host_params = jax.tree.map(
+            lambda x: jax.device_put(np.asarray(x)), state.params
+        )
+        out = np.asarray(generate(cfg, host_params, prompt, n))
+        print(f"# prompt    {np.asarray(prompt)[0].tolist()}")
+        print(f"# generated {out[0, prompt.shape[1]:].tolist()}")
+
     if start_step >= args.steps:
         print(f"# checkpoint already at step {start_step} >= --steps "
               f"{args.steps}; nothing to train")
+        maybe_generate()  # sampling from a finished run is still useful
         return 0
     t0 = time.perf_counter()
     loss = float("nan")
@@ -180,6 +202,7 @@ def main() -> int:
         manager.wait()
     dt = time.perf_counter() - t0
     tok_s = (args.steps - start_step) * args.batch * args.seq_len / dt
+    maybe_generate()
     print(
         f"RESULT: example=gpt_train loss={loss:.4f} steps={args.steps} "
         f"mesh={dict(mesh.shape)} tokens_per_sec={tok_s:.0f}",
