@@ -170,11 +170,17 @@ def main() -> int:
         prompt = jnp.asarray(next(it)[:1, :8])
         # KV cache holds max_len positions; clamp instead of crashing
         n = min(args.generate, cfg.max_len - int(prompt.shape[1]))
+        if n <= 0:
+            print(f"# --generate skipped: no cache room past the prompt "
+                  f"(max_len {cfg.max_len})")
+            return
         if n < args.generate:
             print(f"# --generate clamped to {n} (max_len {cfg.max_len})")
-        # decode runs single-device: pull one replica's params off the mesh
+        # decode runs single-device: gather one replica's params off the
+        # mesh (multi-controller-safe)
         host_params = jax.tree.map(
-            lambda x: jax.device_put(np.asarray(x)), state.params
+            lambda x: jax.device_put(np.asarray(x)),
+            trainer.eval_params(state),
         )
         out = np.asarray(generate(cfg, host_params, prompt, n))
         print(f"# prompt    {np.asarray(prompt)[0].tolist()}")

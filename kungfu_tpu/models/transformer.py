@@ -235,10 +235,11 @@ class Attention(nn.Module):
             and cfg.sp_axis in cfg.mesh.axis_names
         ):
             names = cfg.mesh.axis_names
-            # keep batch on dp and heads on tp inside the manual region —
-            # omitting them would all-gather those dims onto every device
+            # keep batch on dp (and fsdp) and heads on tp inside the manual
+            # region — omitting them would all-gather those dims onto every
+            # device
             spec = P(
-                "dp" if "dp" in names else None,
+                tuple(a for a in ("dp", "fsdp") if a in names) or None,
                 cfg.sp_axis,
                 "tp" if "tp" in names else None,
                 None,

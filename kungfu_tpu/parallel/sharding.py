@@ -31,9 +31,27 @@ DEFAULT_RULES: Tuple[Tuple[str, Optional[str]], ...] = (
 
 
 def rules_for_mesh(mesh: Mesh, rules=DEFAULT_RULES) -> Tuple[Tuple[str, Optional[str]], ...]:
-    """Drop rules whose mesh axis does not exist (e.g. no 'ep' axis)."""
+    """Drop rules whose mesh axis does not exist (e.g. no 'ep' axis).
+
+    An `fsdp` mesh axis activates GSPMD-style fully-sharded data
+    parallelism inside MeshTrainer: parameter *embed* dims shard over
+    fsdp (XLA inserts the per-layer all-gathers — ZeRO-3 semantics by
+    sharding propagation) and the batch shards over BOTH dp and fsdp
+    (fsdp groups are data-parallel).  This is the rules-table composition
+    path; chunk-flattened FSDPTrainer remains the alternative layout.
+    """
     names = set(mesh.axis_names)
-    return tuple((l, m if (m in names) else None) for l, m in rules)
+    fsdp_defaults = rules is DEFAULT_RULES and "fsdp" in names
+    out = []
+    for l, m in rules:
+        if l == "batch" and fsdp_defaults:
+            axes = tuple(a for a in ("dp", "fsdp") if a in names)
+            out.append((l, axes if len(axes) > 1 else axes[0]))
+        elif l == "embed" and fsdp_defaults:
+            out.append((l, "fsdp"))
+        else:
+            out.append((l, m if (m in names) else None))
+    return tuple(out)
 
 
 def logical_constraint(x, names: Sequence[Optional[str]], mesh: Optional[Mesh] = None, rules=None):

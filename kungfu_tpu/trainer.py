@@ -46,12 +46,14 @@ class MeshTrainer:
       loss_fn: (model, params, batch) -> scalar loss on the GLOBAL batch
         (per-example mean; XLA handles the cross-shard reduction).
       tx: optax transform (plain optimizers; see module docstring).
-      mesh: the device mesh (dp/sp/tp/ep axes; parameter sharding over an
-        fsdp axis is FSDPTrainer's job — map logical axes to "fsdp" via
-        custom `rules` + `batch_axes` here only if you know the layout).
+      mesh: the device mesh (dp/sp/tp/ep/fsdp axes).  An `fsdp` axis
+        activates GSPMD fully-sharded parameters via the default rules
+        (embed dims shard over fsdp, batch over dp AND fsdp) — the
+        rules-table composition path; chunk-flattened FSDPTrainer remains
+        the alternative layout.
       rules: logical->mesh axis rules; default derives from the mesh.
-      batch_axes: mesh axes the batch dim shards over (default: "dp" if
-        present).
+      batch_axes: mesh axes the batch dim shards over (default: the axes
+        the rules map "batch" to — dp, plus fsdp when present).
     """
 
     def __init__(
@@ -70,14 +72,19 @@ class MeshTrainer:
         self.mesh = mesh if mesh is not None else make_mesh(dp=-1)
         self.rules = rules if rules is not None else rules_for_mesh(self.mesh)
         names = self.mesh.axis_names
-        # default batch axes: only those the DEFAULT_RULES actually map the
-        # "batch" logical axis to — claiming more (e.g. fsdp) would shard
-        # the batch on placement and have the model constraint undo it
-        self.batch_axes = (
-            batch_axes
-            if batch_axes is not None
-            else tuple(a for a in ("dp",) if a in names)
-        )
+        # default batch axes follow the rules' "batch" mapping (dp, plus
+        # fsdp when the mesh has one): placement matches the in-model
+        # constraint, so no per-step resharding — and multi-controller
+        # local batches assemble under the true global sharding
+        if batch_axes is not None:
+            self.batch_axes = batch_axes
+        else:
+            mapped = dict(self.rules).get("batch")
+            if mapped is None:
+                mapped = ()
+            elif isinstance(mapped, str):
+                mapped = (mapped,)
+            self.batch_axes = tuple(a for a in mapped if a in names)
         self._donate = donate
         self._shardings = None
         self._step_fn = None
