@@ -324,7 +324,7 @@ class Block(nn.Module):
             x = x + MoEMLP(cfg, name="moe")(ln(name="ln2")(x))
         else:
             x = x + MLP(cfg, name="mlp")(ln(name="ln2")(x))
-        return flax_spmd.with_logical_constraint(x, ("batch", "seq", "embed"))
+        return flax_spmd.with_logical_constraint(x, ("batch", "seq", "act_embed"))
 
 
 class TransformerLM(nn.Module):
@@ -349,7 +349,7 @@ class TransformerLM(nn.Module):
                 jnp.float32,
             )
             x = x + pos[None, :L].astype(cfg.dtype)
-        x = flax_spmd.with_logical_constraint(x, ("batch", "seq", "embed"))
+        x = flax_spmd.with_logical_constraint(x, ("batch", "seq", "act_embed"))
         for i in range(cfg.n_layers):
             use_moe = cfg.n_experts > 0 and (i % cfg.moe_every == cfg.moe_every - 1)
             x = Block(cfg, use_moe=use_moe, name=f"block_{i}")(x)

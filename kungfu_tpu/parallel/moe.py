@@ -54,7 +54,7 @@ class MoEMLP(nn.Module):
             * jax.nn.one_hot(pos, capacity, dtype=x.dtype)[:, None, :]
         )
         expert_in = jnp.einsum("td,tec->ecd", flat, dispatch)  # [E, C, Dm]
-        expert_in = flax_spmd.with_logical_constraint(expert_in, ("expert", None, "embed"))
+        expert_in = flax_spmd.with_logical_constraint(expert_in, ("expert", None, "act_embed"))
 
         # per-expert FFN, experts sharded over the expert axis
         w_in = self.param(

@@ -20,7 +20,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 DEFAULT_RULES: Tuple[Tuple[str, Optional[str]], ...] = (
     ("batch", "dp"),
     ("seq", "sp"),
+    # "embed" names PARAMETER embed dims (fsdp shards them); activations
+    # use "act_embed" so the fsdp rule never forces activation resharding
     ("embed", None),
+    ("act_embed", None),
     ("heads", "tp"),
     ("kv", None),
     ("mlp", "tp"),
