@@ -449,12 +449,23 @@ def _generate_compiled(dcfg: TransformerConfig, b: int, prompt_len: int,
     return run
 
 
-def lm_loss(logits: jax.Array, tokens: jax.Array) -> jax.Array:
-    """Next-token cross entropy, mean over all positions."""
+def lm_loss(
+    logits: jax.Array, tokens: jax.Array, z_loss: float = 0.0
+) -> jax.Array:
+    """Next-token cross entropy, mean over all positions.
+
+    `z_loss`: PaLM-style stabilizer `z_loss * mean(log Z^2)` keeping the
+    softmax normalizer near 1 (typ. 1e-4) — prevents logit drift in long
+    bf16 pretraining runs.
+    """
     targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32))
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+    lg = logits[:, :-1].astype(jnp.float32)
+    log_z = jax.scipy.special.logsumexp(lg, axis=-1)
+    ll = jnp.take_along_axis(lg, targets[..., None], axis=-1)[..., 0] - log_z
+    loss = -jnp.mean(ll)
+    if z_loss:
+        loss = loss + z_loss * jnp.mean(log_z ** 2)
+    return loss
 
 
 def lm_loss_with_aux(

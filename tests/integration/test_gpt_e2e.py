@@ -1,6 +1,9 @@
-"""End-to-end LLM showcase under the launcher: train (dp x sp), checkpoint,
-kill, resume, stream from the C++ file loader, and generate — the full
-switch-from-the-reference story in one test."""
+"""End-to-end LLM showcase: two gpt_train.py processes — dp x sp training
+streamed from the C++ file loader, async checkpoint in the first run, a
+clean restart that restores and keeps improving, and KV-cache generation.
+(Crash-mid-save recovery and the launcher env contract are covered
+elsewhere: tests/unit/test_checkpoint.py kill-and-resume drills and
+tests/integration/test_launcher.py.)"""
 import json
 import os
 import subprocess

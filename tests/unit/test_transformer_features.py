@@ -295,3 +295,26 @@ def test_windowed_model_train_and_decode_agree():
         )
         cache = st["cache"]
         np.testing.assert_allclose(np.asarray(o[:, 0]), ref[:, t], atol=2e-4)
+
+
+def test_lm_loss_z_loss():
+    """z_loss=0 is the plain cross entropy; z_loss>0 adds mean(logZ^2) and
+    its gradient pulls the softmax normalizer toward 1."""
+    logits = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 16)) * 4.0
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, 16)
+    base = float(lm_loss(logits, tokens))
+    withz = float(lm_loss(logits, tokens, z_loss=1e-2))
+    log_z = jax.scipy.special.logsumexp(
+        np.asarray(logits[:, :-1], np.float32), axis=-1
+    )
+    np.testing.assert_allclose(withz - base, 1e-2 * float((log_z ** 2).mean()),
+                               rtol=1e-5)
+    # a few steps of pure z-loss shrink the mean normalizer magnitude
+    f = lambda lg: lm_loss(lg, tokens, z_loss=1.0) - lm_loss(lg, tokens)
+    lg = logits
+    for _ in range(20):
+        lg = lg - 0.5 * jax.grad(f)(lg)
+    z0 = np.abs(log_z).mean()
+    z1 = np.abs(np.asarray(jax.scipy.special.logsumexp(
+        np.asarray(lg[:, :-1], np.float32), axis=-1))).mean()
+    assert z1 < z0
