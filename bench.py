@@ -70,6 +70,19 @@ def _peak_specs_per_chip():
     return (None, None), kind
 
 
+def _maybe_profile():
+    """Profiler capture of the timed region when KFT_BENCH_PROFILE=dir is
+    set (xprof/Perfetto-viewable) — substantiates the HBM roofline claim."""
+    prof_dir = os.environ.get("KFT_BENCH_PROFILE")
+    if prof_dir:
+        from kungfu_tpu.utils.trace import profile_to
+
+        return profile_to(prof_dir)
+    import contextlib
+
+    return contextlib.nullcontext()
+
+
 def _compiled_step_costs(trainer, state, batch):
     """(flops, bytes_accessed) of one compiled step from XLA cost analysis."""
     try:
@@ -136,10 +149,11 @@ def run_config(batch_per_chip: int, steps: int, flops: bool):
     # compile + warm up the n-step scan program, then time a second dispatch
     state, metrics = trainer.train_steps(state, batch, n=steps)
     sync(metrics)
-    t0 = time.perf_counter()
-    state, metrics = trainer.train_steps(state, batch, n=steps)
-    sync(metrics)
-    dt = time.perf_counter() - t0
+    with _maybe_profile():
+        t0 = time.perf_counter()
+        state, metrics = trainer.train_steps(state, batch, n=steps)
+        sync(metrics)
+        dt = time.perf_counter() - t0
 
     img_per_sec = steps * global_batch / dt
     return {
@@ -257,11 +271,14 @@ def run_files_train(batch_per_chip: int, steps: int):
     try:
         state, m = trainer.train_step(state, trainer.shard_batch(next(loader)))
         float(np.asarray(m["loss"]))  # compile + sync
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            state, m = trainer.train_step(state, trainer.shard_batch(next(loader)))
-        float(np.asarray(m["loss"]))
-        dt = time.perf_counter() - t0
+        with _maybe_profile():
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                state, m = trainer.train_step(
+                    state, trainer.shard_batch(next(loader))
+                )
+            float(np.asarray(m["loss"]))
+            dt = time.perf_counter() - t0
     finally:
         loader.close()
     return {
@@ -329,6 +346,12 @@ def _install_deadline(seconds: float):
 
 
 def main():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    # honor an explicit KFT_PLATFORM/JAX_PLATFORMS=cpu request (harness
+    # testing off-chip); on the TPU tunnel nothing is set and axon wins
+    from kungfu_tpu.env import apply_platform_override
+
+    apply_platform_override()
     deadline = _install_deadline(float(os.environ.get("KFT_BENCH_DEADLINE", "2400")))
     steps = int(os.environ.get("KFT_BENCH_STEPS", "20"))
     sweep_env = os.environ.get("KFT_BENCH_BATCH")
@@ -340,7 +363,6 @@ def main():
         # bytes), so probe below 128 too
         sweep = [64, 128, 256]
 
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
     files_mode = os.environ.get("KFT_BENCH_DATA") == "files"
     results = []

@@ -469,12 +469,13 @@ def lm_loss(
 
 
 def lm_loss_with_aux(
-    model: TransformerLM, params, tokens: jax.Array, aux_weight: float = 0.01
+    model: TransformerLM, params, tokens: jax.Array, aux_weight: float = 0.01,
+    z_loss: float = 0.0,
 ) -> jax.Array:
     """LM loss + Switch load-balancing auxiliary loss (required for MoE
     configs — without it the router collapses onto one expert)."""
     logits, state = model.apply({"params": params}, tokens, mutable=["intermediates"])
-    loss = lm_loss(logits, tokens)
+    loss = lm_loss(logits, tokens, z_loss=z_loss)
     aux = jnp.zeros((), jnp.float32)
     for path, leaves in _iter_sown(state.get("intermediates", {})):
         if path.endswith("moe_aux_loss"):
