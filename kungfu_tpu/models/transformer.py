@@ -449,6 +449,14 @@ def _generate_compiled(dcfg: TransformerConfig, b: int, prompt_len: int,
     return run
 
 
+def _token_ll(logits: jax.Array, targets: jax.Array):
+    """Per-token log-likelihood (fp32) and the log normalizer log Z."""
+    lg = logits.astype(jnp.float32)
+    log_z = jax.scipy.special.logsumexp(lg, axis=-1)
+    ll = jnp.take_along_axis(lg, targets[..., None], axis=-1)[..., 0] - log_z
+    return ll, log_z
+
+
 def lm_loss(
     logits: jax.Array, tokens: jax.Array, z_loss: float = 0.0
 ) -> jax.Array:
@@ -458,14 +466,46 @@ def lm_loss(
     softmax normalizer near 1 (typ. 1e-4) — prevents logit drift in long
     bf16 pretraining runs.
     """
-    targets = tokens[:, 1:]
-    lg = logits[:, :-1].astype(jnp.float32)
-    log_z = jax.scipy.special.logsumexp(lg, axis=-1)
-    ll = jnp.take_along_axis(lg, targets[..., None], axis=-1)[..., 0] - log_z
+    ll, log_z = _token_ll(logits[:, :-1], tokens[:, 1:])
     loss = -jnp.mean(ll)
     if z_loss:
         loss = loss + z_loss * jnp.mean(log_z ** 2)
     return loss
+
+
+def mlm_loss(
+    logits: jax.Array, targets: jax.Array, mask: jax.Array,
+    z_loss: float = 0.0,
+) -> jax.Array:
+    """Masked-LM (BERT-style) cross entropy: mean over MASKED positions.
+
+    `targets` are the ORIGINAL token ids, `mask` is 1 where the input was
+    corrupted (the model sees the corrupted tokens; the loss reads only the
+    masked slots).  Use with a bidirectional config (causal=False).
+    """
+    ll, log_z = _token_ll(logits, targets)
+    m = mask.astype(jnp.float32)
+    denom = jnp.maximum(m.sum(), 1.0)
+    loss = -(ll * m).sum() / denom
+    if z_loss:
+        loss = loss + z_loss * ((log_z ** 2) * m).sum() / denom
+    return loss
+
+
+def mlm_corrupt(
+    rng: jax.Array, tokens: jax.Array, vocab_size: int, mask_id: int,
+    mask_rate: float = 0.15,
+) -> Tuple[jax.Array, jax.Array]:
+    """BERT's 80/10/10 corruption: select `mask_rate` of positions; of those
+    80% -> mask_id, 10% -> random token, 10% unchanged.  Returns
+    (corrupted_tokens, selected_mask)."""
+    r_sel, r_kind, r_tok = jax.random.split(rng, 3)
+    sel = jax.random.uniform(r_sel, tokens.shape) < mask_rate
+    kind = jax.random.uniform(r_kind, tokens.shape)
+    rand_tok = jax.random.randint(r_tok, tokens.shape, 0, vocab_size)
+    corrupted = jnp.where(kind < 0.8, mask_id,
+                          jnp.where(kind < 0.9, rand_tok, tokens))
+    return jnp.where(sel, corrupted, tokens).astype(tokens.dtype), sel
 
 
 def lm_loss_with_aux(

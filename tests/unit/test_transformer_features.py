@@ -318,3 +318,77 @@ def test_lm_loss_z_loss():
     z1 = np.abs(np.asarray(jax.scipy.special.logsumexp(
         np.asarray(lg[:, :-1], np.float32), axis=-1))).mean()
     assert z1 < z0
+
+
+class TestMLM:
+    def test_mlm_loss_reads_only_masked_positions(self):
+        logits = jax.random.normal(jax.random.PRNGKey(0), (2, 6, 16))
+        targets = jax.random.randint(jax.random.PRNGKey(1), (2, 6), 0, 16)
+        from kungfu_tpu.models.transformer import mlm_loss
+
+        mask = jnp.zeros((2, 6)).at[:, 2].set(1)
+        l1 = float(mlm_loss(logits, targets, mask))
+        # perturb one vocab entry at an UNMASKED position: loss must not move
+        logits2 = logits.at[:, 4, 0].add(3.0)
+        assert abs(float(mlm_loss(logits2, targets, mask)) - l1) < 1e-6
+        # same perturbation at the masked position: loss moves
+        logits3 = logits.at[:, 2, 0].add(3.0)
+        assert abs(float(mlm_loss(logits3, targets, mask)) - l1) > 1e-3
+        # all-zero mask is safe (denominator clamps)
+        assert np.isfinite(float(mlm_loss(logits, targets, jnp.zeros((2, 6)))))
+
+    def test_mlm_corrupt_stats(self):
+        from kungfu_tpu.models.transformer import mlm_corrupt
+
+        toks = jax.random.randint(jax.random.PRNGKey(0), (64, 128), 0, 100)
+        out, sel = mlm_corrupt(jax.random.PRNGKey(1), toks, vocab_size=100,
+                               mask_id=103, mask_rate=0.15)
+        sel = np.asarray(sel)
+        rate = sel.mean()
+        assert 0.10 < rate < 0.20, rate
+        # unselected positions unchanged
+        np.testing.assert_array_equal(np.asarray(out)[~sel],
+                                      np.asarray(toks)[~sel])
+        # ~80% of selected positions carry the mask id
+        frac_masked = (np.asarray(out)[sel] == 103).mean()
+        assert 0.7 < frac_masked < 0.9, frac_masked
+
+    def test_bert_style_encoder_trains(self):
+        """Bidirectional encoder + MLM objective learns the ramp task."""
+        import optax
+
+        from kungfu_tpu.models.transformer import mlm_corrupt, mlm_loss
+
+        V, MASK = 64, 63
+        cfg = _base(vocab_size=V, causal=False, attention="full",
+                    d_model=64, d_ff=128, max_len=24)
+        model = TransformerLM(cfg)
+        rng = np.random.RandomState(0)
+
+        def batch(n=32):
+            start = rng.randint(0, V - 24 - 1, size=(n, 1))
+            return ((start + np.arange(24)) % (V - 1)).astype(np.int32)
+
+        params = nn.meta.unbox(model.init(jax.random.PRNGKey(0), batch(2))["params"])
+        tx = optax.adam(3e-3)
+        st = tx.init(params)
+
+        @jax.jit
+        def step(p, s, b, key):
+            corrupted, sel = mlm_corrupt(key, b, V, MASK)
+
+            def loss_fn(pp):
+                return mlm_loss(model.apply({"params": pp}, corrupted), b, sel)
+
+            l, g = jax.value_and_grad(loss_fn)(p)
+            u, s = tx.update(g, s, p)
+            return optax.apply_updates(p, u), s, l
+
+        key = jax.random.PRNGKey(0)
+        first = None
+        for i in range(150):
+            key, k = jax.random.split(key)
+            params, st, loss = step(params, st, jnp.asarray(batch()), k)
+            if first is None:
+                first = float(loss)
+        assert float(loss) < 0.5 * first, (first, float(loss))
