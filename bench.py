@@ -360,8 +360,10 @@ def main():
     else:
         # measured on v5e: throughput falls monotonically 128 -> 512 (the
         # step is HBM-bound, bigger batches just move more activation
-        # bytes), so probe below 128 too
-        sweep = [64, 128, 256]
+        # bytes), so probe below 128 too.  128 runs FIRST: it is the config
+        # with a warm server-side compile cache, so even a short tunnel
+        # window records at least one point
+        sweep = [128, 64, 256]
 
 
     files_mode = os.environ.get("KFT_BENCH_DATA") == "files"
