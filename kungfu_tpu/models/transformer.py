@@ -67,6 +67,10 @@ class TransformerConfig:
     window: int = 0
     # feed-forward flavor: "gelu" (2-matmul) or "swiglu" (gated, 3-matmul)
     ffn: str = "gelu"
+    # dropout on embeddings and each residual branch, active only when the
+    # model is applied with train=True and an rngs={"dropout": key}
+    # (MeshTrainer threads a per-step key to 4-arg loss functions)
+    dropout: float = 0.0
     # share the input embedding matrix with the lm_head (logits = x @ E^T)
     tie_embeddings: bool = False
     # MoE: every `moe_every`-th block uses experts (0 = dense model)
@@ -313,17 +317,18 @@ class Block(nn.Module):
     use_moe: bool = False
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, train: bool = False):
         cfg = self.cfg
         ln = partial(nn.LayerNorm, dtype=jnp.float32, use_bias=False,
                      scale_init=nn.with_logical_partitioning(nn.initializers.ones, ("embed",)))
-        x = x + Attention(cfg, name="attn")(ln(name="ln1")(x))
+        drop = nn.Dropout(cfg.dropout, deterministic=not (train and cfg.dropout > 0))
+        x = x + drop(Attention(cfg, name="attn")(ln(name="ln1")(x)))
         if self.use_moe:
             from ..parallel.moe import MoEMLP
 
-            x = x + MoEMLP(cfg, name="moe")(ln(name="ln2")(x))
+            x = x + drop(MoEMLP(cfg, name="moe")(ln(name="ln2")(x)))
         else:
-            x = x + MLP(cfg, name="mlp")(ln(name="ln2")(x))
+            x = x + drop(MLP(cfg, name="mlp")(ln(name="ln2")(x)))
         return flax_spmd.with_logical_constraint(x, ("batch", "seq", "act_embed"))
 
 
@@ -331,7 +336,7 @@ class TransformerLM(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, train: bool = False):
         cfg = self.cfg
         B, L = tokens.shape
         emb = nn.Embed(
@@ -349,10 +354,13 @@ class TransformerLM(nn.Module):
                 jnp.float32,
             )
             x = x + pos[None, :L].astype(cfg.dtype)
+        x = nn.Dropout(
+            cfg.dropout, deterministic=not (train and cfg.dropout > 0)
+        )(x)
         x = flax_spmd.with_logical_constraint(x, ("batch", "seq", "act_embed"))
         for i in range(cfg.n_layers):
             use_moe = cfg.n_experts > 0 and (i % cfg.moe_every == cfg.moe_every - 1)
-            x = Block(cfg, use_moe=use_moe, name=f"block_{i}")(x)
+            x = Block(cfg, use_moe=use_moe, name=f"block_{i}")(x, train=train)
         x = nn.LayerNorm(dtype=jnp.float32, use_bias=False, name="ln_f",
                          scale_init=nn.with_logical_partitioning(nn.initializers.ones, ("embed",)))(x)
         if cfg.tie_embeddings:

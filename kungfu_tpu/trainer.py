@@ -29,6 +29,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
 import jax
+import jax.numpy as jnp
 import optax
 import flax.linen as nn
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -44,7 +45,10 @@ class MeshTrainer:
     Args:
       model: flax module whose params carry logical-axis metadata.
       loss_fn: (model, params, batch) -> scalar loss on the GLOBAL batch
-        (per-example mean; XLA handles the cross-shard reduction).
+        (per-example mean; XLA handles the cross-shard reduction).  A loss
+        with a FOURTH required positional param — (model, params, batch,
+        rng) — receives a fresh per-step PRNG key (derived from the init
+        rng + step counter) for dropout / in-step data corruption.
       tx: optax transform (plain optimizers; see module docstring).
       mesh: the device mesh (dp/sp/tp/ep/fsdp axes).  An `fsdp` axis
         activates GSPMD fully-sharded parameters via the default rules
@@ -68,6 +72,20 @@ class MeshTrainer:
     ):
         self.model = model
         self.loss_fn = loss_fn
+        # a loss with FOUR required positional params (model, params, batch,
+        # rng) gets a per-step PRNG key — dropout, stochastic depth, MLM
+        # corruption inside the step.  Only required positionals count:
+        # optional kwargs (lm_loss_with_aux's aux_weight/z_loss) must not
+        # flip the calling convention.
+        import inspect
+
+        required = [
+            p for p in inspect.signature(loss_fn).parameters.values()
+            if p.default is inspect.Parameter.empty
+            and p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+        ]
+        self._loss_takes_rng = len(required) >= 4
+        self._base_rng = jax.random.PRNGKey(0)
         self.tx = tx
         self.mesh = mesh if mesh is not None else make_mesh(dp=-1)
         self.rules = rules if rules is not None else rules_for_mesh(self.mesh)
@@ -96,6 +114,7 @@ class MeshTrainer:
 
         `sample_batch` is a (host) global batch used only for shapes.
         """
+        self._base_rng = jax.random.fold_in(rng, 0x5eed)  # loss-rng stream
         with nn.logical_axis_rules(self.rules):
             boxed = self.model.init(rng, *_as_args(sample_batch))["params"]
         self._shardings = param_shardings(self.mesh, boxed, self.rules)
@@ -123,20 +142,24 @@ class MeshTrainer:
         self._step_fn = self._build_step()
         return TrainState(params=placed, opt_state=opt_state, step=0)
 
-    def _step_body(self, params, opt_state, batch):
+    def _step_body(self, params, opt_state, batch, rng):
         """One step under the logical rules: shared by the single-step jit
         and the train_steps scan so the two can never diverge."""
         with nn.logical_axis_rules(self.rules):
-            loss, grads = jax.value_and_grad(
-                lambda p: self.loss_fn(self.model, p, batch)
-            )(params)
+            if self._loss_takes_rng:
+                fn = lambda p: self.loss_fn(self.model, p, batch, rng)
+            else:
+                fn = lambda p: self.loss_fn(self.model, p, batch)
+            loss, grads = jax.value_and_grad(fn)(params)
             updates, opt_state = self.tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
     def _build_step(self):
-        def step(params, opt_state, batch):
-            params, opt_state, loss = self._step_body(params, opt_state, batch)
+        def step(params, opt_state, batch, rng):
+            params, opt_state, loss = self._step_body(
+                params, opt_state, batch, rng
+            )
             return params, opt_state, {"loss": loss}
 
         return jax.jit(step, donate_argnums=(0, 1) if self._donate else ())
@@ -153,24 +176,32 @@ class MeshTrainer:
         sharding = NamedSharding(self.mesh, spec)
         return jax.tree.map(lambda x: _put_local_shard(x, sharding), batch)
 
+    def _step_rng(self, step: int):
+        """Per-step loss rng: the init key folded with the step counter —
+        deterministic across restarts at the same step."""
+        return jax.random.fold_in(self._base_rng, step)
+
     def train_step(self, state: TrainState, batch: Any) -> Tuple[TrainState, Dict]:
         if self._step_fn is None:
             raise RuntimeError("call init() before train_step()")
         with self.mesh:
             params, opt_state, metrics = self._step_fn(
-                state.params, state.opt_state, batch
+                state.params, state.opt_state, batch,
+                self._step_rng(state.step),
             )
         return TrainState(params, opt_state, state.step + 1), metrics
 
     def _build_multi_step(self, n: int):
-        def many(params, opt_state, batch):
-            def body(carry, _):
+        def many(params, opt_state, batch, rng):
+            def body(carry, i):
                 p, o = carry
-                p, o, loss = self._step_body(p, o, batch)
+                p, o, loss = self._step_body(
+                    p, o, batch, jax.random.fold_in(rng, i)
+                )
                 return (p, o), loss
 
             (params, opt_state), losses = jax.lax.scan(
-                body, (params, opt_state), None, length=n
+                body, (params, opt_state), jnp.arange(n)
             )
             return params, opt_state, {"loss": losses[-1]}
 
@@ -188,7 +219,10 @@ class MeshTrainer:
         if fn is None:
             fn = self._multi[n] = self._build_multi_step(n)
         with self.mesh:
-            params, opt_state, metrics = fn(state.params, state.opt_state, batch)
+            params, opt_state, metrics = fn(
+                state.params, state.opt_state, batch,
+                self._step_rng(state.step),
+            )
         return TrainState(params, opt_state, state.step + n), metrics
 
     def eval_params(self, state: TrainState) -> Any:

@@ -326,3 +326,39 @@ class TestMeshTrainerFSDP:
 
         st_d, loss_d = run(make_mesh(dp=8))
         assert abs(loss_f - loss_d) < 1e-4, (loss_f, loss_d)
+
+
+def test_loss_arity_detection_ignores_defaults():
+    """A 3-required-arg loss with optional kwargs (lm_loss_with_aux shape)
+    must NOT be treated as rng-taking."""
+    import optax
+
+    from kungfu_tpu.models.transformer import TransformerConfig, TransformerLM
+    from kungfu_tpu.plan import make_mesh
+    from kungfu_tpu.trainer import MeshTrainer
+
+    cfg = TransformerConfig(vocab_size=32, d_model=16, n_layers=1, n_heads=2,
+                            d_ff=32, max_len=16, dtype=jnp.float32,
+                            attention="full")
+
+    def loss3(m, p, b, aux_weight=0.01, z_loss=0.0):
+        from kungfu_tpu.models.transformer import lm_loss
+
+        return lm_loss(m.apply({"params": p}, b), b, z_loss=z_loss)
+
+    tr = MeshTrainer(TransformerLM(cfg), loss3, optax.sgd(0.1),
+                     mesh=make_mesh(dp=8))
+    assert not tr._loss_takes_rng
+    toks = np.random.RandomState(0).randint(0, 32, (8, 16)).astype(np.int32)
+    st = tr.init(jax.random.PRNGKey(0), toks)
+    st, m = tr.train_step(st, tr.shard_batch(toks))
+    assert np.isfinite(float(np.asarray(m["loss"])))
+
+    def loss4(m, p, b, rng):
+        return jax.random.uniform(rng, ()) + 0.0 * sum(
+            jnp.sum(x) for x in jax.tree.leaves(p)
+        )
+
+    tr4 = MeshTrainer(TransformerLM(cfg), loss4, optax.sgd(0.1),
+                      mesh=make_mesh(dp=8))
+    assert tr4._loss_takes_rng
