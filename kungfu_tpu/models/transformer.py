@@ -321,7 +321,7 @@ class Block(nn.Module):
         cfg = self.cfg
         ln = partial(nn.LayerNorm, dtype=jnp.float32, use_bias=False,
                      scale_init=nn.with_logical_partitioning(nn.initializers.ones, ("embed",)))
-        drop = nn.Dropout(cfg.dropout, deterministic=not (train and cfg.dropout > 0))
+        drop = nn.Dropout(cfg.dropout, deterministic=not train)
         x = x + drop(Attention(cfg, name="attn")(ln(name="ln1")(x)))
         if self.use_moe:
             from ..parallel.moe import MoEMLP
@@ -354,9 +354,7 @@ class TransformerLM(nn.Module):
                 jnp.float32,
             )
             x = x + pos[None, :L].astype(cfg.dtype)
-        x = nn.Dropout(
-            cfg.dropout, deterministic=not (train and cfg.dropout > 0)
-        )(x)
+        x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
         x = flax_spmd.with_logical_constraint(x, ("batch", "seq", "act_embed"))
         for i in range(cfg.n_layers):
             use_moe = cfg.n_experts > 0 and (i % cfg.moe_every == cfg.moe_every - 1)

@@ -115,6 +115,7 @@ class MeshTrainer:
         `sample_batch` is a (host) global batch used only for shapes.
         """
         self._base_rng = jax.random.fold_in(rng, 0x5eed)  # loss-rng stream
+        self._multi = {}  # compiled multi-step fns capture the base rng
         with nn.logical_axis_rules(self.rules):
             boxed = self.model.init(rng, *_as_args(sample_batch))["params"]
         self._shardings = param_shardings(self.mesh, boxed, self.rules)
@@ -192,11 +193,15 @@ class MeshTrainer:
         return TrainState(params, opt_state, state.step + 1), metrics
 
     def _build_multi_step(self, n: int):
-        def many(params, opt_state, batch, rng):
+        base = self._base_rng
+
+        def many(params, opt_state, batch, step0):
             def body(carry, i):
                 p, o = carry
+                # same per-step key formula as train_step: fold_in(base,
+                # absolute step) — the two paths can never diverge
                 p, o, loss = self._step_body(
-                    p, o, batch, jax.random.fold_in(rng, i)
+                    p, o, batch, jax.random.fold_in(base, step0 + i)
                 )
                 return (p, o), loss
 
@@ -221,7 +226,7 @@ class MeshTrainer:
         with self.mesh:
             params, opt_state, metrics = fn(
                 state.params, state.opt_state, batch,
-                self._step_rng(state.step),
+                jnp.asarray(state.step, jnp.int32),
             )
         return TrainState(params, opt_state, state.step + n), metrics
 

@@ -362,3 +362,37 @@ def test_loss_arity_detection_ignores_defaults():
     tr4 = MeshTrainer(TransformerLM(cfg), loss4, optax.sgd(0.1),
                       mesh=make_mesh(dp=8))
     assert tr4._loss_takes_rng
+
+
+def test_rng_paths_agree():
+    """train_step at step s and train_steps(n=1) starting at step s use the
+    SAME per-step key (restart determinism across both paths)."""
+    import optax
+
+    from kungfu_tpu.plan import make_mesh
+    from kungfu_tpu.trainer import MeshTrainer
+    from kungfu_tpu.models.transformer import TransformerConfig, TransformerLM
+
+    cfg = TransformerConfig(vocab_size=32, d_model=16, n_layers=1, n_heads=2,
+                            d_ff=32, max_len=16, dtype=jnp.float32,
+                            attention="full")
+
+    def probe(m, p, b, rng):
+        return jax.random.uniform(rng, ()) + 0.0 * sum(
+            jnp.sum(x) for x in jax.tree.leaves(p)
+        )
+
+    toks = np.random.RandomState(0).randint(0, 32, (8, 16)).astype(np.int32)
+
+    def run(single):
+        # fresh trainer/state per path: the step donates its buffers
+        tr = MeshTrainer(TransformerLM(cfg), probe, optax.sgd(0.1),
+                         mesh=make_mesh(dp=8))
+        st = tr.init(jax.random.PRNGKey(3), toks)
+        if single:
+            _, m = tr.train_step(st, tr.shard_batch(toks))
+        else:
+            _, m = tr.train_steps(st, tr.shard_batch(toks), n=1)
+        return float(np.asarray(m["loss"]))
+
+    assert abs(run(True) - run(False)) < 1e-7
