@@ -37,6 +37,8 @@ activation traffic, not the MXU.
 """
 import json
 import os
+import signal
+import subprocess
 import sys
 import time
 
@@ -59,15 +61,19 @@ PEAK_SPECS = {
 }
 
 
+def _peak_specs_for_kind(kind):
+    # longest prefix wins ("TPU v5 lite" must not match the "TPU v5" = v5p row)
+    for k in sorted(PEAK_SPECS, key=len, reverse=True):
+        if kind and kind.startswith(k):
+            return PEAK_SPECS[k]
+    return (None, None)
+
+
 def _peak_specs_per_chip():
     import jax
 
     kind = jax.devices()[0].device_kind
-    # longest prefix wins ("TPU v5 lite" must not match the "TPU v5" = v5p row)
-    for k in sorted(PEAK_SPECS, key=len, reverse=True):
-        if kind.startswith(k):
-            return PEAK_SPECS[k], kind
-    return (None, None), kind
+    return _peak_specs_for_kind(kind), kind
 
 
 def _maybe_profile():
@@ -164,6 +170,7 @@ def run_config(batch_per_chip: int, steps: int, flops: bool):
         "compiled_bytes_per_step": step_bytes,
         "n_chips": n_chips,
         "global_batch": global_batch,
+        "device_kind": jax.devices()[0].device_kind,
     }
 
 
@@ -289,53 +296,64 @@ def run_files_train(batch_per_chip: int, steps: int):
         "compiled_bytes_per_step": None,
         "n_chips": n_chips,
         "global_batch": global_batch,
+        "device_kind": jax.devices()[0].device_kind,
     }
 
 
+def _last_recorded():
+    """The last committed on-chip headline (clearly marked stale), so a
+    tunnel outage at bench time still leaves an informative artifact."""
+    try:
+        cfg = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_CONFIGS.json")
+        with open(cfg) as f:
+            for r in json.load(f).get("results", []):
+                if r.get("config") == "resnet50-ssgd-dp" and r.get("value"):
+                    return {
+                        "value": r["value"],
+                        "unit": r.get("unit"),
+                        "batch": r.get("batch"),
+                        "step_ms": r.get("step_ms"),
+                        "mfu": r.get("mfu"),
+                        "note": "recorded in an EARLIER run (committed "
+                                "BENCH_CONFIGS.json), NOT this invocation",
+                    }
+    except Exception:  # any surprise here must not kill the fallback path
+        pass
+    return None
+
+
+def _emit_error_line(error: str):
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_train_images_per_sec_per_chip",
+                "value": None,
+                "unit": "images/sec/chip",
+                "vs_baseline": None,
+                "error": error,
+                "last_recorded": _last_recorded(),
+            }
+        ),
+        flush=True,
+    )
+
+
 def _install_deadline(seconds: float):
-    """Emit an error JSON line and exit if the bench doesn't finish in time.
+    """Emit the error JSON line and exit if the bench doesn't finish in time.
 
     The TPU tunnel in this environment can wedge (backend init or a
     dispatch blocks forever); without a deadline the driver would record
-    nothing at all.  The error line keeps the contract parseable.
+    nothing at all.  The error line keeps the contract parseable.  The
+    deadline must be SHORTER than any outer harness timeout, or the
+    fallback line never prints — hence the conservative 840 s default.
     """
     import threading
 
     def fire():
-        # surface the last recorded on-chip run (clearly marked stale) so a
-        # tunnel outage at bench time still leaves an informative artifact
-        last = None
-        try:
-            cfg = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                               "BENCH_CONFIGS.json")
-            with open(cfg) as f:
-                for r in json.load(f).get("results", []):
-                    if r.get("config") == "resnet50-ssgd-dp" and r.get("value"):
-                        last = {
-                            "value": r["value"],
-                            "unit": r.get("unit"),
-                            "batch": r.get("batch"),
-                            "step_ms": r.get("step_ms"),
-                            "mfu": r.get("mfu"),
-                            "note": "recorded in an EARLIER run (committed "
-                                    "BENCH_CONFIGS.json), NOT this invocation",
-                        }
-        except Exception:  # any surprise here must not kill the watchdog
-            pass
-        print(
-            json.dumps(
-                {
-                    "metric": "resnet50_train_images_per_sec_per_chip",
-                    "value": None,
-                    "unit": "images/sec/chip",
-                    "vs_baseline": None,
-                    "error": f"deadline {seconds:.0f}s exceeded (TPU backend "
-                             "unreachable or wedged); see committed "
-                             "BENCH_CONFIGS.json for recorded runs",
-                    "last_recorded": last,
-                }
-            ),
-            flush=True,
+        _emit_error_line(
+            f"deadline {seconds:.0f}s exceeded (TPU backend unreachable or "
+            "wedged); see committed BENCH_CONFIGS.json for recorded runs"
         )
         os._exit(3)
 
@@ -345,15 +363,134 @@ def _install_deadline(seconds: float):
     return t
 
 
-def main():
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    # honor an explicit KFT_PLATFORM/JAX_PLATFORMS=cpu request (harness
-    # testing off-chip); on the TPU tunnel nothing is set and axon wins
+def _kill_tree(pid: int):
+    """SIGKILL pid's whole session (children run with start_new_session)."""
+    for sig in (signal.SIGKILL,):
+        try:
+            os.killpg(pid, sig)
+        except (OSError, PermissionError):
+            pass
+        try:
+            os.kill(pid, sig)
+        except (OSError, PermissionError):
+            pass
+
+
+# fatal-form markers only (matched against the TAIL of stderr): JAX also
+# logs benign "Unable to initialize backend 'tpu'" lines early while
+# falling back to another platform — those runs still produce a result
+# and must not be classified as tunnel death
+_INIT_FAILURE_MARKERS = (
+    "RuntimeError: Unable to initialize backend",
+    "failed to connect to all addresses",
+)
+
+
+def _run_child(args_list, timeout, env_extra=None):
+    """Run a bench child with a process-tree-killing timeout.
+
+    Returns (rc, stdout, stderr); rc=124 encodes a timeout.  The child gets
+    its own session so a wedged JAX runtime can be killed as a group.
+    """
+    env = dict(os.environ)
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    if env_extra:
+        env.update(env_extra)
+    p = subprocess.Popen(
+        args_list, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env, start_new_session=True,
+    )
+    try:
+        out, err = p.communicate(timeout=timeout)
+        return p.returncode, out, err
+    except subprocess.TimeoutExpired:
+        _kill_tree(p.pid)
+        try:
+            out, err = p.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            out, err = "", ""
+        return 124, out, err
+
+
+def _probe_backend(timeout: float) -> str | None:
+    """Initialize the JAX backend in a THROWAWAY subprocess.
+
+    Backend-init failure is terminal for the whole sweep (observed: the
+    axon tunnel wedges and every subsequent config burns its full timeout
+    on the same init hang) — so establish up front, cheaply and killably,
+    whether the chip answers at all.  Returns an error string or None.
+    """
+    rc, out, err = _run_child(
+        [sys.executable, "-c",
+         "from kungfu_tpu.env import apply_platform_override; "
+         "apply_platform_override(); "
+         "import jax; d=jax.devices(); print('PROBE_OK', d[0].device_kind)"],
+        timeout=timeout,
+    )
+    if rc == 0 and "PROBE_OK" in out:
+        return None
+    if rc == 124:
+        return f"backend init probe timed out after {timeout:.0f}s (tunnel wedged)"
+    return f"backend init probe failed (rc={rc}): {err.strip()[-300:]}"
+
+
+def _run_one_subprocess(batch: int, timeout: float):
+    """One sweep config in its own killable subprocess.
+
+    Returns (result dict | None, terminal_error str | None).  A terminal
+    error (backend init failure) aborts the remaining sweep — retrying a
+    dead tunnel just burns the driver's window.
+    """
+    rc, out, err = _run_child(
+        [sys.executable, os.path.abspath(__file__), "--one", str(batch)],
+        timeout=timeout,
+    )
+    sys.stderr.write(err)
+    for line in out.splitlines():
+        if line.startswith("#ONE "):
+            return json.loads(line[len("#ONE "):]), None
+    if rc != 0 and any(m in err[-2000:] for m in _INIT_FAILURE_MARKERS):
+        return None, f"backend init failed mid-sweep (batch {batch})"
+    if rc == 124:
+        print(f"# batch/chip {batch}: timed out after {timeout:.0f}s",
+              file=sys.stderr)
+    else:
+        print(f"# batch/chip {batch}: failed rc={rc}: {err.strip()[-200:]}",
+              file=sys.stderr)
+    return None, None
+
+
+def _child_main(batch: int):
+    """--one mode: run a single sweep config and print '#ONE <json>'."""
     from kungfu_tpu.env import apply_platform_override
 
     apply_platform_override()
-    deadline = _install_deadline(float(os.environ.get("KFT_BENCH_DEADLINE", "2400")))
     steps = int(os.environ.get("KFT_BENCH_STEPS", "20"))
+    files_mode = os.environ.get("KFT_BENCH_DATA") == "files"
+    r = run_files_train(batch, steps) if files_mode else run_config(
+        batch, steps, flops=True
+    )
+    print("#ONE " + json.dumps(r), flush=True)
+
+
+def main():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    # honor an explicit KFT_PLATFORM/JAX_PLATFORMS=cpu request (harness
+    # testing off-chip); on the TPU tunnel nothing is set and axon wins.
+    # Child modes do the real work; the PARENT never imports jax, so a
+    # wedged backend can never take down the process that must print.
+    if len(sys.argv) >= 3 and sys.argv[1] == "--one":
+        _child_main(int(sys.argv[2]))
+        return
+
+    deadline = _install_deadline(float(os.environ.get("KFT_BENCH_DEADLINE", "840")))
+    probe_err = _probe_backend(float(os.environ.get("KFT_BENCH_PROBE_TIMEOUT", "150")))
+    if probe_err:
+        _emit_error_line(probe_err)
+        raise SystemExit(3)
+
+    files_mode = os.environ.get("KFT_BENCH_DATA") == "files"
     sweep_env = os.environ.get("KFT_BENCH_BATCH")
     if sweep_env:
         sweep = [int(b) for b in sweep_env.split(",")]
@@ -365,31 +502,45 @@ def main():
         # window records at least one point
         sweep = [128, 64, 256]
 
-
-    files_mode = os.environ.get("KFT_BENCH_DATA") == "files"
+    per_cfg_timeout = float(os.environ.get("KFT_BENCH_CONFIG_TIMEOUT", "420"))
+    deadline_s = float(os.environ.get("KFT_BENCH_DEADLINE", "840"))
+    t_start = time.time()
     results = []
     for b in sweep:
-        try:
-            # per-config cost analysis so mfu/hbm_util use the BEST config's
-            # own flops/bytes (fixed per-step traffic doesn't scale with
-            # batch, so borrowing another config's bytes would skew hbm_util)
-            r = run_files_train(b, steps) if files_mode else run_config(
-                b, steps, flops=True
-            )
+        # never start a config the deadline can't absorb: leave a 45 s
+        # margin so completed results always print BEFORE the watchdog
+        # fires (the sweep's worst case exceeds the deadline by design —
+        # the deadline is the driver-window backstop, not the budget)
+        remaining = deadline_s - (time.time() - t_start) - 45
+        if remaining < 60:
+            print(f"# stopping sweep: {remaining:.0f}s left before deadline",
+                  file=sys.stderr)
+            break
+        # per-config cost analysis so mfu/hbm_util use the BEST config's
+        # own flops/bytes (fixed per-step traffic doesn't scale with
+        # batch, so borrowing another config's bytes would skew hbm_util)
+        r, terminal = _run_one_subprocess(b, min(per_cfg_timeout, remaining))
+        if terminal:
+            if not results:
+                _emit_error_line(terminal)
+                raise SystemExit(3)
+            print(f"# aborting sweep: {terminal}", file=sys.stderr)
+            break
+        if r is not None:
             results.append(r)
             print(
                 f"# batch/chip {b}: {r['img_per_sec_per_chip']:.1f} img/s/chip, "
                 f"{r['step_ms']:.1f} ms/step",
                 file=sys.stderr,
             )
-        except Exception as e:  # e.g. OOM at the largest batch
-            print(f"# batch/chip {b}: failed ({type(e).__name__}: {e})", file=sys.stderr)
 
     if not results:
-        raise SystemExit("no benchmark config completed")
+        _emit_error_line("no benchmark config completed within its timeout")
+        raise SystemExit(3)
 
     best = max(results, key=lambda r: r["img_per_sec_per_chip"])
-    (peak, peak_hbm), kind = _peak_specs_per_chip()
+    kind = best.get("device_kind")
+    peak, peak_hbm = _peak_specs_for_kind(kind)
 
     src = best if best.get("compiled_flops_per_step") else next(
         (r for r in results if r.get("compiled_flops_per_step")), None

@@ -67,10 +67,8 @@ class BenchResult:
         )
 
 
-def _payloads(session: Session, model: str, fuse: bool, dtype=np.float32) -> List[jnp.ndarray]:
+def _payloads(session: Session, model: str, dtype=np.float32) -> List[jnp.ndarray]:
     sizes = fakemodel.get_sizes(model)
-    if fuse:
-        sizes = [sum(sizes)]
     rng = np.random.RandomState(0)
     # Session.lift places per-peer rows correctly in BOTH single-controller
     # and multi-controller (launcher) runs — a plain jnp.asarray of the
@@ -87,19 +85,23 @@ def bench_all_reduce(
     warmup: int = 2,
     dtype=np.float32,
 ) -> BenchResult:
-    """Time `steps` group-all-reduces of the model's gradient list."""
+    """Time `steps` group-all-reduces of the model's gradient list.
+
+    fuse selects Session.group_all_reduce's path: True = the whole list is
+    concatenated and reduced by one compiled program (the reference NCCL
+    fuse, sync_sgd.py:81-112); False = one dispatched collective per tensor.
+    The A/B between the two is this benchmark's reason to exist.
+    """
     if method not in METHODS:
         raise ValueError(f"unknown method {method!r}; one of {sorted(METHODS)}")
     strategy = METHODS[method]
-    xs = _payloads(session, model, fuse, dtype)
+    xs = _payloads(session, model, dtype)
     payload = sum(int(x.nbytes) // session.size for x in xs)
 
     def one_step():
-        outs = [
-            session.all_reduce(x, name=f"bench/{model}/{i}", strategy=strategy)
-            for i, x in enumerate(xs)
-        ]
-        outs[-1].block_until_ready()
+        session.group_all_reduce(
+            xs, name=f"bench/{model}", fuse=fuse, strategy=strategy
+        )
 
     for _ in range(warmup):
         one_step()

@@ -22,6 +22,9 @@ to the kernel-evidence record and the reference's other headline models):
   6 attention-flash-vs-full  Pallas flash vs einsum attention on-chip, fwd+grad
   7 vgg16-ssgd               VGG-16 S-SGD throughput
   8 inception-v3-ssgd        InceptionV3 S-SGD throughput
+  9 gpt-lm-mfu               flagship GPT LM (340M, seq 2048, flash) MFU on-chip
+  10 allreduce-scaling       mesh-size sweep of the fused group allreduce +
+                             fused-vs-per-tensor A/B (kungfu-bench-allreduce)
 
 Configs needing the TPU degrade to an {"error": ...} record instead of
 sinking the matrix when the chip is unreachable.
@@ -171,9 +174,12 @@ def config_resnet50_ssgd() -> dict:
 
 
 def _lm_throughput(tx, per_replica: bool, batch_per_chip: int, steps: int,
-                   seq_len: int = 128) -> dict:
-    """Measured tokens/sec for a BERT-base-shaped LM under a distributed
-    optimizer (compiled scan multi-step, real chip when present)."""
+                   seq_len: int = 128, cfg_overrides: dict | None = None) -> dict:
+    """Measured tokens/sec for a transformer LM under a distributed
+    optimizer (compiled scan multi-step, real chip when present).
+
+    Default shape is BERT-base; cfg_overrides swaps in any other
+    TransformerConfig fields (the GPT MFU config uses it)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -181,10 +187,12 @@ def _lm_throughput(tx, per_replica: bool, batch_per_chip: int, steps: int,
     from ..models.transformer import TransformerConfig, TransformerLM, lm_loss
     from ..train import DataParallelTrainer
 
-    cfg = TransformerConfig(
+    kw = dict(
         vocab_size=30522, d_model=768, n_layers=12, n_heads=12, d_ff=3072,
         max_len=seq_len, dtype=jnp.bfloat16,
     )
+    kw.update(cfg_overrides or {})
+    cfg = TransformerConfig(**kw)
     model = TransformerLM(cfg)
     n_chips = len(jax.devices())
     global_batch = batch_per_chip * n_chips
@@ -212,9 +220,13 @@ def _lm_throughput(tx, per_replica: bool, batch_per_chip: int, steps: int,
 
     # approximate model FLOPs per token: 6N (fwd 2N + bwd 4N) plus the
     # attention-matrix term 12 * layers * seq * d_model (QK^T + AV, 3x for
-    # training) — the standard 6ND accounting, not XLA's padded count
+    # training; halved under causal masking) — the standard 6ND/PaLM
+    # accounting, not XLA's padded count
     n_params = sum(x.size for x in jax.tree.leaves(params))
-    flops_per_token = 6 * n_params + 12 * cfg.n_layers * seq_len * cfg.d_model
+    attn_term = 12 * cfg.n_layers * seq_len * cfg.d_model
+    if cfg.causal:
+        attn_term //= 2
+    flops_per_token = 6 * n_params + attn_term
     mfu = None
     if jax.default_backend() == "tpu":
         try:  # optional metric: never let a lookup failure sink the record
@@ -507,6 +519,102 @@ def config_inception(steps: int = 10) -> dict:
         return {"config": "inception-v3-ssgd", "error": f"{type(e).__name__}: {e}"}
 
 
+def config_gpt_mfu(steps: int = 8) -> dict:
+    """Config 9 (beyond parity): flagship GPT-style LM MFU on-chip.
+
+    A ~340M-param causal LM (d_model 1024, 24 layers, RoPE) at seq 2048
+    with the Pallas flash kernel — the transformer is compute-bound where
+    ResNet is HBM-bound, so this is the repo's strongest "TPU-native and
+    fast" datapoint (round-3 verdict item 4; target MFU >= 0.40 on v5e).
+    """
+    import optax
+
+    from ..optimizers import synchronous_sgd
+
+    overrides = dict(
+        vocab_size=32000, d_model=1024, n_layers=24, n_heads=16, d_ff=4096,
+        causal=True, rope=True, attention="auto",
+    )
+    rows, best = [], None
+    for batch in dict.fromkeys((int(os.environ.get("KFT_GPT_BATCH", "8")), 4)):
+        try:
+            d = _lm_throughput(
+                synchronous_sgd(optax.adamw(3e-4, b1=0.9, b2=0.95)),
+                per_replica=False, batch_per_chip=batch, steps=steps,
+                seq_len=2048, cfg_overrides=overrides,
+            )
+        except Exception as e:
+            rows.append({"batch_per_chip": batch,
+                         "error": f"{type(e).__name__}: {e}"})
+            continue
+        rows.append(d)
+        if best is None or d["tokens_per_sec_per_chip"] > best["tokens_per_sec_per_chip"]:
+            best = d
+    if best is None:
+        return {"config": "gpt-lm-mfu", "error": json.dumps(rows)[-400:]}
+    return {
+        "config": "gpt-lm-mfu",
+        "metric": "gpt_lm_mfu",
+        "value": best["mfu"],
+        "unit": "model_flop_utilization",
+        "tokens_per_sec_per_chip": best["tokens_per_sec_per_chip"],
+        "seq_len": 2048,
+        "n_params": best["n_params"],
+        "batch_per_chip": best["batch_per_chip"],
+        "step_ms": best["step_ms"],
+        "backend": best["backend"],
+        "rows": rows,
+    }
+
+
+def config_allreduce_scaling() -> dict:
+    """Config 10: allreduce weak-scaling sweep + fused-vs-per-tensor A/B
+    (kungfu-bench-allreduce analog, tests/go/cmd/kungfu-bench-allreduce).
+
+    Runs on the virtual 8-device CPU mesh so the record exists regardless
+    of tunnel health; the same command sweeps real chips over ICI when
+    multi-chip hardware exists (KFT_SCALING_TPU=1).
+    """
+    # KFT_SCALING_TPU=1 asks for the real-chip ICI sweep: the child must
+    # then NOT inherit a forced-cpu platform or the sweep degenerates to
+    # one device
+    on_tpu = os.environ.get("KFT_SCALING_TPU") == "1"
+    env_extra = {} if on_tpu else {"JAX_PLATFORMS": "cpu"}
+    rows = {}
+    with tempfile.TemporaryDirectory() as td:
+        try:
+            for arm, flag in (("fused", []), ("per_tensor", ["--no-fuse"])):
+                tmp = os.path.join(td, f"{arm}.json")
+                r = _run(
+                    [sys.executable, "-m", "kungfu_tpu.benchmarks.scaling",
+                     "--out", tmp] + flag,
+                    timeout=900, env_extra=env_extra,
+                )
+                if r.returncode != 0:
+                    return {"config": "allreduce-scaling",
+                            "error": f"rc={r.returncode}: {r.stderr[-300:]}"}
+                with open(tmp) as f:
+                    rows[arm] = json.load(f)
+        except subprocess.TimeoutExpired:
+            return {"config": "allreduce-scaling", "error": "timeout"}
+    fused = rows["fused"]["rows"][-1]
+    unfused = rows["per_tensor"]["rows"][-1]
+    return {
+        "config": "allreduce-scaling",
+        "metric": "allreduce_scaling_efficiency",
+        "value": fused.get("scaling_efficiency"),
+        "unit": "busbw(np_max)/busbw(np_min>1)",
+        "np_max": fused["np"],
+        "fused_vs_per_tensor_speedup": round(
+            unfused["step_ms"] / fused["step_ms"], 3
+        ),
+        "backend": rows["fused"]["backend"],
+        "device_kind": rows["fused"]["device_kind"],
+        "fused_rows": rows["fused"]["rows"],
+        "per_tensor_rows": rows["per_tensor"]["rows"],
+    }
+
+
 def config_attention() -> dict:
     """Flash (Pallas) vs full (einsum) attention on-chip, fwd+grad, per
     sequence length — the kernel-evidence record (ops/flash.py claim site).
@@ -557,6 +665,8 @@ CONFIGS = {
     "6": ("attention-flash-vs-full", lambda args: config_attention()),
     "7": ("vgg16-ssgd", lambda args: config_vgg16()),
     "8": ("inception-v3-ssgd", lambda args: config_inception()),
+    "9": ("gpt-lm-mfu", lambda args: config_gpt_mfu()),
+    "10": ("allreduce-scaling", lambda args: config_allreduce_scaling()),
 }
 
 

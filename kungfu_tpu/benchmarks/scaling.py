@@ -1,0 +1,137 @@
+"""Allreduce scaling-efficiency sweep — the `kungfu-bench-allreduce` analog.
+
+The reference ships a one-command allreduce throughput bench used for perf
+tracking (tests/go/cmd/kungfu-bench-allreduce); BASELINE.md's multi-chip
+target (>=90% scaling efficiency 4->64 chips on v5e-64) needs the same:
+a harness that sweeps mesh sizes and prints grep-able RESULT lines, ready
+to run the day real multi-chip hardware exists.
+
+    python -m kungfu_tpu.benchmarks.scaling [--sizes 1,2,4,8] \
+        [--model resnet50-imagenet] [--out SCALING.json]
+
+On a CPU host it forces an 8-virtual-device platform (the repo's standard
+multi-chip stand-in) and records the weak-scaling curve of the fused group
+allreduce; on a TPU slice it sweeps sub-meshes of the real chips over ICI.
+
+Efficiency definition: busbw(n) / busbw(n_min) — bus bandwidth already
+normalizes the 2(n-1)/n algorithmic factor, so a flat curve = perfect
+scaling.  n=1 rows are reported but excluded from the efficiency baseline
+(no wire traffic at n=1).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _ensure_devices(min_devices: int) -> None:
+    """Force a virtual multi-device CPU platform when no TPU is asked for.
+
+    Backend selection is lazy: `import jax` (already done by the package
+    import that got us here) does NOT pick a backend, so flipping the env +
+    jax.config BEFORE the first device use is still effective.  Without
+    this, a host with a dead TPU tunnel would hang at backend init.
+    """
+    if _tpu_expected():
+        return
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={min_devices}"
+        ).strip()
+    # the tunnel environment exports JAX_PLATFORMS=axon globally, so the
+    # inherited value must be OVERRIDDEN, not defaulted (cf.
+    # env.apply_platform_override's KFT_PLATFORM-wins rule)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if "jax" in sys.modules:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+
+def _tpu_expected() -> bool:
+    # KFT_SCALING_TPU=1 opts into probing the real chip; default is the
+    # CPU mesh so the sweep can never wedge on a dead tunnel
+    return os.environ.get("KFT_SCALING_TPU") == "1"
+
+
+def run(sizes, model: str, steps: int, warmup: int, fuse: bool):
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh
+
+    from . import bench_all_reduce
+    from ..session import Session
+
+    devices = jax.devices()
+    rows = []
+    for n in sizes:
+        if n > len(devices):
+            print(f"# skipping np={n}: only {len(devices)} devices", file=sys.stderr)
+            continue
+        mesh = Mesh(np.asarray(devices[:n]), ("dp",))
+        session = Session(mesh)
+        r = bench_all_reduce(
+            session, model=model, method="auto", fuse=fuse,
+            steps=steps, warmup=warmup,
+        )
+        print(r.line(n), flush=True)
+        rows.append(
+            {
+                "np": n,
+                "payload_bytes": r.payload_bytes,
+                "step_ms": round(r.seconds_per_step * 1e3, 3),
+                "data_gibps": round(r.data_gibps, 3),
+                "busbw_gibps": round(r.busbw_gibps(n), 3),
+            }
+        )
+    multi = [row for row in rows if row["np"] > 1]
+    if multi:
+        base = multi[0]
+        for row in multi:
+            row["scaling_efficiency"] = round(
+                row["busbw_gibps"] / base["busbw_gibps"], 3
+            )
+        print(
+            f"RESULT: bench=allreduce-scaling model={model} fuse={int(fuse)} "
+            f"np={base['np']}->{multi[-1]['np']} "
+            f"efficiency={multi[-1]['scaling_efficiency']:.3f}",
+            flush=True,
+        )
+    return rows
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="kungfu_tpu.benchmarks.scaling")
+    ap.add_argument("--sizes", default="1,2,4,8")
+    ap.add_argument("--model", default="resnet50-imagenet")
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--warmup", type=int, default=2)
+    ap.add_argument("--no-fuse", action="store_true")
+    ap.add_argument("--out", default="", help="write rows as JSON to this file")
+    args = ap.parse_args(argv)
+
+    sizes = sorted({int(s) for s in args.sizes.split(",") if s})
+    _ensure_devices(max(sizes))
+
+    import jax
+
+    rows = run(sizes, args.model, args.steps, args.warmup, fuse=not args.no_fuse)
+    out = {
+        "bench": "allreduce-scaling",
+        "model": args.model,
+        "fuse": not args.no_fuse,
+        "backend": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+        "rows": rows,
+    }
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -174,10 +174,9 @@ class Session:
             self._fns[key] = fn
         return fn
 
-    def _build(self, kind: str, op: str, impl: Impl, **kw) -> Callable:
+    def _reduce_impl(self, op: str, impl: Impl) -> Callable:
         axes = self._axes
         axis = axes if len(axes) > 1 else axes[0]
-        spec = P(axes)
 
         def reduce_impl(y):
             if impl is Impl.HIERARCHICAL:
@@ -187,6 +186,15 @@ class Session:
             if impl is Impl.RS_AG:
                 return C.rs_ag_all_reduce(y, axis, op)
             return C.all_reduce(y, axis, op)
+
+        return reduce_impl
+
+    def _build(self, kind: str, op: str, impl: Impl, **kw) -> Callable:
+        axes = self._axes
+        axis = axes if len(axes) > 1 else axes[0]
+        spec = P(axes)
+
+        reduce_impl = self._reduce_impl(op, impl)
 
         if kind == "all_reduce":
             def body(x):
@@ -253,21 +261,78 @@ class Session:
             strategy = strategy_for_tree(Graph.from_forest_array(list(tree)))
         return self._run("all_reduce", x, op=op, name=name, strategy=strategy)
 
-    def group_all_reduce(self, xs: Sequence, op: str = "sum", name: str = ""):
-        """Reduce a tensor list: dispatch every op, sync once at the end.
+    def _fused_group_fn(self, signature, op: str, impl: Impl) -> Callable:
+        """One compiled program reducing EVERY tensor in the list.
 
-        The reference pipelines chunks across strategy graphs so transfers
-        overlap (session.go:288-313); the XLA analog is async dispatch —
-        every compiled collective is enqueued before the first result is
-        awaited, so the runtime overlaps them — with one wall-clock window
-        for the whole group instead of dispatch-sync per tensor.
+        Not a concat/split fuse (measured 20x slower than the collective
+        itself on a 161-tensor ResNet-50 list — the gather/scatter copies
+        dwarf the reduction): one shard_map whose body reduces each tensor,
+        so the group costs ONE dispatch and XLA's all-reduce combiner is
+        free to batch the transfers.  Mixed dtypes need no special casing.
+        """
+        key = ("fused_group", op, impl, signature)
+        fn = self._fns.get(key)
+        if fn is not None:
+            return fn
+        spec = P(self._axes)
+        reduce_impl = self._reduce_impl(op, impl)
+
+        def body(*ys):
+            return tuple(reduce_impl(jnp.squeeze(y, 0))[None] for y in ys)
+
+        specs = tuple(spec for _ in signature)
+        fn = jax.jit(shard_map(body, self.mesh, in_specs=specs, out_specs=specs))
+        self._fns[key] = fn
+        return fn
+
+    def group_all_reduce(self, xs: Sequence, op: str = "sum", name: str = "",
+                         fuse: bool = True, strategy: Optional[Strategy] = None):
+        """Reduce a tensor list in one sync window.
+
+        fuse=True (default): the whole list is reduced by ONE compiled
+        program — the role of the reference's NCCL fuse path
+        (optimizers/sync_sgd.py:81-112), which exists for the same reason:
+        many small transfers pay per-op launch latency.  The TPU-idiomatic
+        mechanism differs: no concat/split staging (measured 20x slower
+        than the collective itself — the copies dwarf the reduction), just
+        one program containing every tensor's reduction, one dispatch, and
+        XLA's all-reduce combiner batching the wires.  A/B via `python -m
+        kungfu_tpu.benchmarks` [--no-fuse]; measured numbers live in
+        BENCH_CONFIGS.json (allreduce-scaling config).
+
+        fuse=False: dispatch every tensor's collective separately, then sync
+        once.  TPU executes enqueued programs in order, so this is N
+        back-to-back transfers (not overlapped) — useful when the list is
+        huge and a fused buffer would double peak memory.  On the CPU
+        backend the dispatches are additionally serialized: XLA's
+        in-process rendezvous lets concurrently-running programs interleave
+        their collectives differently per device thread, which deadlocks —
+        the same cross-worker ordering hazard the reference built its NCCL
+        scheduler for (nccl/scheduler.cpp); SPMD-compiled steps never hit
+        it because the order is fixed at compile time.
         """
         t0 = time.perf_counter()
         gname = name or "group_all_reduce"
+        impl = self._impl(strategy)
         with stall_detector(gname):
-            outs = [
-                self._dispatch("all_reduce", x, op=op) for x in xs
-            ]
+            if fuse and len(xs) > 1:
+                xs = [jnp.asarray(x) for x in xs]
+                for x in xs:
+                    if x.shape[0] != self.size:
+                        raise ValueError(
+                            f"leading dim {x.shape[0]} != session size "
+                            f"{self.size}; per-peer tensors stack on dim 0"
+                        )
+                signature = tuple((x.shape, str(x.dtype)) for x in xs)
+                outs = list(self._fused_group_fn(signature, op, impl)(*xs))
+            else:
+                serialize = jax.default_backend() == "cpu"
+                outs = []
+                for x in xs:
+                    o = self._dispatch("all_reduce", x, op=op, strategy=strategy)
+                    if serialize:
+                        o.block_until_ready()
+                    outs.append(o)
             for out in outs:
                 out.block_until_ready()
         dt = time.perf_counter() - t0
