@@ -24,7 +24,8 @@ from typing import Any, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import flax.linen as nn
-from flax.linen import spmd as flax_spmd
+
+from ..parallel.sharding import logical_constraint
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..parallel.ring_attention import full_attention, ring_attention
@@ -229,9 +230,9 @@ class Attention(nn.Module):
             if not (kind == "flash" and Hkv % tp == 0):
                 k = jnp.repeat(k, H // Hkv, axis=2)
                 v = jnp.repeat(v, H // Hkv, axis=2)
-        q = flax_spmd.with_logical_constraint(q, ("batch", "seq", "heads", "kv"))
-        k = flax_spmd.with_logical_constraint(k, ("batch", "seq", "heads", "kv"))
-        v = flax_spmd.with_logical_constraint(v, ("batch", "seq", "heads", "kv"))
+        q = logical_constraint(q, ("batch", "seq", "heads", "kv"), cfg.mesh)
+        k = logical_constraint(k, ("batch", "seq", "heads", "kv"), cfg.mesh)
+        v = logical_constraint(v, ("batch", "seq", "heads", "kv"), cfg.mesh)
 
         if (
             kind in ("ring", "ulysses")
@@ -308,7 +309,7 @@ class MLP(nn.Module):
             h = nn.silu(gate) * h
         else:
             h = nn.gelu(h)
-        h = flax_spmd.with_logical_constraint(h, ("batch", "seq", "mlp"))
+        h = logical_constraint(h, ("batch", "seq", "mlp"), cfg.mesh)
         return _dense(cfg.d_model, "out", ("mlp", "embed"), cfg.dtype)(h)
 
 
@@ -320,7 +321,7 @@ class Block(nn.Module):
     def __call__(self, x, train: bool = False):
         cfg = self.cfg
         ln = partial(nn.LayerNorm, dtype=jnp.float32, use_bias=False,
-                     scale_init=nn.with_logical_partitioning(nn.initializers.ones, ("embed",)))
+                     scale_init=nn.with_logical_partitioning(nn.initializers.ones, ("norm",)))
         drop = nn.Dropout(cfg.dropout, deterministic=not train)
         x = x + drop(Attention(cfg, name="attn")(ln(name="ln1")(x)))
         if self.use_moe:
@@ -329,7 +330,40 @@ class Block(nn.Module):
             x = x + drop(MoEMLP(cfg, name="moe")(ln(name="ln2")(x)))
         else:
             x = x + drop(MLP(cfg, name="mlp")(ln(name="ln2")(x)))
-        return flax_spmd.with_logical_constraint(x, ("batch", "seq", "act_embed"))
+        return logical_constraint(x, ("batch", "seq", "act_embed"), cfg.mesh)
+
+
+class _Head(nn.Module):
+    """lm_head projection with a use-site-gathered kernel.
+
+    Same param tree as the nn.Dense it replaces (params["lm_head"]
+    ["kernel"]).  The kernel is STORED under the rules' sharding (fsdp
+    shards it) but GATHERED at use: without the constraint, the backward
+    dot that produces the sharded kernel grad makes the partitioner
+    reshard the batch-sharded logits cotangent (B, L, V) to the kernel's
+    layout — an involuntary full remat of an activation-sized tensor.
+    Gathered, the grad is computed partial+psum then sliced: weight-sized
+    traffic, the ZeRO-3 contract.
+    """
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        w = self.param(
+            "kernel",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), ("embed", "vocab")
+            ),
+            (cfg.d_model, cfg.vocab_size),
+            jnp.float32,
+        )
+        # "act_vocab" (not "vocab"): keeps the kernel tp-sharded on tp
+        # meshes (Megatron vocab-parallel logits) while gathering the
+        # fsdp storage dims
+        w = logical_constraint(w, (None, "act_vocab"), cfg.mesh)
+        return jnp.einsum("bld,dv->blv", x.astype(jnp.float32), w)
 
 
 class TransformerLM(nn.Module):
@@ -345,7 +379,12 @@ class TransformerLM(nn.Module):
                 nn.initializers.normal(stddev=0.02), ("vocab", "embed")
             ),
         )
-        x = emb(tokens)
+        # pin the lookup output to the activation layout immediately: the
+        # table's embed dim may be fsdp-sharded (ZeRO-3), and without the
+        # constraint the gather output inherits that feature-dim sharding
+        x = logical_constraint(
+            emb(tokens), ("batch", "seq", "act_embed"), cfg.mesh
+        )
         if not cfg.rope:  # rope applies per-layer in Attention instead
             pos = self.param(
                 "pos_embed",
@@ -353,28 +392,51 @@ class TransformerLM(nn.Module):
                 (cfg.max_len, cfg.d_model),
                 jnp.float32,
             )
-            x = x + pos[None, :L].astype(cfg.dtype)
+            # use-site gather: pos_embed's PARAM embed dim may be
+            # fsdp-sharded (ZeRO-3); adding it raw would make the
+            # partitioner reshard the batch-sharded activation to the
+            # table's layout (observed: involuntary full remat in the
+            # dp x fsdp dryrun).  Constraining the use to the activation
+            # layout all-gathers the small table instead.
+            p = logical_constraint(
+                pos[None, :L].astype(cfg.dtype), (None, "seq", "act_embed"),
+                cfg.mesh,
+            )
+            x = x + p
         x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
-        x = flax_spmd.with_logical_constraint(x, ("batch", "seq", "act_embed"))
+        x = logical_constraint(x, ("batch", "seq", "act_embed"), cfg.mesh)
         for i in range(cfg.n_layers):
             use_moe = cfg.n_experts > 0 and (i % cfg.moe_every == cfg.moe_every - 1)
             x = Block(cfg, use_moe=use_moe, name=f"block_{i}")(x, train=train)
         x = nn.LayerNorm(dtype=jnp.float32, use_bias=False, name="ln_f",
-                         scale_init=nn.with_logical_partitioning(nn.initializers.ones, ("embed",)))(x)
+                         scale_init=nn.with_logical_partitioning(nn.initializers.ones, ("norm",)))(x)
         if cfg.tie_embeddings:
             # logits = x @ E^T with the INPUT embedding, in f32 to match
             # the untied lm_head's precision (bf16 logits would noisily
             # round the loss over a large vocab)
-            e = emb.variables["params"]["embedding"]
+            e = nn.meta.unbox(emb.variables["params"]["embedding"])
+            # use-site gather (ZeRO-3): the stored table may be
+            # fsdp-sharded; used raw, the partitioner reshards the big
+            # batch-sharded logits cotangent to the table's layout in
+            # backward (involuntary full remat).  Constrained replicated,
+            # forward all-gathers the table and backward computes the
+            # table grad as partial+psum then slices — weight-sized
+            # traffic instead of activation-sized.
+            e = logical_constraint(e, ("act_vocab", None), cfg.mesh)
             logits = jnp.einsum(
-                "bld,vd->blv", x.astype(jnp.float32),
-                nn.meta.unbox(e).astype(jnp.float32),
+                "bld,vd->blv", x.astype(jnp.float32), e.astype(jnp.float32)
             )
         else:
-            logits = _dense(
-                cfg.vocab_size, "lm_head", ("embed", "vocab"), jnp.float32
-            )(x)
-        return logits
+            logits = _Head(cfg, name="lm_head")(x)
+        # batch-sharded logits ("act_vocab" keeps tp vocab-parallelism,
+        # resolves to None under fsdp): without this the partitioner may
+        # shard the head matmul over the kernel's fsdp storage dims,
+        # resharding the whole activation (involuntary full remat).
+        # Plain "vocab" would be wrong here — under fsdp rules it outranks
+        # "batch" for the fsdp axis and would shard logits feature-wise.
+        return logical_constraint(
+            logits, ("batch", "seq", "act_vocab"), cfg.mesh
+        )
 
 
 def generate(

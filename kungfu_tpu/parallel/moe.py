@@ -15,7 +15,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 import flax.linen as nn
-from flax.linen import spmd as flax_spmd
+from .sharding import logical_constraint
 
 
 class MoEMLP(nn.Module):
@@ -54,7 +54,9 @@ class MoEMLP(nn.Module):
             * jax.nn.one_hot(pos, capacity, dtype=x.dtype)[:, None, :]
         )
         expert_in = jnp.einsum("td,tec->ecd", flat, dispatch)  # [E, C, Dm]
-        expert_in = flax_spmd.with_logical_constraint(expert_in, ("expert", None, "act_embed"))
+        expert_in = logical_constraint(
+            expert_in, ("expert", None, "act_embed"), self.cfg.mesh
+        )
 
         # per-expert FFN, experts sharded over the expert axis
         w_in = self.param(

@@ -24,10 +24,19 @@ DEFAULT_RULES: Tuple[Tuple[str, Optional[str]], ...] = (
     # use "act_embed" so the fsdp rule never forces activation resharding
     ("embed", None),
     ("act_embed", None),
+    # norm scales/biases: a few dozen floats — sharding them over fsdp
+    # saves nothing and their annotation makes the partitioner reshard the
+    # big activations they multiply (observed involuntary full remat), so
+    # they stay replicated even under ZeRO
+    ("norm", None),
     ("heads", "tp"),
     ("kv", None),
     ("mlp", "tp"),
     ("vocab", "tp"),
+    # activation/use-site vocab dim: tp-sharded when tp exists (Megatron
+    # vocab-parallel logits), NEVER rewritten to fsdp — use-site gathers
+    # name this so ZeRO storage sharding doesn't leak onto activations
+    ("act_vocab", "tp"),
     ("expert", "ep"),
     ("stage", "pp"),
 )
@@ -52,16 +61,50 @@ def rules_for_mesh(mesh: Mesh, rules=DEFAULT_RULES) -> Tuple[Tuple[str, Optional
             out.append((l, axes if len(axes) > 1 else axes[0]))
         elif l == "embed" and fsdp_defaults:
             out.append((l, "fsdp"))
+        elif l == "vocab" and fsdp_defaults and "tp" not in names:
+            out.append((l, "fsdp"))
         else:
             out.append((l, m if (m in names) else None))
+    if fsdp_defaults and "tp" not in names:
+        # vocab must OUTRANK embed for the fsdp axis: flax gives a mesh
+        # axis to the FIRST rule claiming it, so listing vocab first
+        # shards the embedding table and lm_head on their VOCAB dim and
+        # leaves their embed dim whole.  Sharding those tables on the
+        # embed (feature) dim instead makes the table-gradient scatter
+        # demand feature-sharded updates, which forces the partitioner to
+        # fully rematerialize the batch-sharded activations (observed in
+        # the dp x fsdp dryrun).
+        out.sort(key=lambda r: 0 if r[0] == "vocab" else 1)
     return tuple(out)
 
 
 def logical_constraint(x, names: Sequence[Optional[str]], mesh: Optional[Mesh] = None, rules=None):
-    """with_sharding_constraint by logical names (no-op outside a mesh)."""
+    """with_sharding_constraint by logical names (no-op without a mesh).
+
+    The mesh MUST be passed explicitly: flax's with_logical_constraint
+    no-ops unless flax.core.meta.global_mesh_defined() is true, and on the
+    pinned jax/flax versions `with mesh:` does not satisfy that check
+    (verified empirically — constraints were absent from the lowered HLO
+    until the mesh was passed here, observed as an involuntary full remat
+    in the dp x fsdp dryrun).
+
+    Rules come from the ambient nn.logical_axis_rules context.  An EMPTY
+    context no-ops, preserving flax's contract — manual shard_map regions
+    (e.g. pipeline stages) set `nn.logical_axis_rules(())` exactly to
+    disable constraints; substituting defaults there would inject
+    with_sharding_constraint inside a manual region.  Callers without a
+    rules context can pass `rules=` explicitly (MeshTrainer always traces
+    under its rules, so the training path never hits the empty case).
+    """
     if mesh is None or not mesh.axis_names:
         return x
-    return flax_spmd.with_logical_constraint(x, tuple(names))
+    if rules is None:
+        rules = flax_spmd.get_logical_axis_rules()
+        if not rules:
+            return x
+    return flax_spmd.with_logical_constraint(
+        x, tuple(names), rules=rules, mesh=mesh
+    )
 
 
 def param_shardings(mesh: Mesh, abstract_params: Any, rules=None) -> Any:

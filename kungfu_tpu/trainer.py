@@ -145,8 +145,17 @@ class MeshTrainer:
 
     def _step_body(self, params, opt_state, batch, rng):
         """One step under the logical rules: shared by the single-step jit
-        and the train_steps scan so the two can never diverge."""
-        with nn.logical_axis_rules(self.rules):
+        and the train_steps scan so the two can never diverge.
+
+        Traced under `with self.mesh` so bare-PartitionSpec
+        lax.with_sharding_constraint calls resolve.  Note this does NOT
+        activate flax's ambient with_logical_constraint on the pinned
+        versions (flax.core.meta.global_mesh_defined() stays false —
+        verified against the lowered HLO); model constraints must pass the
+        mesh explicitly via parallel.sharding.logical_constraint, which is
+        why the rules context alone is not enough.
+        """
+        with self.mesh, nn.logical_axis_rules(self.rules):
             if self._loss_takes_rng:
                 fn = lambda p: self.loss_fn(self.model, p, batch, rng)
             else:
