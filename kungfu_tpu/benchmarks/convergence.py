@@ -198,8 +198,11 @@ def host_gossip_worker(steps: int, batch: int, lr: float,
     curve = []
     for step in range(steps):
         d, l = next(loader)
-        params = hpa.mix(params)  # gossip pull + average (pre-update)
+        # reference order (async_sgd.py:127-140): average, apply local
+        # grads, THEN publish — peers pull a model with the latest step
+        params = hpa.mix(params)
         params, opt, loss = step_fn(params, opt, (d.reshape(-1, 28, 28, 1), l))
+        hpa.publish(params)
         if step % log_every == 0 or step == steps - 1:
             curve.append([step, round(float(loss), 4)])
     kungfu_tpu.run_barrier()

@@ -164,7 +164,16 @@ class HostPairAveraging:
         return r if r < self.peer.rank else r + 1  # skip self (async_sgd.py:73)
 
     def mix(self, params):
-        """One gossip exchange; returns the mixed params (call pre-update)."""
+        """One gossip pull+average; returns the mixed params.
+
+        Call BEFORE the local gradient step, then `publish` the
+        post-gradient params.  mix() itself publishes nothing (beyond the
+        one-time step-0 bootstrap): the reference saves the model AFTER
+        applying local gradients (async_sgd.py:127-140 — average, apply,
+        SaveVariable), so peers always pull a model that includes the
+        owner's latest local step.  Publishing the mixed-but-not-updated
+        model here instead would hand peers a one-step-stale view.
+        """
         from .. import native
 
         mine = self._fuse(params)
@@ -178,6 +187,10 @@ class HostPairAveraging:
             other = self.peer.request(self._random_peer(), self.NAME, wait=False)
             if other is not None:
                 native.average_f32(mine, other.astype(self._np.float32).reshape(-1))
-        mixed = self._defuse(mine, params)
-        self.peer.save(self.NAME, mine)
-        return mixed
+        return self._defuse(mine, params)
+
+    def publish(self, params) -> None:
+        """Save the POST-gradient model to the blob store (the reference's
+        SaveVariable call, async_sgd.py:138-140)."""
+        self.peer.save(self.NAME, self._fuse(params))
+        self._published = True

@@ -145,11 +145,19 @@ def test_host_pair_averaging_two_peers():
     p0, p1 = (HostPairAveraging(StubPeer(r)) for r in range(2))
     m0 = {"w": jnp.full((4,), 0.0, jnp.float32)}
     m1 = {"w": jnp.full((4,), 8.0, jnp.float32)}
-    m0 = p0.mix(m0)          # publishes 0, pulls nothing yet from 1
-    m1 = p1.mix(m1)          # pulls 0's model: (8+0)/2 = 4
+    m0 = p0.mix(m0)          # bootstrap-publishes 0, pulls nothing yet
+    m1 = p1.mix(m1)          # bootstrap-publishes 8, pulls 0: (8+0)/2 = 4
     np.testing.assert_allclose(np.asarray(m1["w"]), 4.0)
-    m0 = p0.mix(m0)          # pulls 1's published mixed model: (0+4)/2 = 2
-    np.testing.assert_allclose(np.asarray(m0["w"]), 2.0)
+    # local "gradient step" on peer 1, then publish the POST-gradient
+    # model — the reference's save point (async_sgd.py:127-140)
+    m1 = {"w": m1["w"] + 1.0}  # -> 5
+    p1.publish(m1)
+    # staleness contract: the stored blob reflects peer 1's LATEST local
+    # step (5), not the pre-update mixed model (4)
+    blob = clients[0].request(peers_ids[1], HostPairAveraging.NAME)
+    np.testing.assert_allclose(np.asarray(blob).reshape(-1), 5.0)
+    m0 = p0.mix(m0)          # pulls 1's post-step model: (0+5)/2 = 2.5
+    np.testing.assert_allclose(np.asarray(m0["w"]), 2.5)
     for c in clients:
         c.close()
     for s in servers:
