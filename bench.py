@@ -119,7 +119,11 @@ def run_config(batch_per_chip: int, steps: int, flops: bool):
     global_batch = batch_per_chip * n_chips
 
     bn_dtype = jnp.float32 if os.environ.get("KFT_BENCH_BN_FP32") else jnp.bfloat16
-    model = ResNet50(num_classes=1000, norm_dtype=bn_dtype)
+    # roofline A/B levers (see models/resnet.py): MLPerf space-to-depth
+    # stem and per-block remat (FLOPs-for-HBM-bytes trade)
+    stem = "space_to_depth" if os.environ.get("KFT_BENCH_STEM") == "s2d" else "conv7"
+    remat = os.environ.get("KFT_BENCH_REMAT") == "1"
+    model = ResNet50(num_classes=1000, norm_dtype=bn_dtype, stem=stem, remat=remat)
 
     def loss_fn(params, model_state, batch):
         images, labels = batch
@@ -171,6 +175,8 @@ def run_config(batch_per_chip: int, steps: int, flops: bool):
         "n_chips": n_chips,
         "global_batch": global_batch,
         "device_kind": jax.devices()[0].device_kind,
+        "stem": stem,
+        "remat": remat,
     }
 
 

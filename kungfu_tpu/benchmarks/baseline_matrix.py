@@ -25,6 +25,8 @@ to the kernel-evidence record and the reference's other headline models):
   9 gpt-lm-mfu               flagship GPT LM (340M, seq 2048, flash) MFU on-chip
   10 allreduce-scaling       mesh-size sweep of the fused group allreduce +
                              fused-vs-per-tensor A/B (kungfu-bench-allreduce)
+  11 resnet50-roofline-ab    activation-traffic A/B on-chip: baseline vs
+                             space-to-depth stem vs per-block remat
 
 Configs needing the TPU degrade to an {"error": ...} record instead of
 sinking the matrix when the chip is unreachable.
@@ -346,7 +348,10 @@ def config_resnet50_gossip(steps: int = 5) -> dict:
         hpa.mix(host_params)  # warm (allocates fuse buffers)
         t1 = time.perf_counter()
         for _ in range(5):
+            # full per-step gossip cost: pull+average, then the
+            # post-gradient publish (reference save point)
             hpa.mix(host_params)
+            hpa.publish(host_params)
         host_ms = (time.perf_counter() - t1) / 5 * 1e3
 
         img_s = steps * batch * n_chips / dt / n_chips
@@ -615,6 +620,68 @@ def config_allreduce_scaling() -> dict:
     }
 
 
+def config_resnet_roofline() -> dict:
+    """Config 11: ResNet-50 activation-traffic A/B on-chip (verdict r3 #3).
+
+    Four variants at the headline batch: baseline, space-to-depth stem,
+    per-block remat, both.  Each runs bench.py's --one child (same step,
+    same timing).  The record shows whether the HBM-bound step moves when
+    activation bytes do — the "optimize, don't narrate" evidence.
+    """
+    variants = [
+        ("baseline", {}),
+        ("s2d-stem", {"KFT_BENCH_STEM": "s2d"}),
+        ("remat", {"KFT_BENCH_REMAT": "1"}),
+        ("s2d+remat", {"KFT_BENCH_STEM": "s2d", "KFT_BENCH_REMAT": "1"}),
+    ]
+    batch = os.environ.get("KFT_ROOFLINE_BATCH", "128")
+    steps = os.environ.get("KFT_BENCH_STEPS", "20")
+    rows = []
+    for name, env in variants:
+        try:
+            r = _run(
+                [sys.executable, os.path.join(_REPO, "bench.py"), "--one", batch],
+                timeout=500, env_extra={**env, "KFT_BENCH_STEPS": steps},
+            )
+        except subprocess.TimeoutExpired:
+            rows.append({"variant": name, "error": "timeout"})
+            continue
+        row = {"variant": name}
+        for line in r.stdout.splitlines():
+            if line.startswith("#ONE "):
+                d = json.loads(line[len("#ONE "):])
+                row.update(
+                    img_per_sec_per_chip=round(d["img_per_sec_per_chip"], 2),
+                    step_ms=round(d["step_ms"], 2),
+                    compiled_bytes_per_step=d.get("compiled_bytes_per_step"),
+                )
+                break
+        else:
+            row["error"] = f"rc={r.returncode}: {r.stderr[-200:]}"
+        rows.append(row)
+    ok = [r for r in rows if "error" not in r]
+    if not ok:
+        return {"config": "resnet50-roofline-ab", "error": json.dumps(rows)[-400:]}
+    base = next((r for r in ok if r["variant"] == "baseline"), None)
+    best = max(ok, key=lambda r: r["img_per_sec_per_chip"])
+    rec = {
+        "config": "resnet50-roofline-ab",
+        "metric": "resnet50_best_variant_speedup_vs_baseline",
+        # value stays None when the baseline row failed: a speedup against
+        # some other variant would be a mislabeled evidence record
+        "value": round(
+            best["img_per_sec_per_chip"] / base["img_per_sec_per_chip"], 3
+        ) if base else None,
+        "unit": "x",
+        "best_variant": best["variant"],
+        "batch_per_chip": int(batch),
+        "rows": rows,
+    }
+    if base is None:
+        rec["note"] = "baseline variant failed; speedup denominator unavailable"
+    return rec
+
+
 def config_attention() -> dict:
     """Flash (Pallas) vs full (einsum) attention on-chip, fwd+grad, per
     sequence length — the kernel-evidence record (ops/flash.py claim site).
@@ -667,6 +734,7 @@ CONFIGS = {
     "8": ("inception-v3-ssgd", lambda args: config_inception()),
     "9": ("gpt-lm-mfu", lambda args: config_gpt_mfu()),
     "10": ("allreduce-scaling", lambda args: config_allreduce_scaling()),
+    "11": ("resnet50-roofline-ab", lambda args: config_resnet_roofline()),
 }
 
 

@@ -53,6 +53,19 @@ class ResNet(nn.Module):
     # 224x224xB elements stay accurate enough in bf16 because the variance
     # reduction is hierarchical inside XLA.
     norm_dtype: Any = jnp.float32
+    # "conv7" = the classic 7x7-stride-2 stem.  "space_to_depth" = the TPU
+    # MLPerf stem: pack 2x2 pixel blocks into channels (H,W,3 ->
+    # H/2,W/2,12) and convolve 4x4-stride-1 — same receptive field as a
+    # zero-padded 8x8-stride-2 conv, but 12 input channels tile the MXU
+    # where 3 channels waste
+    # lanes.  A different (equally trainable) parameterization, not a
+    # rearrangement of conv7 weights.
+    stem: str = "conv7"
+    # checkpoint each bottleneck block: backward recomputes the block's
+    # convs (~1/3 more conv FLOPs) instead of reading their saved outputs
+    # from HBM — a deliberate FLOPs-for-bytes trade for the HBM-bound
+    # training step (the step runs ~32% MFU, so MXU headroom exists)
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -65,14 +78,23 @@ class ResNet(nn.Module):
             dtype=self.norm_dtype,
         )
         x = x.astype(self.dtype)
-        x = conv(self.width, (7, 7), (2, 2), padding=[(3, 3), (3, 3)], name="conv_init")(x)
+        if self.stem == "space_to_depth":
+            b, h, w, c = x.shape
+            x = x.reshape(b, h // 2, 2, w // 2, 2, c)
+            x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 2, w // 2, 4 * c)
+            x = conv(self.width, (4, 4), (1, 1), padding="SAME",
+                     name="conv_init_s2d")(x)
+        else:
+            x = conv(self.width, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                     name="conv_init")(x)
         x = norm(name="bn_init")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        block_cls = nn.remat(BottleneckBlock) if self.remat else BottleneckBlock
         for i, block_count in enumerate(self.stage_sizes):
             for j in range(block_count):
                 strides = (2, 2) if i > 0 and j == 0 else (1, 1)
-                x = BottleneckBlock(
+                x = block_cls(
                     filters=self.width * 2 ** i, strides=strides, conv=conv, norm=norm
                 )(x)
         x = jnp.mean(x, axis=(1, 2))

@@ -170,6 +170,12 @@ class Attention(nn.Module):
             cache_idx = self.variable(
                 "cache", "idx", lambda: jnp.zeros((), jnp.int32)
             )
+            # sticky overflow flag: once any write ran past max_len the
+            # clamped dynamic_update_slice has clobbered older slots, so
+            # EVERY later output is suspect, not just out-of-range rows
+            cache_ovf = self.variable(
+                "cache", "overflowed", lambda: jnp.zeros((), jnp.bool_)
+            )
             idx0 = cache_idx.value
             pos = idx0 + jnp.arange(L)
             q = apply_rope(q, pos, cfg.rope_theta)
@@ -186,6 +192,9 @@ class Attention(nn.Module):
                     (0, idx0, 0, 0),
                 )
                 cache_idx.value = idx0 + L
+                cache_ovf.value = jnp.logical_or(
+                    cache_ovf.value, idx0 + L > cfg.max_len
+                )
             kf = cache_k.value
             vf = cache_v.value
             if Hkv != H:
@@ -204,12 +213,16 @@ class Attention(nn.Module):
             s = jnp.where(valid[None, None], s, -1e30)
             p = jax.nn.softmax(s, axis=-1)
             o = jnp.einsum("bhlm,bmhd->blhd", p, vf.astype(jnp.float32))
-            # cursor past max_len would clamp the cache write and unmask
-            # clobbered slots — poison those rows with NaN so overflow is
-            # LOUD instead of silently-wrong logits (generate() bounds the
-            # total; this guards the raw decode apply() surface)
-            o = jnp.where((pos >= cfg.max_len)[None, :, None, None],
-                          jnp.nan, o)
+            # cursor past max_len clamps the cache write and clobbers older
+            # slots — poison with NaN so overflow is LOUD instead of
+            # silently-wrong logits (generate() bounds the total; this
+            # guards the raw decode apply() surface).  The sticky flag
+            # poisons in-range rows of overflowing and LATER calls too:
+            # they attend to corrupted K/V.
+            poison = jnp.logical_or(
+                (pos >= cfg.max_len)[None, :, None, None], cache_ovf.value
+            )
+            o = jnp.where(poison, jnp.nan, o)
             o = o.astype(cfg.dtype).reshape(B, L, cfg.d_model)
             return _dense(cfg.d_model, "out", ("heads", "embed"), cfg.dtype)(o)
 

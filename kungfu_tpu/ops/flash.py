@@ -631,12 +631,19 @@ def flash_attention(
     `window` (sliding-window / local attention, requires causal): each
     query attends only the last `window` positions; masked AND skipped at
     block granularity, so compute is O(L*window) not O(L^2).
+
+    Backward selection: KFT_FLASH_BWD=xla swaps the Pallas backward for
+    the blocked-XLA one, read at TRACE time — a jit compiled before the
+    env var changes keeps the backward it was traced with (jit caches key
+    on shapes, not env).  It is an A/B benchmarking switch; build fresh
+    jits around it (the attention bench does), don't flip it mid-session
+    and expect cached callers to follow.
     """
     b, l, h, d = q.shape
     hkv = k.shape[2]
     assert h % hkv == 0 and v.shape[2] == hkv, (q.shape, k.shape, v.shape)
     w = int(window) if window else 0
-    assert w >= 0, "window must be positive (None/0 = unlimited)"
+    assert w >= 0, "window must be non-negative (None/0 = unlimited)"
     assert w == 0 or causal, "sliding window requires causal attention"
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
     bq = min(block_q, max(8, l))
@@ -676,7 +683,7 @@ def flash_attention_with_lse(
     hkv = k.shape[2]
     assert h % hkv == 0 and v.shape[2] == hkv, (q.shape, k.shape, v.shape)
     w = int(window) if window else 0
-    assert w >= 0, "window must be positive (None/0 = unlimited)"
+    assert w >= 0, "window must be non-negative (None/0 = unlimited)"
     assert w == 0 or causal, "sliding window requires causal attention"
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
     bq = min(block_q, max(8, l))

@@ -63,6 +63,13 @@ def rules_for_mesh(mesh: Mesh, rules=DEFAULT_RULES) -> Tuple[Tuple[str, Optional
             out.append((l, "fsdp"))
         elif l == "vocab" and fsdp_defaults and "tp" not in names:
             out.append((l, "fsdp"))
+        elif isinstance(m, tuple):
+            # tuple-valued mapping (e.g. batch -> ("dp","fsdp")): keep the
+            # axes this mesh actually has
+            axes = tuple(a for a in m if a in names)
+            out.append(
+                (l, axes if len(axes) > 1 else (axes[0] if axes else None))
+            )
         else:
             out.append((l, m if (m in names) else None))
     if fsdp_defaults and "tp" not in names:
@@ -75,6 +82,23 @@ def rules_for_mesh(mesh: Mesh, rules=DEFAULT_RULES) -> Tuple[Tuple[str, Optional
         # fully rematerialize the batch-sharded activations (observed in
         # the dp x fsdp dryrun).
         out.sort(key=lambda r: 0 if r[0] == "vocab" else 1)
+    if "fsdp" in names and not fsdp_defaults:
+        # custom rules on an fsdp mesh: the ZeRO rewrite above is
+        # identity-gated on DEFAULT_RULES, so a caller passing their own
+        # table (even a copied default) must map the fsdp axis themselves
+        # — otherwise params silently replicate.  Surface it.
+        used = set()
+        for _, m in out:
+            used.update(m if isinstance(m, tuple) else (m,))
+        if "fsdp" not in used:
+            from ..utils import get_logger
+
+            get_logger("kungfu.sharding").warning(
+                "mesh has an 'fsdp' axis but the custom rules table never "
+                "maps it: parameters will be fully replicated.  Map a "
+                "logical dim to 'fsdp' (DEFAULT_RULES does this "
+                "automatically) or drop the axis."
+            )
     return tuple(out)
 
 
