@@ -628,10 +628,13 @@ def config_resnet_roofline() -> dict:
     same timing).  The record shows whether the HBM-bound step moves when
     activation bytes do — the "optimize, don't narrate" evidence.
     """
+    # both levers are pinned in EVERY variant ("" = off): children inherit
+    # the matrix process's environment, so an ambient KFT_BENCH_STEM /
+    # KFT_BENCH_REMAT export would otherwise silently mislabel the rows
     variants = [
-        ("baseline", {}),
-        ("s2d-stem", {"KFT_BENCH_STEM": "s2d"}),
-        ("remat", {"KFT_BENCH_REMAT": "1"}),
+        ("baseline", {"KFT_BENCH_STEM": "", "KFT_BENCH_REMAT": ""}),
+        ("s2d-stem", {"KFT_BENCH_STEM": "s2d", "KFT_BENCH_REMAT": ""}),
+        ("remat", {"KFT_BENCH_STEM": "", "KFT_BENCH_REMAT": "1"}),
         ("s2d+remat", {"KFT_BENCH_STEM": "s2d", "KFT_BENCH_REMAT": "1"}),
     ]
     batch = os.environ.get("KFT_ROOFLINE_BATCH", "128")
@@ -654,6 +657,10 @@ def config_resnet_roofline() -> dict:
                     img_per_sec_per_chip=round(d["img_per_sec_per_chip"], 2),
                     step_ms=round(d["step_ms"], 2),
                     compiled_bytes_per_step=d.get("compiled_bytes_per_step"),
+                    # provenance straight from the child: detects any
+                    # env-plumbing mismatch in the record itself
+                    stem=d.get("stem"),
+                    remat=d.get("remat"),
                 )
                 break
         else:

@@ -94,8 +94,14 @@ class ResNet(nn.Module):
         for i, block_count in enumerate(self.stage_sizes):
             for j in range(block_count):
                 strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                # explicit stable name: nn.remat renames auto-scoped
+                # modules (remat(CheckpointBottleneckBlock_N)), which would
+                # fork the param tree between remat on/off — with the name
+                # pinned, both variants share one tree and one same-seed
+                # init, so the A/B really is the same network
                 x = block_cls(
-                    filters=self.width * 2 ** i, strides=strides, conv=conv, norm=norm
+                    filters=self.width * 2 ** i, strides=strides, conv=conv,
+                    norm=norm, name=f"stage{i}_block{j}",
                 )(x)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)

@@ -10,6 +10,10 @@ import pytest
 
 from kungfu_tpu.checkpoint import CheckpointManager
 
+# compile-heavy: excluded from the fast dev loop (pytest -m 'not slow');
+# CI runs the full suite unfiltered
+pytestmark = pytest.mark.slow
+
 
 def _state(scale: float):
     params = {"w": jnp.full((4, 3), scale, jnp.float32), "b": jnp.zeros((3,), jnp.float32)}

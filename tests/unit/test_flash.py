@@ -9,6 +9,10 @@ import jax.numpy as jnp
 from kungfu_tpu.ops.flash import flash_attention
 from kungfu_tpu.parallel.ring_attention import full_attention
 
+# compile-heavy: excluded from the fast dev loop (pytest -m 'not slow');
+# CI runs the full suite unfiltered
+pytestmark = pytest.mark.slow
+
 
 def _rand(b, l, h, d, dtype=jnp.float32, seed=0):
     ks = jax.random.split(jax.random.PRNGKey(seed), 3)

@@ -13,6 +13,10 @@ from kungfu_tpu.optimizers import synchronous_sgd
 from kungfu_tpu.plan import make_mesh
 from kungfu_tpu.train import DataParallelTrainer
 
+# compile-heavy: excluded from the fast dev loop (pytest -m 'not slow');
+# CI runs the full suite unfiltered
+pytestmark = pytest.mark.slow
+
 
 def _setup():
     model = MLP(hidden=(32,), num_classes=10)

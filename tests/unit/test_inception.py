@@ -1,11 +1,17 @@
 """InceptionV3: canonical topology (param count matches the public
 23.83M-parameter InceptionV3 without aux head) and a real tiny forward."""
+import pytest
+
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 
 from kungfu_tpu.models.inception import InceptionV3
+
+# compile-heavy: excluded from the fast dev loop (pytest -m 'not slow');
+# CI runs the full suite unfiltered
+pytestmark = pytest.mark.slow
 
 
 def test_param_count_matches_canonical():

@@ -20,6 +20,10 @@ from kungfu_tpu.parallel.sharding import rules_for_mesh
 from kungfu_tpu.parallel.pp import pipeline_apply, stack_stage_params
 from kungfu_tpu.plan import make_mesh
 
+# compile-heavy: excluded from the fast dev loop (pytest -m 'not slow');
+# CI runs the full suite unfiltered
+pytestmark = pytest.mark.slow
+
 
 class TestRingAttention:
     @pytest.mark.parametrize("causal", [True, False], ids=["causal", "bidir"])

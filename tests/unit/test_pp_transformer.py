@@ -13,6 +13,10 @@ from kungfu_tpu.models.transformer import TransformerConfig, TransformerLM, lm_l
 from kungfu_tpu.parallel.pp_transformer import PipelinedLM
 from kungfu_tpu.plan import MeshSpec, make_mesh
 
+# compile-heavy: excluded from the fast dev loop (pytest -m 'not slow');
+# CI runs the full suite unfiltered
+pytestmark = pytest.mark.slow
+
 
 def _mesh(**spec):
     import numpy as np

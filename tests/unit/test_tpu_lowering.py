@@ -19,7 +19,8 @@ import jax
 import jax.numpy as jnp
 import optax
 
-pytestmark = pytest.mark.filterwarnings("ignore")
+# compile-heavy: excluded from the fast dev loop (pytest -m "not slow")
+pytestmark = [pytest.mark.filterwarnings("ignore"), pytest.mark.slow]
 
 
 @contextlib.contextmanager

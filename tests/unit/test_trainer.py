@@ -13,6 +13,10 @@ from kungfu_tpu.models.transformer import (
 from kungfu_tpu.plan import MeshSpec, make_mesh
 from kungfu_tpu.trainer import MeshTrainer
 
+# compile-heavy: excluded from the fast dev loop (pytest -m 'not slow');
+# CI runs the full suite unfiltered
+pytestmark = pytest.mark.slow
+
 
 def _loss_fn(model, params, toks):
     return lm_loss(model.apply({"params": params}, toks), toks)

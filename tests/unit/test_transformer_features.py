@@ -14,6 +14,10 @@ from kungfu_tpu.models.transformer import (
 )
 from kungfu_tpu.plan import make_mesh
 
+# compile-heavy: excluded from the fast dev loop (pytest -m 'not slow');
+# CI runs the full suite unfiltered
+pytestmark = pytest.mark.slow
+
 
 def _base(**kw):
     kw.setdefault("vocab_size", 64)

@@ -17,6 +17,10 @@ from kungfu_tpu.parallel.ring_attention import full_attention
 from kungfu_tpu.parallel.ulysses import ulysses_attention
 from kungfu_tpu.plan import make_mesh
 
+# compile-heavy: excluded from the fast dev loop (pytest -m 'not slow');
+# CI runs the full suite unfiltered
+pytestmark = pytest.mark.slow
+
 SPEC = P(None, "sp", None, None)
 
 
