@@ -541,17 +541,26 @@ def config_gpt_mfu(steps: int = 8) -> dict:
         causal=True, rope=True, attention="auto",
     )
     rows, best = [], None
-    for batch in dict.fromkeys((int(os.environ.get("KFT_GPT_BATCH", "8")), 4)):
+    b0 = int(os.environ.get("KFT_GPT_BATCH", "8"))
+    # remat=True stores only block inputs (the long-seq memory lever): at
+    # seq 2048 it can unlock a batch the plain variant OOMs on, and the
+    # A/B shows which side of the FLOPs-vs-HBM trade v5e lands on.  It
+    # runs LAST: a novel dispatch can wedge the tunnel (hang, not raise),
+    # and the known-safe rows must already be recorded by then.
+    for batch, remat in dict.fromkeys(
+        ((b0, False), (max(b0 // 2, 1), False), (b0, True))
+    ):
         try:
             d = _lm_throughput(
                 synchronous_sgd(optax.adamw(3e-4, b1=0.9, b2=0.95)),
                 per_replica=False, batch_per_chip=batch, steps=steps,
-                seq_len=2048, cfg_overrides=overrides,
+                seq_len=2048, cfg_overrides={**overrides, "remat": remat},
             )
         except Exception as e:
-            rows.append({"batch_per_chip": batch,
+            rows.append({"batch_per_chip": batch, "remat": remat,
                          "error": f"{type(e).__name__}: {e}"})
             continue
+        d["remat"] = remat
         rows.append(d)
         if best is None or d["tokens_per_sec_per_chip"] > best["tokens_per_sec_per_chip"]:
             best = d
@@ -566,6 +575,7 @@ def config_gpt_mfu(steps: int = 8) -> dict:
         "seq_len": 2048,
         "n_params": best["n_params"],
         "batch_per_chip": best["batch_per_chip"],
+        "remat": best.get("remat"),
         "step_ms": best["step_ms"],
         "backend": best["backend"],
         "rows": rows,

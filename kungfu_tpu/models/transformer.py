@@ -74,6 +74,10 @@ class TransformerConfig:
     dropout: float = 0.0
     # share the input embedding matrix with the lm_head (logits = x @ E^T)
     tie_embeddings: bool = False
+    # checkpoint each transformer block: trade ~1/3 extra forward FLOPs
+    # for not storing per-layer activations — the standard long-sequence
+    # memory lever (jax.checkpoint / nn.remat per block)
+    remat: bool = False
     # MoE: every `moe_every`-th block uses experts (0 = dense model)
     n_experts: int = 0
     moe_every: int = 2
@@ -418,9 +422,17 @@ class TransformerLM(nn.Module):
             x = x + p
         x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
         x = logical_constraint(x, ("batch", "seq", "act_embed"), cfg.mesh)
+        # per-block remat: backward recomputes each block's forward
+        # instead of reading every intermediate from HBM — at seq 2048+
+        # the saved activations (~O(10 * B*L*D) bf16 per layer) dominate
+        # HBM, and recompute costs ~1/3 extra forward FLOPs.  Stable
+        # block_{i} names keep the param tree identical across the flag.
+        block_cls = nn.remat(
+            Block, static_argnums=(2,)
+        ) if cfg.remat else Block
         for i in range(cfg.n_layers):
             use_moe = cfg.n_experts > 0 and (i % cfg.moe_every == cfg.moe_every - 1)
-            x = Block(cfg, use_moe=use_moe, name=f"block_{i}")(x, train=train)
+            x = block_cls(cfg, use_moe=use_moe, name=f"block_{i}")(x, train)
         x = nn.LayerNorm(dtype=jnp.float32, use_bias=False, name="ln_f",
                          scale_init=nn.with_logical_partitioning(nn.initializers.ones, ("norm",)))(x)
         if cfg.tie_embeddings:

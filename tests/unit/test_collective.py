@@ -69,6 +69,35 @@ class TestAllReduce:
         for x, o in zip(xs, outs):
             np.testing.assert_allclose(np.asarray(o)[0], x.sum(axis=0), rtol=1e-5)
 
+    def test_group_fused_matches_unfused(self, sess):
+        """Fused (one compiled program) == per-tensor dispatch == numpy,
+        across strategies, mixed dtypes/shapes, and a non-sum op."""
+        rng = np.random.RandomState(7)
+        n = sess.size
+        xs_np = [
+            rng.randn(n, 5).astype(np.float32),
+            rng.randn(n, 3, 4).astype(np.float64),
+            rng.randint(0, 100, size=(n, 7)).astype(np.int32),
+            rng.randn(n).astype(np.float32),
+        ]
+        for strat in (None, Strategy.RING, Strategy.CLIQUE):
+            fused = sess.group_all_reduce(xs_np, fuse=True, strategy=strat)
+            unfused = sess.group_all_reduce(xs_np, fuse=False, strategy=strat)
+            for x_np, f, u in zip(xs_np, fused, unfused):
+                want = np.broadcast_to(
+                    x_np.sum(axis=0, keepdims=True), x_np.shape
+                )
+                np.testing.assert_allclose(np.asarray(f), want, rtol=1e-5)
+                np.testing.assert_allclose(
+                    np.asarray(f), np.asarray(u), rtol=1e-6
+                )
+        mx = sess.group_all_reduce(xs_np[:2], op="max", fuse=True)
+        np.testing.assert_allclose(
+            np.asarray(mx[0]),
+            np.broadcast_to(xs_np[0].max(axis=0, keepdims=True), xs_np[0].shape),
+            rtol=1e-6,
+        )
+
 
 class TestOtherCollectives:
     def test_broadcast(self, sess):
