@@ -401,6 +401,10 @@ def _run_child(args_list, timeout, env_extra=None):
     env = dict(os.environ)
     repo = os.path.dirname(os.path.abspath(__file__))
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    # persistent compile cache: each config runs in a fresh process, and
+    # on the tunnel a recompile costs real window time — cached XLA
+    # binaries make retries nearly free (backends that can't cache ignore)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/kft_jax_cache")
     if env_extra:
         env.update(env_extra)
     p = subprocess.Popen(

@@ -120,6 +120,9 @@ def _run(cmd, timeout, env_extra=None):
     env = dict(os.environ)
     env.setdefault("PYTHONPATH", "")
     env["PYTHONPATH"] = _REPO + os.pathsep + env["PYTHONPATH"]
+    # persistent compile cache across config subprocesses (see bench.py):
+    # retries after a tunnel wedge skip the recompile
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/kft_jax_cache")
     if env_extra:
         env.update(env_extra)
     p = subprocess.Popen(
@@ -199,8 +202,16 @@ def _lm_throughput(tx, per_replica: bool, batch_per_chip: int, steps: int,
     n_chips = len(jax.devices())
     global_batch = batch_per_chip * n_chips
 
-    def loss_fn(params, batch):
-        return lm_loss(model.apply({"params": params}, batch), batch)
+    if cfg.head == "hidden":
+        from ..models.transformer import lm_loss_chunked
+
+        ce_block = int(os.environ.get("KFT_CE_BLOCK", "2048"))
+
+        def loss_fn(params, batch):
+            return lm_loss_chunked(model, params, batch, block=ce_block)
+    else:
+        def loss_fn(params, batch):
+            return lm_loss(model.apply({"params": params}, batch), batch)
 
     import flax.linen as nn
 
@@ -542,25 +553,32 @@ def config_gpt_mfu(steps: int = 8) -> dict:
     )
     rows, best = [], None
     b0 = int(os.environ.get("KFT_GPT_BATCH", "8"))
-    # remat=True stores only block inputs (the long-seq memory lever): at
-    # seq 2048 it can unlock a batch the plain variant OOMs on, and the
-    # A/B shows which side of the FLOPs-vs-HBM trade v5e lands on.  It
-    # runs LAST: a novel dispatch can wedge the tunnel (hang, not raise),
-    # and the known-safe rows must already be recorded by then.
-    for batch, remat in dict.fromkeys(
-        ((b0, False), (max(b0 // 2, 1), False), (b0, True))
-    ):
+    # Ordered safe-first: plain rows, then the chunked-CE head (streams
+    # the [B,L,V] logits away — ops/chunked_ce), then remat.  The novel
+    # dispatches run LAST: a wedge (hang, not raise) must find the
+    # known-safe rows already recorded.
+    for batch, remat, chunked in dict.fromkeys((
+        (b0, False, False),
+        (max(b0 // 2, 1), False, False),
+        (b0, False, True),
+        (b0, True, False),
+    )):
+        ov = {**overrides, "remat": remat}
+        if chunked:
+            ov["head"] = "hidden"
         try:
             d = _lm_throughput(
                 synchronous_sgd(optax.adamw(3e-4, b1=0.9, b2=0.95)),
                 per_replica=False, batch_per_chip=batch, steps=steps,
-                seq_len=2048, cfg_overrides={**overrides, "remat": remat},
+                seq_len=2048, cfg_overrides=ov,
             )
         except Exception as e:
             rows.append({"batch_per_chip": batch, "remat": remat,
+                         "chunked_ce": chunked,
                          "error": f"{type(e).__name__}: {e}"})
             continue
         d["remat"] = remat
+        d["chunked_ce"] = chunked
         rows.append(d)
         if best is None or d["tokens_per_sec_per_chip"] > best["tokens_per_sec_per_chip"]:
             best = d
@@ -576,6 +594,7 @@ def config_gpt_mfu(steps: int = 8) -> dict:
         "n_params": best["n_params"],
         "batch_per_chip": best["batch_per_chip"],
         "remat": best.get("remat"),
+        "chunked_ce": best.get("chunked_ce"),
         "step_ms": best["step_ms"],
         "backend": best["backend"],
         "rows": rows,

@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 import flax.linen as nn
 
+from ..ops.chunked_ce import chunked_lm_head_ll
 from ..parallel.sharding import logical_constraint
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -78,6 +79,12 @@ class TransformerConfig:
     # for not storing per-layer activations — the standard long-sequence
     # memory lever (jax.checkpoint / nn.remat per block)
     remat: bool = False
+    # "dense" returns [B, L, V] logits; "hidden" returns the final hidden
+    # states and defers the head to a streaming loss (lm_loss_chunked /
+    # ops/chunked_ce) that never materializes the logits tensor — the
+    # large-vocab memory/HBM lever.  The param tree is identical either
+    # way (the head kernel is created at init in both modes).
+    head: str = "dense"
     # MoE: every `moe_every`-th block uses experts (0 = dense model)
     n_experts: int = 0
     moe_every: int = 2
@@ -111,6 +118,9 @@ class TransformerConfig:
                 "sliding window is supported on the flash/full paths"
             )
         assert self.ffn in ("gelu", "swiglu"), self.ffn
+        assert self.head in ("dense", "hidden"), self.head
+        if self.decode:
+            assert self.head == "dense", "decode/generation needs logits"
 
     @property
     def kv_heads(self) -> int:
@@ -435,6 +445,13 @@ class TransformerLM(nn.Module):
             x = block_cls(cfg, use_moe=use_moe, name=f"block_{i}")(x, train)
         x = nn.LayerNorm(dtype=jnp.float32, use_bias=False, name="ln_f",
                          scale_init=nn.with_logical_partitioning(nn.initializers.ones, ("norm",)))(x)
+        if cfg.head == "hidden":
+            # deferred head: the streaming loss (lm_loss_chunked) consumes
+            # hidden states + the head kernel directly.  Touch the head at
+            # init so the param tree matches head="dense" exactly.
+            if not cfg.tie_embeddings and self.is_initializing():
+                _Head(cfg, name="lm_head")(x[:, :1])
+            return x
         if cfg.tie_embeddings:
             # logits = x @ E^T with the INPUT embedding, in f32 to match
             # the untied lm_head's precision (bf16 logits would noisily
@@ -489,7 +506,12 @@ def generate(
     assert prompt_len + max_new_tokens <= cfg.max_len, (
         f"{prompt_len}+{max_new_tokens} exceeds max_len={cfg.max_len}"
     )
-    dcfg = dataclasses.replace(cfg, decode=True, attention="full", mesh=None)
+    # decode overrides: full attention on the cache, no mesh, and a dense
+    # head (a head="hidden"-trained config shares the same param tree, so
+    # its params decode unchanged)
+    dcfg = dataclasses.replace(
+        cfg, decode=True, attention="full", mesh=None, head="dense"
+    )
     if rng is None:
         rng = jax.random.PRNGKey(0)
     run = _generate_compiled(dcfg, b, prompt_len, max_new_tokens, temperature)
@@ -560,6 +582,39 @@ def lm_loss(
     bf16 pretraining runs.
     """
     ll, log_z = _token_ll(logits[:, :-1], tokens[:, 1:])
+    loss = -jnp.mean(ll)
+    if z_loss:
+        loss = loss + z_loss * jnp.mean(log_z ** 2)
+    return loss
+
+
+def lm_loss_chunked(
+    model: "TransformerLM", params, tokens: jax.Array, block: int = 2048,
+    z_loss: float = 0.0,
+) -> jax.Array:
+    """`lm_loss` without materializing [B, L, V] logits.
+
+    Requires a model configured with head="hidden": the forward returns
+    final hidden states and the head matmul + log-softmax stream over
+    vocab blocks (ops/chunked_ce — recomputed in backward).  At GPT scale
+    the logits tensor is the single largest activation; this removes it.
+    """
+    cfg = model.cfg
+    assert cfg.head == "hidden", 'lm_loss_chunked needs TransformerConfig(head="hidden")'
+    h = model.apply({"params": params}, tokens)  # [B, L, D] f32 (ln_f)
+    if cfg.tie_embeddings:
+        w = params["embed"]["embedding"].astype(jnp.float32).T
+    else:
+        w = params["lm_head"]["kernel"]
+    # same use-site gather contract as _Head: keep tp vocab-parallelism,
+    # gather fsdp storage dims so the streamed matmuls never pull the
+    # activations onto the kernel's layout (the involuntary-remat
+    # pathology _Head documents)
+    w = logical_constraint(w, (None, "act_vocab"), cfg.mesh)
+    b, l, d = h.shape
+    ll, log_z = chunked_lm_head_ll(
+        h[:, :-1].reshape(-1, d), w, tokens[:, 1:].reshape(-1), block
+    )
     loss = -jnp.mean(ll)
     if z_loss:
         loss = loss + z_loss * jnp.mean(log_z ** 2)

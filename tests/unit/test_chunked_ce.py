@@ -1,0 +1,119 @@
+"""Chunked lm-head CE == dense logits + log-softmax, values and gradients."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kungfu_tpu.ops.chunked_ce import chunked_lm_head_ll
+
+
+def _dense_ll(h, w, targets):
+    logits = h.astype(jnp.float32) @ w.astype(jnp.float32)
+    log_z = jax.scipy.special.logsumexp(logits, axis=1)
+    tl = jnp.take_along_axis(logits, targets[:, None], axis=1)[:, 0]
+    return tl - log_z, log_z
+
+
+@pytest.mark.parametrize("v,block", [(50, 16), (64, 16), (33, 64), (128, 128)])
+def test_matches_dense(v, block):
+    rng = np.random.RandomState(0)
+    n, d = 12, 8
+    h = jnp.asarray(rng.randn(n, d), jnp.float32)
+    w = jnp.asarray(rng.randn(d, v) * 0.3, jnp.float32)
+    t = jnp.asarray(rng.randint(0, v, n), jnp.int32)
+    ll, lz = chunked_lm_head_ll(h, w, t, block)
+    ll_d, lz_d = _dense_ll(h, w, t)
+    np.testing.assert_allclose(np.asarray(ll), np.asarray(ll_d), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(lz), np.asarray(lz_d), rtol=1e-5)
+
+
+@pytest.mark.parametrize("z_weight", [0.0, 0.3])
+def test_grads_match_dense(z_weight):
+    rng = np.random.RandomState(1)
+    n, d, v, block = 10, 6, 40, 16
+    h = jnp.asarray(rng.randn(n, d), jnp.float32)
+    w = jnp.asarray(rng.randn(d, v) * 0.3, jnp.float32)
+    t = jnp.asarray(rng.randint(0, v, n), jnp.int32)
+
+    def loss_chunked(h, w):
+        ll, lz = chunked_lm_head_ll(h, w, t, block)
+        return -jnp.mean(ll) + z_weight * jnp.mean(lz ** 2)
+
+    def loss_dense(h, w):
+        ll, lz = _dense_ll(h, w, t)
+        return -jnp.mean(ll) + z_weight * jnp.mean(lz ** 2)
+
+    lc, (dhc, dwc) = jax.value_and_grad(loss_chunked, argnums=(0, 1))(h, w)
+    ld, (dhd, dwd) = jax.value_and_grad(loss_dense, argnums=(0, 1))(h, w)
+    np.testing.assert_allclose(float(lc), float(ld), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(dhc), np.asarray(dhd), rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(dwc), np.asarray(dwd), rtol=1e-4, atol=1e-6)
+
+
+def test_bf16_inputs_f32_math():
+    rng = np.random.RandomState(2)
+    n, d, v = 8, 4, 24
+    h32 = rng.randn(n, d).astype(np.float32)
+    w32 = (rng.randn(d, v) * 0.3).astype(np.float32)
+    t = jnp.asarray(rng.randint(0, v, n), jnp.int32)
+    h = jnp.asarray(h32, jnp.bfloat16)
+    w = jnp.asarray(w32, jnp.bfloat16)
+    ll, _ = chunked_lm_head_ll(h, w, t, 8)
+    ll_d, _ = _dense_ll(h.astype(jnp.float32), w.astype(jnp.float32), t)
+    np.testing.assert_allclose(np.asarray(ll), np.asarray(ll_d), rtol=1e-5)
+    # grads come back in the input dtypes
+    g = jax.grad(lambda h, w: -jnp.mean(chunked_lm_head_ll(h, w, t, 8)[0]),
+                 argnums=(0, 1))(h, w)
+    assert g[0].dtype == jnp.bfloat16 and g[1].dtype == jnp.bfloat16
+
+
+def test_jit_and_under_vmap_free_scan():
+    """Compiles under jit; block not dividing V exercises padding."""
+    rng = np.random.RandomState(3)
+    n, d, v, block = 16, 8, 100, 32
+    h = jnp.asarray(rng.randn(n, d), jnp.float32)
+    w = jnp.asarray(rng.randn(d, v) * 0.2, jnp.float32)
+    t = jnp.asarray(rng.randint(0, v, n), jnp.int32)
+    f = jax.jit(lambda h, w: -jnp.mean(chunked_lm_head_ll(h, w, t, block)[0]))
+    l1 = float(f(h, w))
+    ll_d, _ = _dense_ll(h, w, t)
+    np.testing.assert_allclose(l1, float(-jnp.mean(ll_d)), rtol=1e-5)
+
+
+def test_lm_loss_chunked_matches_dense_model():
+    """head='hidden' + lm_loss_chunked == head='dense' + lm_loss, same
+    param tree, same loss, same grads."""
+    import optax  # noqa: F401  (parity with other model tests' imports)
+    import flax.linen as nn
+
+    from kungfu_tpu.models.transformer import (
+        TransformerConfig, TransformerLM, lm_loss, lm_loss_chunked,
+    )
+
+    rng = np.random.RandomState(4)
+    toks = jnp.asarray(rng.randint(0, 64, (2, 16)), jnp.int32)
+    kw = dict(vocab_size=64, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+              max_len=16, dtype=jnp.float32, rope=True)
+    dense = TransformerLM(TransformerConfig(**kw))
+    hidden = TransformerLM(TransformerConfig(head="hidden", **kw))
+    p_dense = nn.meta.unbox(dense.init(jax.random.PRNGKey(0), toks)["params"])
+    p_hidden = nn.meta.unbox(hidden.init(jax.random.PRNGKey(0), toks)["params"])
+    # identical trees AND values (the deferred head is created at init)
+    assert jax.tree.structure(p_dense) == jax.tree.structure(p_hidden)
+    for a, b in zip(jax.tree.leaves(p_dense), jax.tree.leaves(p_hidden)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def loss_d(p):
+        return lm_loss(dense.apply({"params": p}, toks), toks, z_loss=1e-4)
+
+    def loss_c(p):
+        return lm_loss_chunked(hidden, p, toks, block=16, z_loss=1e-4)
+
+    ld, gd = jax.value_and_grad(loss_d)(p_dense)
+    lc, gc = jax.value_and_grad(loss_c)(p_dense)
+    np.testing.assert_allclose(float(lc), float(ld), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(gd), jax.tree.leaves(gc)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6
+        )
