@@ -16,6 +16,7 @@ Reported numbers:
 """
 from __future__ import annotations
 
+import functools
 import os
 import time
 from dataclasses import dataclass
@@ -187,24 +188,28 @@ def bench_attention(
 
     steps = max(1, steps)
     warmup = max(1, warmup)  # first call is compile; timing it is never wanted
+    # "flash" is the shipping default (auto backward selection); the forced
+    # pallas/xla arms expose the A/B the auto heuristic is calibrated on
     impls = [("flash", flash_attention, None), ("full", full_attention, None)]
     if grad:
-        # A/B the Pallas backward against the blocked-XLA backward (the
-        # KFT_FLASH_BWD switch is read at trace time, so it must be set
-        # while the impl compiles)
+        if jax.default_backend() == "tpu":
+            # forced-pallas off-TPU would run the interpreter on real bench
+            # shapes (effectively a hang) — the compiled-kernel arm is
+            # TPU-only, matching flash.py's own env-knob guard
+            impls.append(("flash_pallas_bwd", flash_attention, "pallas"))
         impls.append(("flash_xla_bwd", flash_attention, "xla"))
     out: Dict[str, float] = {}
     for name, fn, bwd in impls:
-        prev = os.environ.get("KFT_FLASH_BWD")
-        if bwd is not None:
-            os.environ["KFT_FLASH_BWD"] = bwd
-        else:
-            # pin the default arms too: a stray KFT_FLASH_BWD=xla in the
-            # environment would silently turn the "flash" row into the XLA
-            # backward and void the A/B
-            os.environ.pop("KFT_FLASH_BWD", None)
+        # stray KFT_FLASH_BWD / KFT_FLASH_BWD_AUTO_SEQ exports would
+        # silently skew the default arm's auto selection and void the A/B
+        # — pin both off for all arms
+        prev = os.environ.pop("KFT_FLASH_BWD", None)
+        prev_seq = os.environ.pop("KFT_FLASH_BWD_AUTO_SEQ", None)
         try:
-            f = make(fn)
+            f = make(
+                functools.partial(fn, backward=bwd)
+                if fn is flash_attention else fn
+            )
             for _ in range(warmup):
                 r = f(q, k, v)
             sync(r)
@@ -213,10 +218,10 @@ def bench_attention(
                 r = f(q, k, v)
             sync(r)
         finally:
-            if prev is None:
-                os.environ.pop("KFT_FLASH_BWD", None)
-            else:
+            if prev is not None:
                 os.environ["KFT_FLASH_BWD"] = prev
+            if prev_seq is not None:
+                os.environ["KFT_FLASH_BWD_AUTO_SEQ"] = prev_seq
         dt = (time.perf_counter() - t0) / steps
         out[name] = dt
         print(

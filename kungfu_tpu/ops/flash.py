@@ -521,34 +521,75 @@ def _bwd_blocked(q, k, v, o, lse, g, scale: float, causal: bool,
     return (dq * scale).astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
+def _bwd_auto_seq() -> int:
+    """Below this many query positions the one-pass blocked-XLA backward
+    beats the two-kernel Pallas backward on-chip (measured:
+    BENCH_CONFIGS.json attention-flash-vs-full — xla wins at 1024/2048,
+    Pallas wins at 4096).  Read at trace time so the env knob works
+    whenever it is set (jits compiled earlier keep their traced choice).
+    Malformed values fall back to the default, like KFT_FLASH_BWD."""
+    try:
+        return int(os.environ.get("KFT_FLASH_BWD_AUTO_SEQ", "4096"))
+    except ValueError:
+        return 4096
+
+
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10)
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11)
 )
 def _flash_bhld(q, k, v, scale, causal, block_q, block_k, interpret, h, hkv,
-                window):
+                window, backward):
     o, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
                       h, hkv, window)
     return o
 
 
 def _flash_bhld_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
-                    h, hkv, window):
+                    h, hkv, window, backward):
     o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
                         h, hkv, window)
     return o, (q, k, v, o, lse)
 
 
 def _dispatch_bwd(q, k, v, o, lse, g, scale, causal, block_q, block_k,
-                  interpret, g_lse=None, h=1, hkv=1, window=0):
-    """Pallas backward wherever the forward ran the kernel (TPU, or explicit
-    interpret=True in tests); the XLA blocked backward off-TPU and under
-    KFT_FLASH_BWD=xla (the A/B switch the attention bench flips)."""
-    # explicit interpret (True OR False) means the caller forced the kernel
-    # in the forward — mirror it in the backward; None auto-selects by
-    # backend like the forward does
-    use_kernel = True if interpret is not None else not _use_interpret()
-    if os.environ.get("KFT_FLASH_BWD") == "xla":
+                  interpret, g_lse=None, h=1, hkv=1, window=0,
+                  backward=None):
+    """Backward selection, strongest claim first:
+
+    1. explicit `backward=` ("pallas" | "xla") from the caller;
+    2. KFT_FLASH_BWD env (trace-time A/B switch, see flash_attention doc);
+    3. off-TPU (and no forced interpret): blocked XLA — it lowers anywhere;
+    4. auto by shape: Pallas when the work is kernel-shaped (sliding window
+       — the kernel skips dead blocks, XLA can't — GQA, or seq >=
+       KFT_FLASH_BWD_AUTO_SEQ), blocked XLA below that, where its single
+       pass (5 matmuls vs the two-kernel Pallas split's 7) wins on-chip.
+    """
+    if backward is None:
+        # tolerate unrecognized env values (legacy behavior: only the exact
+        # strings select; KFT_FLASH_BWD=0/true/... falls through to auto).
+        # env "pallas" is honored only where the kernel runs compiled: on
+        # CPU it would silently force the orders-of-magnitude-slower
+        # interpreter (a stale export was a no-op before this knob existed)
+        env = os.environ.get("KFT_FLASH_BWD")
+        if env == "xla":
+            backward = "xla"
+        elif env == "pallas" and (interpret is not None or not _use_interpret()):
+            backward = "pallas"
+    if backward is not None:
+        # entry points validate user input at call time; by here the value
+        # is one of the two known strings
+        use_kernel = backward == "pallas"
+    elif interpret is not None:
+        # explicit interpret (True OR False) means the caller forced the
+        # kernel in the forward — mirror it in the backward
+        use_kernel = True
+    elif _use_interpret():
         use_kernel = False
+    else:
+        seq_len = q.shape[1]
+        use_kernel = bool(
+            window > 0 or h != hkv or seq_len >= _bwd_auto_seq()
+        )
     if use_kernel:
         return _bwd_pallas(
             q, k, v, o, lse, g, scale, causal, block_q, block_k,
@@ -574,38 +615,39 @@ def _dispatch_bwd(q, k, v, o, lse, g, scale, causal, block_q, block_k,
 
 
 def _flash_bhld_bwd(scale, causal, block_q, block_k, interpret, h, hkv,
-                    window, res, g):
+                    window, backward, res, g):
     q, k, v, o, lse = res
     return _dispatch_bwd(q, k, v, o, lse, g, scale, causal, block_q, block_k,
-                         interpret, h=h, hkv=hkv, window=window)
+                         interpret, h=h, hkv=hkv, window=window,
+                         backward=backward)
 
 
 _flash_bhld.defvjp(_flash_bhld_fwd, _flash_bhld_bwd)
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10)
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11)
 )
 def _flash_bhld_lse(q, k, v, scale, causal, block_q, block_k, interpret,
-                    h, hkv, window):
+                    h, hkv, window, backward):
     return _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
                       h, hkv, window)
 
 
 def _flash_bhld_lse_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
-                        h, hkv, window):
+                        h, hkv, window, backward):
     o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
                         h, hkv, window)
     return (o, lse), (q, k, v, o, lse)
 
 
 def _flash_bhld_lse_bwd(scale, causal, block_q, block_k, interpret, h, hkv,
-                        window, res, g):
+                        window, backward, res, g):
     q, k, v, o, lse = res
     g_o, g_lse = g
     return _dispatch_bwd(q, k, v, o, lse, g_o, scale, causal, block_q,
                          block_k, interpret, g_lse=g_lse, h=h, hkv=hkv,
-                         window=window)
+                         window=window, backward=backward)
 
 
 _flash_bhld_lse.defvjp(_flash_bhld_lse_fwd, _flash_bhld_lse_bwd)
@@ -621,6 +663,7 @@ def flash_attention(
     block_k: int = 128,
     interpret: Optional[bool] = None,
     window: Optional[int] = None,
+    backward: Optional[str] = None,
 ) -> jax.Array:
     """Fused attention, [B, L, H, D] -> [B, L, H, D] in q's dtype.
 
@@ -632,12 +675,17 @@ def flash_attention(
     query attends only the last `window` positions; masked AND skipped at
     block granularity, so compute is O(L*window) not O(L^2).
 
-    Backward selection: KFT_FLASH_BWD=xla swaps the Pallas backward for
-    the blocked-XLA one, read at TRACE time — a jit compiled before the
-    env var changes keeps the backward it was traced with (jit caches key
-    on shapes, not env).  It is an A/B benchmarking switch; build fresh
-    jits around it (the attention bench does), don't flip it mid-session
-    and expect cached callers to follow.
+    Backward selection (`backward`): None auto-selects per shape — the
+    one-pass blocked-XLA backward below KFT_FLASH_BWD_AUTO_SEQ (default
+    4096) query positions, the Pallas kernels at/above it and whenever a
+    sliding window or GQA makes them structurally better (measured A/B:
+    BENCH_CONFIGS.json attention-flash-vs-full).  Pass "pallas" or "xla"
+    to force one — a trace-time Python constant (like causal/window), so
+    rebuilding the callable rebuilds the choice; under jit mark it static
+    (static_argnames) rather than passing it as a traced argument.
+    The legacy KFT_FLASH_BWD env var still overrides the auto choice but
+    is invisible to the jit cache — a jit compiled before the env var
+    changes keeps the backward it was traced with; prefer the argument.
     """
     b, l, h, d = q.shape
     hkv = k.shape[2]
@@ -645,6 +693,10 @@ def flash_attention(
     w = int(window) if window else 0
     assert w >= 0, "window must be non-negative (None/0 = unlimited)"
     assert w == 0 or causal, "sliding window requires causal attention"
+    if backward not in (None, "pallas", "xla"):
+        # fail at call time, not first-gradient time: a typo on an
+        # inference-only path would otherwise be silently accepted
+        raise ValueError(f"backward must be 'pallas' or 'xla', got {backward!r}")
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
     bq = min(block_q, max(8, l))
     bk = min(block_k, max(8, l))
@@ -655,7 +707,7 @@ def flash_attention(
 
     o = _flash_bhld(
         to_bhld(q), to_bhld(k), to_bhld(v), scale, causal, bq, bk, interpret,
-        h, hkv, w,
+        h, hkv, w, backward,
     )
     return o.reshape(b, h, l, d).transpose(0, 2, 1, 3)
 
@@ -670,6 +722,7 @@ def flash_attention_with_lse(
     block_k: int = 128,
     interpret: Optional[bool] = None,
     window: Optional[int] = None,
+    backward: Optional[str] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Fused attention also returning the log-sum-exp of each softmax row.
 
@@ -685,6 +738,8 @@ def flash_attention_with_lse(
     w = int(window) if window else 0
     assert w >= 0, "window must be non-negative (None/0 = unlimited)"
     assert w == 0 or causal, "sliding window requires causal attention"
+    if backward not in (None, "pallas", "xla"):
+        raise ValueError(f"backward must be 'pallas' or 'xla', got {backward!r}")
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
     bq = min(block_q, max(8, l))
     bk = min(block_k, max(8, l))
@@ -695,7 +750,7 @@ def flash_attention_with_lse(
 
     o, lse = _flash_bhld_lse(
         to_bhld(q), to_bhld(k), to_bhld(v), scale, causal, bq, bk, interpret,
-        h, hkv, w,
+        h, hkv, w, backward,
     )
     o = o.reshape(b, h, l, d).transpose(0, 2, 1, 3)
     return o, lse.reshape(b, h, l)

@@ -152,6 +152,104 @@ def test_bwd_xla_pallas_agree(monkeypatch):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
+@pytest.mark.parametrize("bwd", ["pallas", "xla"])
+def test_bwd_explicit_argument(bwd):
+    """backward= forces the chosen implementation and matches the reference
+    gradients (the argument-based form of the KFT_FLASH_BWD A/B)."""
+    q, k, v = _rand(1, 96, 2, 16, seed=13)
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True,
+                                       block_q=32, block_k=32,
+                                       interpret=True, backward=bwd) ** 2)
+
+    def ref(q, k, v):
+        return jnp.sum(full_attention(q, k, v, causal=True) ** 2)
+
+    g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
+
+
+def test_bwd_bad_argument_raises():
+    q, k, v = _rand(1, 32, 1, 16)
+    # call time, not first-gradient time: a typo on an inference-only path
+    # must not be silently accepted
+    with pytest.raises(ValueError, match="backward"):
+        flash_attention(q, k, v, causal=True, interpret=True, backward="nope")
+
+
+@pytest.mark.parametrize(
+    "l,hkv,window,auto_seq,expect",
+    [
+        (96, 2, None, 4096, "xla"),      # short seq, MHA: one-pass XLA wins
+        (96, 2, 32, 4096, "pallas"),     # sliding window: kernel skips blocks
+        (96, 1, None, 4096, "pallas"),   # GQA: kernel avoids head repeats
+        (96, 2, None, 64, "pallas"),     # seq >= KFT_FLASH_BWD_AUTO_SEQ
+    ],
+)
+def test_bwd_auto_selection(monkeypatch, l, hkv, window, auto_seq, expect):
+    """The shape-based auto heuristic picks the measured-faster backward.
+
+    The on-TPU branch is unreachable on CPU (`_use_interpret` preempts it),
+    so simulate it: pretend the backend is TPU and stub both backward
+    implementations with recorders returning shape-correct zeros."""
+    import kungfu_tpu.ops.flash as F
+
+    calls = []
+
+    def fake_pallas(q, k, v, o, lse, g, *a, **kw):
+        calls.append("pallas")
+        return jnp.zeros_like(q), jnp.zeros_like(k), jnp.zeros_like(v)
+
+    def fake_blocked(q, k, v, o, lse, g, *a, **kw):
+        calls.append("xla")
+        return jnp.zeros_like(q), jnp.zeros_like(k), jnp.zeros_like(v)
+
+    monkeypatch.setattr(F, "_use_interpret", lambda: False)
+    monkeypatch.setattr(F, "_bwd_pallas", fake_pallas)
+    monkeypatch.setattr(F, "_bwd_blocked", fake_blocked)
+    monkeypatch.delenv("KFT_FLASH_BWD", raising=False)
+    monkeypatch.setenv("KFT_FLASH_BWD_AUTO_SEQ", str(auto_seq))
+
+    h = 2
+    q, _, _ = _rand(1, l, h, 16, seed=5)
+    _, k, v = _rand(1, l, hkv, 16, seed=6)
+
+    def loss(q, k, v):
+        # interpret must stay None: forcing it would preempt the auto branch.
+        # The fwd kernel would then hit Mosaic on CPU — stub it too.
+        return jnp.sum(flash_attention(q, k, v, causal=True,
+                                       window=window) ** 2)
+
+    ref_fwd = F._fwd_reference
+
+    def fake_fwd(q, k, v, scale, causal, block_q, block_k, interpret, h_,
+                 hkv_, window_):
+        return ref_fwd(q, F._expand_kv(k, h_, hkv_),
+                       F._expand_kv(v, h_, hkv_), scale, causal, window_)
+
+    monkeypatch.setattr(F, "_flash_fwd", fake_fwd)
+    jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    assert calls and all(c == expect for c in calls), (calls, expect)
+
+
+def test_bwd_env_garbage_falls_through(monkeypatch):
+    """Unrecognized KFT_FLASH_BWD values (stale exports like '0'/'true')
+    must fall through to auto selection, not crash the trace."""
+    monkeypatch.setenv("KFT_FLASH_BWD", "0")
+    q, k, v = _rand(1, 64, 1, 16, seed=7)
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True,
+                                       block_q=32, block_k=32,
+                                       interpret=True) ** 2)
+
+    g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    assert all(np.isfinite(np.asarray(x)).all() for x in g)
+
+
 @pytest.mark.parametrize("causal", [True, False])
 def test_lse_gradient_unpadded(causal):
     """lse-cotangent path (ring merge) through the Pallas backward with an
