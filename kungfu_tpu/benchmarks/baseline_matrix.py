@@ -669,8 +669,13 @@ def config_resnet_roofline() -> dict:
     batch = os.environ.get("KFT_ROOFLINE_BATCH", "128")
     steps = os.environ.get("KFT_BENCH_STEPS", "20")
     # fresh-variant compiles over the tunnel can exceed 500s; the persistent
-    # compile cache makes retries cheap, so a longer first-run window is safe
-    per_variant_timeout = int(os.environ.get("KFT_ROOFLINE_TIMEOUT", "900"))
+    # compile cache makes retries cheap, so a longer first-run window is
+    # safe.  Malformed values fall back (unattended runs must not abort on
+    # a typo'd export)
+    try:
+        per_variant_timeout = int(os.environ.get("KFT_ROOFLINE_TIMEOUT", "900"))
+    except ValueError:
+        per_variant_timeout = 900
     rows = []
     for name, env in variants:
         try:

@@ -1,0 +1,79 @@
+"""Queue mechanics of scripts/tpu_retry.py (no tunnel involved)."""
+import os
+import sys
+import subprocess
+
+import pytest
+
+SCRIPTS = os.path.join(os.path.dirname(__file__), "..", "..", "scripts")
+sys.path.insert(0, SCRIPTS)
+
+import tpu_retry  # noqa: E402
+
+
+def test_read_queue_skips_comments(tmp_path):
+    q = tmp_path / "q.txt"
+    q.write_text("# header\n\necho one\n  # note\necho two\n")
+    assert tpu_retry.read_queue(str(q)) == ["echo one", "echo two"]
+
+
+def test_rewrite_preserves_comments(tmp_path):
+    """The queue file is human-maintained: completing a job must not
+    flatten the user's annotations."""
+    q = tmp_path / "q.txt"
+    q.write_text("# section A\necho one\n\n# section B\necho two\n")
+    tpu_retry.rewrite_queue(str(q), remove="echo one")
+    assert q.read_text() == "# section A\n\n# section B\necho two\n"
+    tpu_retry.rewrite_queue(str(q), remove="echo two", append="echo three")
+    assert tpu_retry.read_queue(str(q)) == ["echo three"]
+    assert "# section A" in q.read_text()
+
+
+def test_run_job_rc_and_timeout(tmp_path):
+    assert tpu_retry.run_job("true", timeout=30) == 0
+    assert tpu_retry.run_job("false", timeout=30) != 0
+    assert tpu_retry.run_job("sleep 30", timeout=1) == -1
+
+
+def test_main_drains_queue_and_retries(tmp_path, monkeypatch):
+    """With a healthy 'tunnel', main runs jobs in order, requeues failures,
+    drops them after --retries, and exits when the queue empties."""
+    monkeypatch.setattr(tpu_retry, "probe_tunnel", lambda t: True)
+    out = tmp_path / "ran.txt"
+    q = tmp_path / "q.txt"
+    q.write_text(f"echo ok >> {out}\nfalse\n")
+    rc = tpu_retry.main(["--queue", str(q), "--retries", "2",
+                         "--job-timeout", "30"])
+    assert rc == 0
+    assert out.read_text().count("ok") == 1
+    assert tpu_retry.read_queue(str(q)) == []
+
+
+def test_main_never_resurrects_cancelled_jobs(tmp_path, monkeypatch):
+    """A failing job the user deletes from the file mid-run stays
+    cancelled instead of being requeued."""
+    monkeypatch.setattr(tpu_retry, "probe_tunnel", lambda t: True)
+    q = tmp_path / "q.txt"
+
+    def run_and_cancel(cmd, timeout):
+        q.write_text("")  # user cancels everything while the job runs
+        return 1
+
+    monkeypatch.setattr(tpu_retry, "run_job", run_and_cancel)
+    q.write_text("false\n")
+    rc = tpu_retry.main(["--queue", str(q), "--retries", "5"])
+    assert rc == 0
+    assert tpu_retry.read_queue(str(q)) == []
+
+
+def test_main_waits_while_down(tmp_path, monkeypatch):
+    """While the probe fails the queue is untouched; recovery drains it."""
+    states = iter([False, True])
+    monkeypatch.setattr(tpu_retry, "probe_tunnel", lambda t: next(states))
+    sleeps = []
+    monkeypatch.setattr(tpu_retry.time, "sleep", sleeps.append)
+    q = tmp_path / "q.txt"
+    q.write_text("true\n")
+    rc = tpu_retry.main(["--queue", str(q), "--interval", "5"])
+    assert rc == 0
+    assert sleeps == [5.0]
