@@ -38,6 +38,51 @@ def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _kloop_ranges(qi, block_q: int, block_k: int, nk: int, causal: bool,
+                  window: int, seq_len: int):
+    """Split a q-block's k-loop [lo, hi) into masked-prefix / unmasked-
+    interior / masked-suffix sub-ranges: (lo, full_lo, full_hi, hi).
+
+    Interior blocks are valid for EVERY (q, k) pair — no causal diagonal,
+    no window edge, no padded tail — so their bodies skip the iota/compare/
+    select VPU work entirely.  That work is pure overhead on all but the
+    1-2 boundary blocks per row, and the VPU (not the MXU) is the critical
+    path of these kernels at head_dim 64-128.
+
+    Boundary math (all end-exclusive block indices):
+      hi       causal: first block past this q block's last row
+      lo       window: first block any q row still sees
+      full_hi  min(first diagonal block, first padded block)
+      full_lo  first block ALL q rows fully see (window), clamped to range
+    """
+    if causal:
+        hi = lax.min(nk, pl.cdiv((qi + 1) * block_q, block_k))
+        lo = (
+            lax.max(0, (qi * block_q - window + 1) // block_k)
+            if window > 0 else 0
+        )
+        j_diag = qi * block_q // block_k  # first block touching the diagonal
+    else:
+        hi = nk
+        lo = 0
+        j_diag = nk
+    j_pad = seq_len // block_k  # first block touching the padded tail
+    full_hi = lax.min(lax.min(j_diag, j_pad), hi)
+    if window > 0:
+        # last row of the q block sees k >= (qi+1)*bq - window; a block is
+        # fully inside the window iff its first column is at/after that
+        wfull = ((qi + 1) * block_q - 1 - window) // block_k + 1
+        full_lo = lax.clamp(lo, wfull, full_hi)
+    else:
+        full_lo = lo
+    # invariant the three-loop split relies on: lo <= full_lo <= full_hi
+    # (an edge where the window start passes the padded boundary can push
+    # full_hi below lo; collapsing the interior there is correct — every
+    # remaining block runs masked)
+    full_hi = lax.max(full_lo, full_hi)
+    return lo, full_lo, full_hi, hi
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
                 causal: bool, block_k: int, seq_len: int, window: int):
     """One q block vs all (needed) k blocks; online softmax in fp32.
@@ -54,41 +99,45 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
     q = q_ref[0].astype(jnp.float32) * scale  # [block_q, D]
     q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
 
-    def body(j, carry):
-        m, l, acc = carry
-        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
-        k_pos = j * block_k + lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
-        valid = k_pos < seq_len  # mask the padded tail
-        if causal:
-            valid = jnp.logical_and(valid, q_pos >= k_pos)
-        if window > 0:  # sliding window: only the last `window` positions
-            valid = jnp.logical_and(valid, q_pos - k_pos < window)
-        s = jnp.where(valid, s, NEG_INF)
-        m_blk = jnp.max(s, axis=-1, keepdims=True)  # [block_q, 1]
-        m_new = jnp.maximum(m, m_blk)
-        p = jnp.exp(s - m_new)  # [block_q, block_k]
-        corr = jnp.exp(m - m_new)  # [block_q, 1]
-        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * corr + jnp.dot(p, v_blk, preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
+    def make_body(masked: bool):
+        def body(j, carry):
+            m, l, acc = carry
+            k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+            v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+            s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+            if masked:  # boundary blocks only: diagonal / window edge / pad
+                k_pos = j * block_k + lax.broadcasted_iota(
+                    jnp.int32, (1, block_k), 1
+                )
+                valid = k_pos < seq_len  # mask the padded tail
+                if causal:
+                    valid = jnp.logical_and(valid, q_pos >= k_pos)
+                if window > 0:  # sliding window: last `window` positions
+                    valid = jnp.logical_and(valid, q_pos - k_pos < window)
+                s = jnp.where(valid, s, NEG_INF)
+            m_blk = jnp.max(s, axis=-1, keepdims=True)  # [block_q, 1]
+            m_new = jnp.maximum(m, m_blk)
+            p = jnp.exp(s - m_new)  # [block_q, block_k]
+            corr = jnp.exp(m - m_new)  # [block_q, 1]
+            l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+            acc_new = acc * corr + jnp.dot(
+                p, v_blk, preferred_element_type=jnp.float32
+            )
+            return m_new, l_new, acc_new
+
+        return body
 
     m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc0 = jnp.zeros((block_q, d), jnp.float32)
-    if causal:
-        # blocks strictly above the diagonal contribute nothing: stop after
-        # the block containing this q block's last position; a sliding
-        # window also skips blocks entirely below q_start - window + 1
-        nk_needed = lax.min(nk, pl.cdiv((qi + 1) * block_q, block_k))
-        start = (
-            lax.max(0, (qi * block_q - window + 1) // block_k)
-            if window > 0 else 0
-        )
-        m, l, acc = lax.fori_loop(start, nk_needed, body, (m0, l0, acc0))
-    else:
-        m, l, acc = lax.fori_loop(0, nk, body, (m0, l0, acc0))
+    lo, full_lo, full_hi, hi = _kloop_ranges(
+        qi, block_q, block_k, nk, causal, window, seq_len
+    )
+    carry = (m0, l0, acc0)
+    carry = lax.fori_loop(lo, full_lo, make_body(True), carry)
+    carry = lax.fori_loop(full_lo, full_hi, make_body(False), carry)
+    carry = lax.fori_loop(full_hi, hi, make_body(True), carry)
+    m, l, acc = carry
 
     l_safe = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows (padding)
     o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
@@ -226,31 +275,35 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     delta = delta_ref[0, 0, :].astype(jnp.float32)[:, None]
     q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
 
-    def body(j, dq):
-        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
-        k_pos = j * block_k + lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
-        valid = k_pos < seq_len
-        if causal:
-            valid = jnp.logical_and(valid, q_pos >= k_pos)
-        if window > 0:
-            valid = jnp.logical_and(valid, q_pos - k_pos < window)
-        p = jnp.where(valid, jnp.exp(s - lse), 0.0)    # [block_q, block_k]
-        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
-        return dq + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
+    def make_body(masked: bool):
+        def body(j, dq):
+            k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+            v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+            s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+            p = jnp.exp(s - lse)                      # [block_q, block_k]
+            if masked:  # boundary blocks only (see _kloop_ranges)
+                k_pos = j * block_k + lax.broadcasted_iota(
+                    jnp.int32, (1, block_k), 1
+                )
+                valid = k_pos < seq_len
+                if causal:
+                    valid = jnp.logical_and(valid, q_pos >= k_pos)
+                if window > 0:
+                    valid = jnp.logical_and(valid, q_pos - k_pos < window)
+                p = jnp.where(valid, p, 0.0)
+            dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+            ds = p * (dp - delta)
+            return dq + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
+
+        return body
 
     dq0 = jnp.zeros((block_q, d), jnp.float32)
-    if causal:
-        nk_needed = lax.min(nk, pl.cdiv((qi + 1) * block_q, block_k))
-        start = (
-            lax.max(0, (qi * block_q - window + 1) // block_k)
-            if window > 0 else 0
-        )
-        dq = lax.fori_loop(start, nk_needed, body, dq0)
-    else:
-        dq = lax.fori_loop(0, nk, body, dq0)
+    lo, full_lo, full_hi, hi = _kloop_ranges(
+        qi, block_q, block_k, nk, causal, window, seq_len
+    )
+    dq = lax.fori_loop(lo, full_lo, make_body(True), dq0)
+    dq = lax.fori_loop(full_lo, full_hi, make_body(False), dq)
+    dq = lax.fori_loop(full_hi, hi, make_body(True), dq)
     dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
 
 
@@ -273,41 +326,77 @@ def _dkv_accum(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, ki: int, *,
     v_blk = v_ref[0].astype(jnp.float32)
     k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
 
-    def body(i, carry):
-        dk, dv = carry
-        q_blk = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32) * scale
-        do_blk = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lse_blk = lse_ref[0, 0, pl.ds(i * block_q, block_q)].astype(
-            jnp.float32
-        )[:, None]
-        delta_blk = delta_ref[0, 0, pl.ds(i * block_q, block_q)].astype(
-            jnp.float32
-        )[:, None]
-        s = jnp.dot(q_blk, k_blk.T, preferred_element_type=jnp.float32)
-        q_pos = i * block_q + lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
-        valid = jnp.logical_and(q_pos < seq_len, k_pos < seq_len)
-        if causal:
-            valid = jnp.logical_and(valid, q_pos >= k_pos)
-        if window > 0:
-            valid = jnp.logical_and(valid, q_pos - k_pos < window)
-        p = jnp.where(valid, jnp.exp(s - lse_blk), 0.0)  # [block_q, block_k]
-        dv = dv + jnp.dot(p.T, do_blk, preferred_element_type=jnp.float32)
-        dp = jnp.dot(do_blk, v_blk.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_blk)
-        dk = dk + jnp.dot(ds.T, q_blk, preferred_element_type=jnp.float32)
-        return dk, dv
+    def make_body(masked: bool):
+        def body(i, carry):
+            dk, dv = carry
+            q_blk = q_ref[0, pl.ds(i * block_q, block_q), :].astype(
+                jnp.float32
+            ) * scale
+            do_blk = do_ref[0, pl.ds(i * block_q, block_q), :].astype(
+                jnp.float32
+            )
+            lse_blk = lse_ref[0, 0, pl.ds(i * block_q, block_q)].astype(
+                jnp.float32
+            )[:, None]
+            delta_blk = delta_ref[0, 0, pl.ds(i * block_q, block_q)].astype(
+                jnp.float32
+            )[:, None]
+            s = jnp.dot(q_blk, k_blk.T, preferred_element_type=jnp.float32)
+            p = jnp.exp(s - lse_blk)                  # [block_q, block_k]
+            if masked:  # boundary q blocks only (see range math below)
+                q_pos = i * block_q + lax.broadcasted_iota(
+                    jnp.int32, (block_q, 1), 0
+                )
+                valid = jnp.logical_and(q_pos < seq_len, k_pos < seq_len)
+                if causal:
+                    valid = jnp.logical_and(valid, q_pos >= k_pos)
+                if window > 0:
+                    valid = jnp.logical_and(valid, q_pos - k_pos < window)
+                p = jnp.where(valid, p, 0.0)
+            dv = dv + jnp.dot(p.T, do_blk, preferred_element_type=jnp.float32)
+            dp = jnp.dot(do_blk, v_blk.T, preferred_element_type=jnp.float32)
+            ds = p * (dp - delta_blk)
+            dk = dk + jnp.dot(ds.T, q_blk, preferred_element_type=jnp.float32)
+            return dk, dv
 
-    zeros = jnp.zeros((block_k, d), jnp.float32)
+        return body
+
+    # range split, mirroring _kloop_ranges from the k side: q blocks
+    # strictly before this k block see none of it (causal start); a sliding
+    # window bounds how far past it they sit (end); the interior
+    # [full_lo, full_hi) is valid for every (q, k) pair and skips masking.
     if causal:
-        # q blocks strictly before this k block see none of it; a sliding
-        # window also bounds how far past it they can sit
         start = (ki * block_k) // block_q
         end = (
             lax.min(nq, pl.cdiv((ki + 1) * block_k + window - 1, block_q))
             if window > 0 else nq
         )
-        return lax.fori_loop(start, end, body, (zeros, zeros))
-    return lax.fori_loop(0, nq, body, (zeros, zeros))
+        # first q block whose EVERY row is at/after this k block's last row
+        full_lo = pl.cdiv((ki + 1) * block_k - 1, block_q)
+    else:
+        start = 0
+        end = nq
+        full_lo = 0
+    i_pad = seq_len // block_q  # first q block touching padded rows
+    full_hi = lax.min(end, i_pad)
+    if window > 0:
+        # last q block fully inside the window from this k block's first row
+        full_hi = lax.min(full_hi, (ki * block_k + window) // block_q)
+    full_lo = lax.clamp(start, full_lo, full_hi)
+    # start <= full_lo <= full_hi, the same invariant as _kloop_ranges
+    full_hi = lax.max(full_lo, full_hi)
+    # a k block touching the padded tail invalidates EVERY iteration:
+    # collapse the interior so all blocks run masked
+    k_padded = (ki + 1) * block_k > seq_len
+    full_lo = lax.select(k_padded, start, full_lo)
+    full_hi = lax.select(k_padded, start, full_hi)
+
+    zeros = jnp.zeros((block_k, d), jnp.float32)
+    carry = (zeros, zeros)
+    carry = lax.fori_loop(start, full_lo, make_body(True), carry)
+    carry = lax.fori_loop(full_lo, full_hi, make_body(False), carry)
+    carry = lax.fori_loop(full_hi, end, make_body(True), carry)
+    return carry
 
 
 def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,

@@ -83,6 +83,27 @@ def rewrite_queue(path: str, remove: str = None, append: str = None) -> None:
     os.replace(tmp, path)
 
 
+def _descendants(pid: int) -> list:
+    """All live descendant pids via /proc — killpg alone misses children
+    that started their OWN session (baseline_matrix._run does exactly
+    that), and a wedged grandchild holding the TPU would livelock every
+    later probe.  Same walk as baseline_matrix._descendants."""
+    out, stack = [], [pid]
+    while stack:
+        p = stack.pop()
+        try:
+            import glob
+
+            for f in glob.glob(f"/proc/{p}/task/*/children"):
+                with open(f) as fh:
+                    kids = [int(c) for c in fh.read().split()]
+                out.extend(kids)
+                stack.extend(kids)
+        except (OSError, ValueError):
+            pass
+    return out
+
+
 def run_job(cmd: str, timeout: float) -> int:
     """Run one queued command in its own session; tree-kill on timeout so a
     wedged dispatch can't outlive its window and block the next probe."""
@@ -91,6 +112,11 @@ def run_job(cmd: str, timeout: float) -> int:
     try:
         return p.wait(timeout=timeout)
     except subprocess.TimeoutExpired:
+        for kid in _descendants(p.pid):
+            try:
+                os.kill(kid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
         try:
             os.killpg(p.pid, signal.SIGKILL)
         except (ProcessLookupError, PermissionError):
@@ -138,6 +164,9 @@ def main(argv=None) -> int:
             else:
                 print(f"# tpu_retry: rc={rc}, dropped after "
                       f"{args.retries} attempts: {cmd}", flush=True)
+                # a LATER duplicate of the same command line (e.g. two runs
+                # queued for variance) gets its own fresh retry budget
+                attempts[cmd] = 0
         rewrite_queue(args.queue, remove=cmd, append=requeue)
 
 
