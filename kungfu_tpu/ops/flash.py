@@ -13,8 +13,12 @@ computes the per-block attention; single-chip models call it directly.
 
 Shapes follow the rest of the framework: q, k, v are [B, L, H, D]; the
 kernel runs on a (B*H, L/block_q) grid with K/V streamed block-by-block
-from VMEM.  Computation is fp32 regardless of input dtype (bf16 in, fp32
-accumulate, cast back) — the MXU-native mixed precision.
+from VMEM.  Matmul operands stay in the INPUT dtype (bf16 on the training
+path) with fp32 accumulation (`preferred_element_type`) — an f32-cast
+operand would force the MXU into its multi-pass f32 mode at a fraction of
+the bf16 rate.  Softmax statistics (m, l, lse, delta) and accumulators are
+always fp32; the attention scale is applied to the f32 scores post-dot, so
+no precision is spent on pre-scaled operands.
 
 On non-TPU backends the kernel runs in interpreter mode automatically, so
 the same code path is exercised by the CPU test suite.
@@ -96,15 +100,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
     l_pad = k_ref.shape[1]
     nk = l_pad // block_k
 
-    q = q_ref[0].astype(jnp.float32) * scale  # [block_q, D]
+    q = q_ref[0]  # [block_q, D] — operand dtype feeds the MXU directly
     q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
 
     def make_body(masked: bool):
         def body(j, carry):
             m, l, acc = carry
-            k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-            v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-            s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+            k_blk = k_ref[0, pl.ds(j * block_k, block_k), :]
+            v_blk = v_ref[0, pl.ds(j * block_k, block_k), :]
+            s = jnp.dot(
+                q, k_blk.T, preferred_element_type=jnp.float32
+            ) * scale
             if masked:  # boundary blocks only: diagonal / window edge / pad
                 k_pos = j * block_k + lax.broadcasted_iota(
                     jnp.int32, (1, block_k), 1
@@ -121,7 +127,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
             corr = jnp.exp(m - m_new)  # [block_q, 1]
             l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
             acc_new = acc * corr + jnp.dot(
-                p, v_blk, preferred_element_type=jnp.float32
+                p.astype(v_blk.dtype), v_blk,
+                preferred_element_type=jnp.float32,
             )
             return m_new, l_new, acc_new
 
@@ -266,8 +273,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     d = q_ref.shape[2]
     nk = k_ref.shape[1] // block_k
 
-    q = q_ref[0].astype(jnp.float32) * scale          # [block_q, D]
-    do = do_ref[0].astype(jnp.float32)                # [block_q, D]
+    q = q_ref[0]                                      # [block_q, D]
+    do = do_ref[0]                                    # [block_q, D]
     # lse/delta are [1, 1, block_q] lane vectors (seq on lanes — the
     # layout upstream TPU flash kernels use); [:, None] relayouts to a
     # per-sublane column
@@ -277,9 +284,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
 
     def make_body(masked: bool):
         def body(j, dq):
-            k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-            v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-            s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+            k_blk = k_ref[0, pl.ds(j * block_k, block_k), :]
+            v_blk = v_ref[0, pl.ds(j * block_k, block_k), :]
+            s = jnp.dot(
+                q, k_blk.T, preferred_element_type=jnp.float32
+            ) * scale
             p = jnp.exp(s - lse)                      # [block_q, block_k]
             if masked:  # boundary blocks only (see _kloop_ranges)
                 k_pos = j * block_k + lax.broadcasted_iota(
@@ -293,7 +302,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
                 p = jnp.where(valid, p, 0.0)
             dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
             ds = p * (dp - delta)
-            return dq + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
+            return dq + jnp.dot(
+                ds.astype(k_blk.dtype), k_blk,
+                preferred_element_type=jnp.float32,
+            )
 
         return body
 
@@ -316,32 +328,30 @@ def _dkv_accum(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, ki: int, *,
     lse_ref/delta_ref: [1, 1, L_pad] (sequence on lanes).  Padded q rows
     carry a REAL lse (they attend real keys in the forward), so they must
     be masked out here by q position, not by lse value.  Returns (dk, dv)
-    fp32 [block_k, D].
+    fp32 [block_k, D], dk already carrying the attention-scale factor.
     """
     block_k = k_ref.shape[1]
     d = k_ref.shape[2]
     nq = q_ref.shape[1] // block_q
 
-    k_blk = k_ref[0].astype(jnp.float32)              # [block_k, D]
-    v_blk = v_ref[0].astype(jnp.float32)
+    k_blk = k_ref[0]                                  # [block_k, D]
+    v_blk = v_ref[0]
     k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
 
     def make_body(masked: bool):
         def body(i, carry):
             dk, dv = carry
-            q_blk = q_ref[0, pl.ds(i * block_q, block_q), :].astype(
-                jnp.float32
-            ) * scale
-            do_blk = do_ref[0, pl.ds(i * block_q, block_q), :].astype(
-                jnp.float32
-            )
+            q_blk = q_ref[0, pl.ds(i * block_q, block_q), :]
+            do_blk = do_ref[0, pl.ds(i * block_q, block_q), :]
             lse_blk = lse_ref[0, 0, pl.ds(i * block_q, block_q)].astype(
                 jnp.float32
             )[:, None]
             delta_blk = delta_ref[0, 0, pl.ds(i * block_q, block_q)].astype(
                 jnp.float32
             )[:, None]
-            s = jnp.dot(q_blk, k_blk.T, preferred_element_type=jnp.float32)
+            s = jnp.dot(
+                q_blk, k_blk.T, preferred_element_type=jnp.float32
+            ) * scale
             p = jnp.exp(s - lse_blk)                  # [block_q, block_k]
             if masked:  # boundary q blocks only (see range math below)
                 q_pos = i * block_q + lax.broadcasted_iota(
@@ -353,10 +363,16 @@ def _dkv_accum(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, ki: int, *,
                 if window > 0:
                     valid = jnp.logical_and(valid, q_pos - k_pos < window)
                 p = jnp.where(valid, p, 0.0)
-            dv = dv + jnp.dot(p.T, do_blk, preferred_element_type=jnp.float32)
+            dv = dv + jnp.dot(
+                p.T.astype(do_blk.dtype), do_blk,
+                preferred_element_type=jnp.float32,
+            )
             dp = jnp.dot(do_blk, v_blk.T, preferred_element_type=jnp.float32)
             ds = p * (dp - delta_blk)
-            dk = dk + jnp.dot(ds.T, q_blk, preferred_element_type=jnp.float32)
+            dk = dk + jnp.dot(
+                ds.T.astype(q_blk.dtype), q_blk,
+                preferred_element_type=jnp.float32,
+            )
             return dk, dv
 
         return body
@@ -395,8 +411,10 @@ def _dkv_accum(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, ki: int, *,
     carry = (zeros, zeros)
     carry = lax.fori_loop(start, full_lo, make_body(True), carry)
     carry = lax.fori_loop(full_lo, full_hi, make_body(False), carry)
-    carry = lax.fori_loop(full_hi, end, make_body(True), carry)
-    return carry
+    dk, dv = lax.fori_loop(full_hi, end, make_body(True), carry)
+    # the scale rides the f32 scores (not a pre-scaled q operand), so the
+    # chain-rule factor lands on dk here, once per k/v block
+    return dk * scale, dv
 
 
 def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
@@ -568,10 +586,13 @@ def _bwd_blocked(q, k, v, o, lse, g, scale: float, causal: bool,
     vp = _pad_to(v, block_k, 1)
     nk = kp.shape[1] // block_k
 
-    qf = q.astype(jnp.float32) * scale
-    gf = g.astype(jnp.float32)
-    of = o.astype(jnp.float32)
-    delta = jnp.sum(of * gf, axis=-1)  # [BH, L]
+    # matmul operands stay in the input dtype (bf16 on the training path;
+    # an f32 cast would force slow multi-pass MXU matmuls); statistics,
+    # probabilities and accumulators are f32 via preferred_element_type
+    gf = g.astype(q.dtype)
+    delta = jnp.sum(
+        o.astype(jnp.float32) * g.astype(jnp.float32), axis=-1
+    )  # [BH, L]
     if g_lse is not None:
         delta = delta - g_lse.astype(jnp.float32)
     q_pos = jnp.arange(seq_len)
@@ -579,9 +600,9 @@ def _bwd_blocked(q, k, v, o, lse, g, scale: float, causal: bool,
     def one_block(j):
         k_blk = lax.dynamic_slice_in_dim(kp, j * block_k, block_k, 1)
         v_blk = lax.dynamic_slice_in_dim(vp, j * block_k, block_k, 1)
-        kf = k_blk.astype(jnp.float32)
-        vf = v_blk.astype(jnp.float32)
-        s = jnp.einsum("bqd,bkd->bqk", qf, kf)
+        s = jnp.einsum(
+            "bqd,bkd->bqk", q, k_blk, preferred_element_type=jnp.float32
+        ) * scale
         k_pos = j * block_k + jnp.arange(block_k)
         valid = (k_pos < seq_len)[None, :]
         if causal:
@@ -591,23 +612,40 @@ def _bwd_blocked(q, k, v, o, lse, g, scale: float, causal: bool,
                 valid, q_pos[:, None] - k_pos[None, :] < window
             )
         p = jnp.where(valid[None], jnp.exp(s - lse[:, :, None]), 0.0)
-        dv = jnp.einsum("bqk,bqd->bkd", p, gf)
-        dp = jnp.einsum("bqd,bkd->bqk", gf, vf)
+        dv = jnp.einsum(
+            "bqk,bqd->bkd", p.astype(gf.dtype), gf,
+            preferred_element_type=jnp.float32,
+        )
+        dp = jnp.einsum(
+            "bqd,bkd->bqk", gf, v_blk, preferred_element_type=jnp.float32
+        )
         ds = p * (dp - delta[:, :, None])
-        dq_c = jnp.einsum("bqk,bkd->bqd", ds, kf)
-        dk = jnp.einsum("bqk,bqd->bkd", ds, qf)
+        dq_c = jnp.einsum(
+            "bqk,bkd->bqd", ds.astype(k_blk.dtype), k_blk,
+            preferred_element_type=jnp.float32,
+        )
+        dk = jnp.einsum(
+            "bqk,bqd->bkd", ds.astype(q.dtype), q,
+            preferred_element_type=jnp.float32,
+        )
         return dq_c, dk, dv
 
     def scan_body(dq_acc, j):
         dq_c, dk, dv = one_block(j)
         return dq_acc + dq_c, (dk, dv)
 
+    # zeros_like (not zeros): under shard_map the carry must inherit q's
+    # varying-manual-axes type or the scan rejects the f32 accumulator
     dq, (dks, dvs) = lax.scan(
-        scan_body, jnp.zeros_like(qf), jnp.arange(nk)
+        scan_body, jnp.zeros_like(q, dtype=jnp.float32), jnp.arange(nk)
     )
     dk = jnp.moveaxis(dks, 0, 1).reshape(bh, nk * block_k, d)[:, :seq_len]
     dv = jnp.moveaxis(dvs, 0, 1).reshape(bh, nk * block_k, d)[:, :seq_len]
-    return (dq * scale).astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    return (
+        (dq * scale).astype(q.dtype),
+        (dk * scale).astype(k.dtype),
+        dv.astype(v.dtype),
+    )
 
 
 def _bwd_auto_seq() -> int:

@@ -2,6 +2,7 @@
 import os
 import sys
 import subprocess
+import types
 
 import pytest
 
@@ -71,7 +72,12 @@ def test_main_waits_while_down(tmp_path, monkeypatch):
     states = iter([False, True])
     monkeypatch.setattr(tpu_retry, "probe_tunnel", lambda t: next(states))
     sleeps = []
-    monkeypatch.setattr(tpu_retry.time, "sleep", sleeps.append)
+    # Patch the module REFERENCE, not time.sleep itself: tpu_retry.time is
+    # the global time module, and patching it leaks the spy to background
+    # threads (store servers, watchdogs) that also call time.sleep —
+    # observed as flaky extra entries in full-suite runs.
+    monkeypatch.setattr(tpu_retry, "time",
+                        types.SimpleNamespace(sleep=sleeps.append))
     q = tmp_path / "q.txt"
     q.write_text("true\n")
     rc = tpu_retry.main(["--queue", str(q), "--interval", "5"])
