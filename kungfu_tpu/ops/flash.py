@@ -92,7 +92,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
     """One q block vs all (needed) k blocks; online softmax in fp32.
 
     q_ref: [1, block_q, D]; k_ref/v_ref: [1, L_pad, D];
-    o_ref: [1, block_q, D]; lse_ref: [1, block_q].
+    o_ref: [1, block_q, D]; lse_ref: [1, 1, block_q] (sequence on lanes —
+    the same compact layout the backward kernels consume).
     """
     qi = pl.program_id(1)
     block_q = q_ref.shape[1]
@@ -148,10 +149,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
 
     l_safe = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows (padding)
     o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
-    # lse broadcast across a 128-lane dim: TPU tiling wants the last dim to
-    # be 128-aligned, so per-row scalars ride a full lane (upstream flash
-    # kernels use the same layout)
-    lse_ref[0] = jnp.broadcast_to(m + jnp.log(l_safe), (block_q, 128))
+    # sequence-on-lanes lse: one [1, block_q] lane vector per q block (the
+    # layout the backward kernels already consume) — the earlier 128-lane
+    # broadcast layout wrote 128x the bytes (64 MB per flagship-shape
+    # layer) purely to keep the last dim tile-aligned
+    lse_ref[0] = (m + jnp.log(l_safe)).reshape(1, block_q)
 
 
 def _pad_to(x, multiple: int, axis: int):
@@ -249,15 +251,15 @@ def _flash_fwd(q, k, v, scale: float, causal: bool, block_q: int, block_k: int,
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 128), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, lq, d), q.dtype, vma=vma),
-            jax.ShapeDtypeStruct((bh, lq, 128), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((bh, 1, lq), jnp.float32, vma=vma),
         ],
         interpret=_use_interpret() if interpret is None else interpret,
     )(qp, kp, vp)
-    return o[:, :seq_len], lse[:, :seq_len, 0]
+    return o[:, :seq_len], lse[:, 0, :seq_len]
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,

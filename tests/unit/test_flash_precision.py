@@ -1,0 +1,66 @@
+"""Matmul-operand dtype contract of the flash kernels.
+
+The MXU runs bf16 operands at full rate and f32 operands in a multi-pass
+mode at a fraction of it; an accidental `.astype(jnp.float32)` on a dot
+operand (the pre-round-4 state of every dot in ops/flash.py) is invisible
+to correctness tests but costs most of the kernel's throughput.  These
+tests walk the traced jaxpr — including the Pallas kernel bodies and scan
+sub-jaxprs — and assert every dot_general consumes the INPUT dtype, with
+f32 arriving only via preferred_element_type accumulation.
+"""
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kungfu_tpu.ops.flash import flash_attention
+
+pytestmark = pytest.mark.slow  # tracing the grad of both backward arms
+
+
+def _collect_dot_operand_dtypes(jaxpr, out):
+    """All dot_general operand dtype pairs, descending into sub-jaxprs
+    (scan bodies, pallas_call kernels, custom_vjp calls)."""
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "dot_general":
+            out.append(tuple(v.aval.dtype.name for v in eqn.invars))
+        for p in eqn.params.values():
+            vals = p if isinstance(p, (list, tuple)) else [p]
+            for v in vals:
+                inner = getattr(v, "jaxpr", None)
+                if inner is not None and not hasattr(inner, "eqns"):
+                    inner = getattr(inner, "jaxpr", None)
+                if inner is None and hasattr(v, "eqns"):
+                    inner = v
+                if inner is not None and hasattr(inner, "eqns"):
+                    _collect_dot_operand_dtypes(inner, out)
+    return out
+
+
+def _dots_for(dtype, backward):
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = [jax.random.normal(kk, (1, 256, 2, 64), dtype) for kk in ks]
+
+    def loss(q, k, v):
+        return flash_attention(
+            q, k, v, causal=True, interpret=True, backward=backward
+        ).astype(jnp.float32).sum()
+
+    jx = jax.make_jaxpr(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+    return _collect_dot_operand_dtypes(jx.jaxpr, [])
+
+
+@pytest.mark.parametrize("backward", ["pallas", "xla"])
+def test_bf16_inputs_keep_bf16_operands(backward):
+    dots = _dots_for(jnp.bfloat16, backward)
+    assert dots, "expected dot_generals in the traced grad"
+    offenders = [d for d in dots if d != ("bfloat16", "bfloat16")]
+    assert not offenders, (
+        f"dots with non-bf16 operands (forces multi-pass MXU): {offenders}"
+    )
+
+
+@pytest.mark.parametrize("backward", ["pallas", "xla"])
+def test_f32_inputs_keep_f32_operands(backward):
+    # dtype fidelity cuts both ways: f32 callers keep full-precision dots
+    dots = _dots_for(jnp.float32, backward)
+    assert dots and all(d == ("float32", "float32") for d in dots), dots
