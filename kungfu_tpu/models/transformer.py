@@ -211,22 +211,29 @@ class Attention(nn.Module):
                 )
             kf = cache_k.value
             vf = cache_v.value
-            if Hkv != H:
-                kf = jnp.repeat(kf, H // Hkv, axis=2)
-                vf = jnp.repeat(vf, H // Hkv, axis=2)
             scale = 1.0 / (D ** 0.5)
+            # grouped-query einsum against the UN-repeated cache: decode is
+            # cache-read-bound, so neither a jnp.repeat materialization
+            # (x H/Hkv bytes under GQA) nor an f32 cast (x2 bytes) of the
+            # cache is acceptable — group the query heads instead and keep
+            # operands in the cache dtype with f32 accumulation
+            G = H // Hkv
+            qg = q.reshape(B, L, Hkv, G, D)
             s = jnp.einsum(
-                "blhd,bmhd->bhlm",
-                q.astype(jnp.float32) * scale, kf.astype(jnp.float32),
-            )
+                "blkgd,bmkd->bkglm", qg, kf,
+                preferred_element_type=jnp.float32,
+            ) * scale
             q_pos = pos[:, None]                       # [L, 1]
             c_pos = jnp.arange(cfg.max_len)[None, :]   # [1, max_len]
             valid = c_pos <= q_pos
             if cfg.window:  # sliding-window models decode windowed too
                 valid = jnp.logical_and(valid, q_pos - c_pos < cfg.window)
-            s = jnp.where(valid[None, None], s, -1e30)
+            s = jnp.where(valid[None, None, None], s, -1e30)
             p = jax.nn.softmax(s, axis=-1)
-            o = jnp.einsum("bhlm,bmhd->blhd", p, vf.astype(jnp.float32))
+            o = jnp.einsum(
+                "bkglm,bmkd->blkgd", p.astype(vf.dtype), vf,
+                preferred_element_type=jnp.float32,
+            ).reshape(B, L, H, D)
             # cursor past max_len clamps the cache write and clobbers older
             # slots — poison with NaN so overflow is LOUD instead of
             # silently-wrong logits (generate() bounds the total; this

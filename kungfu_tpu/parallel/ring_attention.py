@@ -46,7 +46,12 @@ def _block_attn(q, k, v, m, l, o, q_off, k_off, causal: bool, scale: float):
     p = jnp.exp(s - m_new[..., None])  # [B, H, Lq, Lk]
     corr = jnp.exp(m - m_new)  # [B, H, Lq]
     l_new = l * corr + jnp.sum(p, axis=-1)
-    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    # operands in v's dtype, f32 accumulation: an f32-cast v would force
+    # the slow multi-pass MXU mode (same contract as ops/flash.py)
+    pv = jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
     o_new = o * corr.transpose(0, 2, 1)[..., None] + pv
     return m_new, l_new, o_new
 
@@ -208,4 +213,7 @@ def full_attention(q, k, v, causal: bool = True, scale: Optional[float] = None,
             (pos[:, None] - pos[None, :] < window)[None, None], s, NEG_INF
         )
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)
+    return jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    ).astype(q.dtype)
