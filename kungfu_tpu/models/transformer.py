@@ -67,6 +67,12 @@ class TransformerConfig:
     # attends only the last `window` positions (flash kernels skip the
     # dead blocks).  Supported by the "flash"/"full" paths; requires causal
     window: int = 0
+    # flash-kernel tile sizes (q rows / k columns per block).  128x128 is
+    # the safe default; larger blocks amortize per-block softmax
+    # bookkeeping when VMEM allows (scripts/mfu_hunt.py sweeps these
+    # on-chip).  Only the "flash" path reads them.
+    flash_block_q: int = 128
+    flash_block_k: int = 128
     # feed-forward flavor: "gelu" (2-matmul) or "swiglu" (gated, 3-matmul)
     ffn: str = "gelu"
     # dropout on embeddings and each residual branch, active only when the
@@ -314,7 +320,9 @@ class Attention(nn.Module):
                 )
                 attn = _shard_map(
                     partial(flash_attention, causal=cfg.causal,
-                            window=cfg.window or None),
+                            window=cfg.window or None,
+                            block_q=cfg.flash_block_q,
+                            block_k=cfg.flash_block_k),
                     mesh=cfg.mesh,
                     in_specs=(spec, spec, spec),
                     out_specs=spec,
@@ -322,7 +330,9 @@ class Attention(nn.Module):
                 o = attn(q, k, v)
             else:
                 o = flash_attention(q, k, v, causal=cfg.causal,
-                                    window=cfg.window or None)
+                                    window=cfg.window or None,
+                                    block_q=cfg.flash_block_q,
+                                    block_k=cfg.flash_block_k)
         else:
             o = full_attention(q, k, v, causal=cfg.causal,
                                window=cfg.window or None)

@@ -97,3 +97,22 @@ def test_baseline_matrix_merge(tmp_path):
     with open(out) as f:
         assert [r["config"] for r in json.load(f)["results"]] == ["c"]
     assert not [p for p in tmp_path.iterdir() if p.suffix == ".tmp"]
+
+
+def test_gpt_decode_config_tiny():
+    """Config 12's measurement mechanics end-to-end on CPU: two-point
+    marginal-cost timing, per-batch rows, best-row headline.  A noisy CPU
+    may yield the documented degenerate-timing row; what must NOT appear
+    is an exception-shaped error (mechanics breakage)."""
+    from kungfu_tpu.benchmarks.baseline_matrix import config_gpt_decode
+
+    r = config_gpt_decode(new_tokens=32, tiny=True)
+    rows = r.get("rows", [])
+    for row in rows:
+        if "error" in row:  # only the documented degenerate case is OK
+            assert "marginal decode time" in row["error"], row
+    ok = [row for row in rows if "tokens_per_sec" in row]
+    if ok:  # the normal outcome
+        assert "error" not in r and r["value"] > 0
+        assert all(row["tokens_per_sec"] > 0 for row in ok)
+        assert all("fixed_overhead_ms" in row for row in ok)

@@ -66,3 +66,20 @@ def test_sliding_window_lowers_for_tpu():
         )
 
     _export_ok(jax.grad(loss, argnums=(0, 1, 2)), q, q, q)
+
+
+def test_large_blocks_head128_lower_for_tpu():
+    """Large asymmetric tiling — 256x512 blocks at head_dim 128 (the
+    mfu_hunt sweep's candidate shapes) — lowers to Mosaic fwd+bwd.  The
+    TransformerConfig flash_block plumb-through is guarded one level up
+    (test_tpu_lowering.test_transformer_custom_blocks_lower)."""
+    q = jnp.zeros((1, 2048, 2, 128), jnp.bfloat16)
+
+    def loss(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, causal=True, interpret=False,
+                            block_q=256, block_k=512, backward="pallas")
+            .astype(jnp.float32) ** 2
+        )
+
+    _export_ok(jax.grad(loss, argnums=(0, 1, 2)), q, q, q)
