@@ -671,28 +671,33 @@ def config_gpt_decode(new_tokens: int = 256, tiny: bool = False) -> dict:
         )
         half = max(new_tokens // 2, 2)
 
-        def timed(batch, n):
+        def timed(run_cfg, batch, n):
             prompt = jax.random.randint(
                 jax.random.PRNGKey(1), (batch, 64), 0, cfg.vocab_size
             )
-            toks = generate(cfg, params, prompt, max_new_tokens=n)
+            toks = generate(run_cfg, params, prompt, max_new_tokens=n)
             int(jax.device_get(toks[0, -1]))  # compile + force the tunnel
             t0 = time.perf_counter()
-            toks = generate(cfg, params, prompt, max_new_tokens=n)
+            toks = generate(run_cfg, params, prompt, max_new_tokens=n)
             int(jax.device_get(toks[0, -1]))
             return time.perf_counter() - t0
 
+        import dataclasses
+
         rows, best = [], None
-        for batch in (8, 32):
+        # the int8 arm A/Bs the quantized KV cache (half the cache-read
+        # bytes) at the larger batch, where decode is most cache-bound
+        for batch, kv_dtype in ((8, "model"), (32, "model"), (32, "int8")):
+            run_cfg = dataclasses.replace(cfg, kv_cache_dtype=kv_dtype)
             try:
                 # two-point measurement: the marginal cost of a decoded
                 # token, with the fixed overhead (eager cache init inside
                 # generate(), 64-token prefill, dispatch) reported
                 # separately instead of silently inflating ms_per_token
-                dt_full = timed(batch, new_tokens)
-                dt_half = timed(batch, half)
+                dt_full = timed(run_cfg, batch, new_tokens)
+                dt_half = timed(run_cfg, batch, half)
             except Exception as e:
-                rows.append({"batch": batch,
+                rows.append({"batch": batch, "kv_cache_dtype": kv_dtype,
                              "error": f"{type(e).__name__}: {e}"[:200]})
                 continue
             dn = new_tokens - half
@@ -701,7 +706,7 @@ def config_gpt_decode(new_tokens: int = 256, tiny: bool = False) -> dict:
                 # timing noise swamped the marginal cost (tiny models /
                 # tiny token counts): record the degenerate measurement as
                 # a row-level error, keeping the per-row isolation promise
-                rows.append({"batch": batch,
+                rows.append({"batch": batch, "kv_cache_dtype": kv_dtype,
                              "error": "non-positive marginal decode time "
                                       f"({dt_full:.4f}s vs {dt_half:.4f}s)",
                              "dt_full_s": round(dt_full, 4),
@@ -709,6 +714,7 @@ def config_gpt_decode(new_tokens: int = 256, tiny: bool = False) -> dict:
                 continue
             row = {
                 "batch": batch,
+                "kv_cache_dtype": kv_dtype,
                 "tokens_per_sec": round(batch / per_tok, 1),
                 "ms_per_token": round(per_tok * 1e3, 3),
                 "fixed_overhead_ms": round(
@@ -716,11 +722,16 @@ def config_gpt_decode(new_tokens: int = 256, tiny: bool = False) -> dict:
                 ),
             }
             rows.append(row)
-            if best is None or row["tokens_per_sec"] > best["tokens_per_sec"]:
+            # the int8 arm is informational (A/B), NOT headline-eligible:
+            # the metric name has always meant full-precision decode, and a
+            # model-dtype regression must not hide behind a quantized win
+            if kv_dtype == "model" and (
+                best is None or row["tokens_per_sec"] > best["tokens_per_sec"]
+            ):
                 best = row
         if best is None:
             return {"config": "gpt-decode", "error": json.dumps(rows)[-400:]}
-        return {
+        out = {
             "config": "gpt-decode",
             "metric": "gpt_decode_tokens_per_sec",
             "value": best["tokens_per_sec"],
@@ -731,6 +742,16 @@ def config_gpt_decode(new_tokens: int = 256, tiny: bool = False) -> dict:
             "rows": rows,
             "backend": jax.default_backend(),
         }
+        by_arm = {
+            (r.get("batch"), r.get("kv_cache_dtype")): r
+            for r in rows if "tokens_per_sec" in r
+        }
+        a, b = by_arm.get((32, "model")), by_arm.get((32, "int8"))
+        if a and b:
+            out["int8_cache_speedup"] = round(
+                b["tokens_per_sec"] / a["tokens_per_sec"], 3
+            )
+        return out
     except Exception as e:
         return {"config": "gpt-decode", "error": f"{type(e).__name__}: {e}"}
 
