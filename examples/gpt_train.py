@@ -43,6 +43,9 @@ def main() -> int:
     ap.add_argument("--vocab", type=int, default=512)
     ap.add_argument("--ckpt-dir", default="", help="enable checkpointing")
     ap.add_argument("--ckpt-every", type=int, default=10)
+    ap.add_argument("--kv-int8", action="store_true",
+                    help="decode with an int8-quantized KV cache (half the "
+                         "cache-read bytes / double the context per chip)")
     ap.add_argument("--generate", type=int, default=0, metavar="N",
                     help="after training, greedily generate N tokens from a "
                          "training-distribution prompt (KV-cache decode)")
@@ -164,6 +167,9 @@ def main() -> int:
 
     def maybe_generate():
         if args.generate <= 0:
+            if args.kv_int8:
+                print("# --kv-int8 does nothing without --generate N "
+                      "(it configures the decode cache)", flush=True)
             return
         from kungfu_tpu.models.transformer import generate
 
@@ -182,7 +188,12 @@ def main() -> int:
             lambda x: jax.device_put(np.asarray(x)),
             trainer.eval_params(state),
         )
-        out = np.asarray(generate(cfg, host_params, prompt, n))
+        gcfg = cfg
+        if args.kv_int8:
+            import dataclasses
+
+            gcfg = dataclasses.replace(cfg, kv_cache_dtype="int8")
+        out = np.asarray(generate(gcfg, host_params, prompt, n))
         print(f"# prompt    {np.asarray(prompt)[0].tolist()}")
         print(f"# generated {out[0, prompt.shape[1]:].tolist()}")
 
