@@ -36,16 +36,37 @@ PROBE = (
 
 
 def probe_tunnel(timeout: float) -> bool:
-    """True iff a trivial device dispatch completes within `timeout`."""
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c", PROBE],
-            timeout=timeout, capture_output=True, text=True,
-            start_new_session=True,
-        )
-        return r.returncode == 0 and "65536" in r.stdout
-    except subprocess.TimeoutExpired:
-        return False
+    """True iff a trivial device dispatch completes within `timeout`.
+
+    Hand-rolled wait instead of subprocess.run(timeout=...): run()'s
+    TimeoutExpired path calls communicate() with no timeout after the
+    kill, which blocks indefinitely when the wedged-tunnel child sits in
+    uninterruptible I/O (observed: an 18-minute silent stall of the whole
+    retry loop).  Here the child is tree-killed and, if it still will not
+    reap, ABANDONED — a leaked zombie is better than a frozen queue.  The
+    stdout read is select-bounded too: a wedged grandchild inheriting the
+    pipe's write end would make a plain .read() block past child exit."""
+    import select
+
+    p = subprocess.Popen(
+        [sys.executable, "-c", PROBE],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        start_new_session=True,
+    )
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if p.poll() is not None:
+            out = ""
+            if p.stdout is not None:
+                ready, _, _ = select.select([p.stdout], [], [], 2.0)
+                if ready:
+                    out = os.read(p.stdout.fileno(), 4096).decode(
+                        "utf-8", "replace"
+                    )
+            return p.returncode == 0 and "65536" in out
+        time.sleep(1.0)
+    _kill_tree(p)
+    return False
 
 
 def _is_job(line: str) -> bool:
@@ -104,6 +125,25 @@ def _descendants(pid: int) -> list:
     return out
 
 
+def _kill_tree(p) -> None:
+    """SIGKILL a Popen child and every /proc-visible descendant; never
+    block past a short reap grace (an unkillable D-state child is
+    abandoned rather than freezing the loop)."""
+    for kid in _descendants(p.pid):
+        try:
+            os.kill(kid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+    try:
+        os.killpg(p.pid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        p.kill()
+    try:
+        p.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        print("# tpu_retry: child unkillable (abandoned)", flush=True)
+
+
 def run_job(cmd: str, timeout: float) -> int:
     """Run one queued command in its own session; tree-kill on timeout so a
     wedged dispatch can't outlive its window and block the next probe."""
@@ -112,16 +152,7 @@ def run_job(cmd: str, timeout: float) -> int:
     try:
         return p.wait(timeout=timeout)
     except subprocess.TimeoutExpired:
-        for kid in _descendants(p.pid):
-            try:
-                os.kill(kid, signal.SIGKILL)
-            except (ProcessLookupError, PermissionError):
-                pass
-        try:
-            os.killpg(p.pid, signal.SIGKILL)
-        except (ProcessLookupError, PermissionError):
-            p.kill()
-        p.wait()
+        _kill_tree(p)
         print(f"# tpu_retry: TIMEOUT after {timeout:.0f}s: {cmd}", flush=True)
         return -1
 
