@@ -315,11 +315,13 @@ class Attention(nn.Module):
         if kind == "auto":
             kind = "flash" if jax.default_backend() == "tpu" else "full"
         if Hkv != H:
-            # the flash kernels take GQA kv natively (index-mapped, no
-            # repeat in HBM) as long as any tp sharding still divides the
-            # kv-head axis; other impls get broadcast kv heads
+            # flash (index-mapped kv), full, and ring (grouped einsums on
+            # the un-repeated kv — the rotated ring payload stays
+            # Hkv-sized) are all GQA-native as long as any tp sharding
+            # still divides the kv-head axis; ulysses redistributes heads
+            # with all_to_all and still consumes broadcast kv heads
             tp = cfg.mesh.shape.get("tp", 1) if cfg.mesh is not None else 1
-            if not (kind == "flash" and Hkv % tp == 0):
+            if kind == "ulysses" or Hkv % tp != 0:
                 k = jnp.repeat(k, H // Hkv, axis=2)
                 v = jnp.repeat(v, H // Hkv, axis=2)
         q = logical_constraint(q, ("batch", "seq", "heads", "kv"), cfg.mesh)

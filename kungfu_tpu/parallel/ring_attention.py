@@ -30,15 +30,23 @@ NEG_INF = -1e30
 def _block_attn(q, k, v, m, l, o, q_off, k_off, causal: bool, scale: float):
     """Fold one K/V block into the online-softmax accumulator.
 
-    q: [B, Lq, H, D]   k,v: [B, Lk, H, D]
+    q: [B, Lq, H, D]   k,v: [B, Lk, Hkv, D] (Hkv divides H; grouped-query
+    einsums against the UN-repeated k/v — under GQA the rotated ring
+    payload and the block operands stay Hkv-sized, H/Hkv times smaller)
     m,l: [B, H, Lq]    o: [B, Lq, H, D] (fp32)
     q_off/k_off: absolute position offsets of the q and k blocks.
     """
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
-    s = s * scale
+    B, Lq, H, D = q.shape
+    Lk, Hkv = k.shape[1], k.shape[2]
+    G = H // Hkv
+    qg = q.reshape(B, Lq, Hkv, G, D)
+    # query head h = khv * G + g — the same grouping order GQA models use
+    s = jnp.einsum(
+        "bqkgd,bmkd->bkgqm", qg, k, preferred_element_type=jnp.float32
+    ).reshape(B, H, Lq, Lk) * scale
     if causal:
-        q_pos = q_off + jnp.arange(q.shape[1])
-        k_pos = k_off + jnp.arange(k.shape[1])
+        q_pos = q_off + jnp.arange(Lq)
+        k_pos = k_off + jnp.arange(Lk)
         mask = q_pos[:, None] >= k_pos[None, :]  # [Lq, Lk]
         s = jnp.where(mask[None, None], s, NEG_INF)
     m_blk = jnp.max(s, axis=-1)  # [B, H, Lq]
@@ -49,9 +57,10 @@ def _block_attn(q, k, v, m, l, o, q_off, k_off, causal: bool, scale: float):
     # operands in v's dtype, f32 accumulation: an f32-cast v would force
     # the slow multi-pass MXU mode (same contract as ops/flash.py)
     pv = jnp.einsum(
-        "bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+        "bkgqm,bmkd->bqkgd",
+        p.reshape(B, Hkv, G, Lq, Lk).astype(v.dtype), v,
         preferred_element_type=jnp.float32,
-    )
+    ).reshape(B, Lq, H, D)
     o_new = o * corr.transpose(0, 2, 1)[..., None] + pv
     return m_new, l_new, o_new
 
@@ -81,8 +90,12 @@ def _block_attn_flash(q, k, v, mode, scale):
     B, Lq, H, D = q.shape
 
     def skip(q, k, v):
-        # derive from the operands so every switch branch agrees on vma types
-        z = jnp.zeros_like(q, jnp.float32) + (k[:, :1, :, :1] * 0 + v[:, :1, :, :1] * 0).astype(jnp.float32)
+        # derive from the operands so every switch branch agrees on vma
+        # types; reduce k/v to size-1 dims so the broadcast also works for
+        # GQA operands (Hkv < H)
+        z = jnp.zeros_like(q, jnp.float32) + (
+            k[:, :1, :1, :1] * 0 + v[:, :1, :1, :1] * 0
+        ).astype(jnp.float32)
         return z, z[:, :, :, 0].transpose(0, 2, 1) + NEG_INF
 
     def full_blk(q, k, v):
@@ -107,8 +120,10 @@ def ring_attention(
 ) -> jax.Array:
     """Exact attention over a sequence sharded on `axis_name`.
 
-    Shapes (per device): q, k, v: [B, L_chunk, H, D]; returns [B, L_chunk, H, D]
-    in q's dtype.  Must be called inside shard_map with `axis_name` in scope.
+    Shapes (per device): q: [B, L_chunk, H, D]; k, v: [B, L_chunk, Hkv, D]
+    with Hkv dividing H (GQA kv rotates un-repeated — H/Hkv times less ICI
+    traffic per hop); returns [B, L_chunk, H, D] in q's dtype.  Must be
+    called inside shard_map with `axis_name` in scope.
 
     `impl` selects the per-block compute: "flash" streams each hop's block
     through the Pallas kernel (default on TPU), "einsum" is the plain-XLA
@@ -197,23 +212,33 @@ def full_attention(q, k, v, causal: bool = True, scale: Optional[float] = None,
                    window: Optional[int] = None):
     """Single-device reference implementation (for tests and small models).
 
-    `window` (requires causal): sliding-window mask — each query sees only
-    the last `window` positions (masked here; the flash kernels also SKIP
-    the dead blocks)."""
+    GQA-native: k/v may carry Hkv < H heads (H % Hkv == 0); the grouped
+    einsums contract against the un-repeated k/v, so no head-broadcast
+    copy exists in HBM.  `window` (requires causal): sliding-window mask —
+    each query sees only the last `window` positions (masked here; the
+    flash kernels also SKIP the dead blocks)."""
     B, L, H, D = q.shape
+    Hkv = k.shape[2]
+    G = H // Hkv
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    qg = q.reshape(B, L, Hkv, G, D)
+    s = jnp.einsum(
+        "bqkgd,bmkd->bkgqm", qg, k, preferred_element_type=jnp.float32
+    ) * scale  # [B, Hkv, G, Lq, Lk]
     pos = jnp.arange(L)
     if causal:
-        s = jnp.where((pos[:, None] >= pos[None, :])[None, None], s, NEG_INF)
+        s = jnp.where(
+            (pos[:, None] >= pos[None, :])[None, None, None], s, NEG_INF
+        )
     if window:
         assert window > 0, "window must be positive (None/0 = unlimited)"
         assert causal, "sliding window requires causal attention"
         s = jnp.where(
-            (pos[:, None] - pos[None, :] < window)[None, None], s, NEG_INF
+            (pos[:, None] - pos[None, :] < window)[None, None, None], s,
+            NEG_INF,
         )
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum(
-        "bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+        "bkgqm,bmkd->bqkgd", p.astype(v.dtype), v,
         preferred_element_type=jnp.float32,
-    ).astype(q.dtype)
+    ).reshape(B, L, H, D).astype(q.dtype)
