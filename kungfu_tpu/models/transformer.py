@@ -315,13 +315,15 @@ class Attention(nn.Module):
         if kind == "auto":
             kind = "flash" if jax.default_backend() == "tpu" else "full"
         if Hkv != H:
-            # flash (index-mapped kv), full, and ring (grouped einsums on
-            # the un-repeated kv — the rotated ring payload stays
-            # Hkv-sized) are all GQA-native as long as any tp sharding
-            # still divides the kv-head axis; ulysses redistributes heads
-            # with all_to_all and still consumes broadcast kv heads
+            # flash (index-mapped kv), full, ring (grouped einsums on the
+            # un-repeated kv — the rotated ring payload stays Hkv-sized),
+            # and ulysses (kv all_to_all moves the Hkv-sized payload when
+            # the sp axis divides the PER-SHARD kv head count, Hkv/tp —
+            # it falls back to broadcasting internally otherwise) are all
+            # GQA-native, as long as any tp sharding still divides the
+            # kv-head axis
             tp = cfg.mesh.shape.get("tp", 1) if cfg.mesh is not None else 1
-            if kind == "ulysses" or Hkv % tp != 0:
+            if Hkv % tp != 0:
                 k = jnp.repeat(k, H // Hkv, axis=2)
                 v = jnp.repeat(v, H // Hkv, axis=2)
         q = logical_constraint(q, ("batch", "seq", "heads", "kv"), cfg.mesh)

@@ -58,14 +58,22 @@ def ulysses_attention(
 ) -> jax.Array:
     """Sequence-parallel attention via head-dimension all_to_all.
 
-    q, k, v: [B, L/n, H, D] per device (seq sharded over `axis_name`).
-    Returns [B, L/n, H, D].  The axis size must divide H.
-    `attn_fn(q, k, v, causal=, scale=)` computes attention on the full-
-    sequence head-slice; defaults to the flash kernel on TPU, plain einsum
-    elsewhere (models/transformer.py's "auto" rule).
+    q: [B, L/n, H, D] per device (seq sharded over `axis_name`);
+    k, v: [B, L/n, Hkv, D] with Hkv dividing H (GQA).  Returns
+    [B, L/n, H, D].  The axis size must divide H.  When it also divides
+    Hkv, the K/V all_to_alls move the UN-repeated Hkv-sized payload and
+    each chip attends its query-head chunk against the matching kv-head
+    chunk (contiguous-chunk grouping aligns: global q head i*H/n + j maps
+    to kv head (i*H/n + j)//G = i*Hkv/n + j//G, which is exactly chip i's
+    kv chunk); otherwise kv heads are broadcast up to H first (correct
+    everywhere, costs the repeat).  `attn_fn(q, k, v, causal=, scale=)`
+    computes attention on the full-sequence head-slice; defaults to the
+    flash kernel on TPU, plain einsum elsewhere (models/transformer.py's
+    "auto" rule) — both are GQA-native.
     """
     n = lax.axis_size(axis_name)
     b, l_shard, h, d = q.shape
+    hkv = k.shape[2]
     if h % n:
         raise ValueError(
             f"{axis_name} axis size {n} must divide n_heads={h}"
@@ -76,8 +84,14 @@ def ulysses_attention(
         else:
             from .ring_attention import full_attention as attn_fn
 
+    if hkv != h and hkv % n:
+        # kv heads not splittable over the axis: fall back to broadcast
+        rep = h // hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+
     qh = _seq_to_heads(q, axis_name)  # [B, L, H/n, D]
-    kh = _seq_to_heads(k, axis_name)
+    kh = _seq_to_heads(k, axis_name)  # [B, L, Hkv/n, D] when GQA-split
     vh = _seq_to_heads(v, axis_name)
     oh = attn_fn(qh, kh, vh, causal=causal, scale=scale)
     return _heads_to_seq(oh, axis_name)  # [B, L/n, H, D]
