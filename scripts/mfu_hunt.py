@@ -97,6 +97,11 @@ def probe_flash() -> None:
             for bq, bk in ((128, 128), (256, 256), (512, 512), (256, 512),
                            (512, 1024)):
                 yield ("ours", heads, dim, bq, bk)
+        # the blocked-XLA backward (auto choice below seq 4096) reads
+        # block_k as its scan granularity — sweep it too
+        for heads, dim in ((16, 64), (8, 128)):
+            for bq, bk in ((128, 128), (128, 512)):
+                yield ("ours_xla_bwd", heads, dim, bq, bk)
         for heads, dim in ((16, 64), (8, 128)):
             yield ("jax_ref", heads, dim, 0, 0)
 
@@ -104,10 +109,10 @@ def probe_flash() -> None:
         shape = (B, L, heads, dim)
         q, k, v = (jnp.asarray(rng.randn(*shape), jnp.bfloat16)
                    for _ in range(3))
-        if kind == "ours":
+        if kind in ("ours", "ours_xla_bwd"):
             fn = functools.partial(
                 flash_attention, causal=True, block_q=bq, block_k=bk,
-                backward="pallas",
+                backward="pallas" if kind == "ours" else "xla",
             )
         else:
             from jax.experimental.pallas.ops.tpu.flash_attention import (
