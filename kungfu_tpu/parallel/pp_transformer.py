@@ -106,7 +106,8 @@ class PipelinedLM:
     def init(self, rng, tokens) -> Any:
         """Init via TransformerLM (same shapes/metadata), repacked:
 
-        {"embed", "pos_embed", "ln_f", "lm_head"} kept as-is;
+        {"embed", "ln_f", "lm_head"} (+ "pos_embed" for non-rope
+        configs; rope models carry no position table) kept as-is;
         {"blocks": ...} leaves stacked [S, R, Lg, ...] with logical axis
         "stage" on the pp dim.
         """
@@ -158,9 +159,13 @@ class PipelinedLM:
                 f"multiple of microbatches={self.M}"
             )
 
-        # embed (outside the pipe)
+        # embed (outside the pipe).  rope configs carry no pos_embed table:
+        # each Block applies rotary positions to q/k internally, and every
+        # microbatch holds the full sequence, so positions need no
+        # pipeline-stage bookkeeping here
         x = jnp.take(p["embed"]["embedding"], tokens, axis=0).astype(cfg.dtype)
-        x = x + p["pos_embed"][None, :L].astype(cfg.dtype)
+        if not cfg.rope:
+            x = x + p["pos_embed"][None, :L].astype(cfg.dtype)
 
         # pipelined block stack
         block, remat, R, pp_axis = self._block, self.remat, self.R, self.pp_axis

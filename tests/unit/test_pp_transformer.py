@@ -47,19 +47,23 @@ def _reference_logits(cfg, tokens):
 
 
 @pytest.mark.parametrize(
-    "spec,repeats,layers,micro",
+    "spec,repeats,layers,micro,cfg_kw",
     [
-        (dict(pp=4), 1, 4, 4),    # GPipe
-        (dict(pp=4), 2, 8, 4),    # circular, R=2 (M == S boundary)
-        (dict(pp=2), 3, 6, 4),    # circular, R=3, M > S
-        (dict(dp=2, pp=4), 1, 4, 2),  # dp rides along
+        (dict(pp=4), 1, 4, 4, {}),    # GPipe
+        (dict(pp=4), 2, 8, 4, {}),    # circular, R=2 (M == S boundary)
+        (dict(pp=2), 3, 6, 4, {}),    # circular, R=3, M > S
+        (dict(dp=2, pp=4), 1, 4, 2, {}),  # dp rides along
+        # GQA + rope through the stages: the Block reuse must carry the
+        # grouped-attention config, and rope configs have no pos_embed
+        # table crossing stages
+        (dict(pp=2), 1, 4, 4, dict(n_kv_heads=2, rope=True)),
     ],
-    ids=["gpipe-pp4", "circ-pp4-r2", "circ-pp2-r3", "dp2xpp4"],
+    ids=["gpipe-pp4", "circ-pp4-r2", "circ-pp2-r3", "dp2xpp4", "gqa-rope"],
 )
-def test_pipelined_matches_plain(spec, repeats, layers, micro):
+def test_pipelined_matches_plain(spec, repeats, layers, micro, cfg_kw):
     tokens = _tokens(8)
     mesh = _mesh(**spec)
-    cfg = _cfg(mesh, n_layers=layers)
+    cfg = _cfg(mesh, n_layers=layers, **cfg_kw)
     want, _ = _reference_logits(cfg, tokens)
 
     model = PipelinedLM(cfg, repeats=repeats, microbatches=micro, remat=False)
