@@ -75,6 +75,12 @@ class TransformerConfig:
     flash_block_k: int = 128
     # feed-forward flavor: "gelu" (2-matmul) or "swiglu" (gated, 3-matmul)
     ffn: str = "gelu"
+    # normalization flavor: "layer" (LayerNorm, no bias) or "rms"
+    # (RMSNorm) — rms + rope + GQA + swiglu is the Llama-class recipe
+    # (models/hf.py loads HF Llama checkpoints into exactly that config).
+    # Both store a single "scale" param, so the tree shape is identical.
+    norm: str = "layer"  # "layer" | "rms"
+    norm_eps: float = 1e-6
     # dropout on embeddings and each residual branch, active only when the
     # model is applied with train=True and an rngs={"dropout": key}
     # (MeshTrainer threads a per-step key to 4-arg loss functions)
@@ -131,6 +137,7 @@ class TransformerConfig:
                 "sliding window is supported on the flash/full paths"
             )
         assert self.ffn in ("gelu", "swiglu"), self.ffn
+        assert self.norm in ("layer", "rms"), self.norm
         assert self.head in ("dense", "hidden"), self.head
         assert self.kv_cache_dtype in ("model", "int8"), self.kv_cache_dtype
         if self.decode:
@@ -413,6 +420,20 @@ class MLP(nn.Module):
         return _dense(cfg.d_model, "out", ("mlp", "embed"), cfg.dtype)(h)
 
 
+def _norm(cfg, name: str):
+    """The config's norm flavor; both flavors store one "scale" param, so
+    layer/rms configs share a param-tree shape."""
+    kw = dict(
+        dtype=jnp.float32, epsilon=cfg.norm_eps, name=name,
+        scale_init=nn.with_logical_partitioning(
+            nn.initializers.ones, ("norm",)
+        ),
+    )
+    if cfg.norm == "rms":
+        return nn.RMSNorm(**kw)
+    return nn.LayerNorm(use_bias=False, **kw)
+
+
 class Block(nn.Module):
     cfg: TransformerConfig
     use_moe: bool = False
@@ -420,8 +441,7 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = False):
         cfg = self.cfg
-        ln = partial(nn.LayerNorm, dtype=jnp.float32, use_bias=False,
-                     scale_init=nn.with_logical_partitioning(nn.initializers.ones, ("norm",)))
+        ln = partial(_norm, cfg)
         drop = nn.Dropout(cfg.dropout, deterministic=not train)
         x = x + drop(Attention(cfg, name="attn")(ln(name="ln1")(x)))
         if self.use_moe:
@@ -516,8 +536,7 @@ class TransformerLM(nn.Module):
         for i in range(cfg.n_layers):
             use_moe = cfg.n_experts > 0 and (i % cfg.moe_every == cfg.moe_every - 1)
             x = block_cls(cfg, use_moe=use_moe, name=f"block_{i}")(x, train)
-        x = nn.LayerNorm(dtype=jnp.float32, use_bias=False, name="ln_f",
-                         scale_init=nn.with_logical_partitioning(nn.initializers.ones, ("norm",)))(x)
+        x = _norm(cfg, "ln_f")(x)
         if cfg.head == "hidden":
             # deferred head: the streaming loss (lm_loss_chunked) consumes
             # hidden states + the head kernel directly.  Touch the head at
