@@ -10,11 +10,12 @@ tpu_retry queue can run this unattended and the results land in a log:
           neither hoist the matmul nor slice through an unused output
           (both happened with naive timing loops; see RESULTS.md r4).
   flash   our Pallas flash fwd+grad at the flagship GPT attention shape,
-          swept over (block_q, block_k) and head layout (16x64 vs 8x128),
-          vs jax.experimental's reference TPU flash kernel.
-  step    the flagship train step at the sweep's best settings.
+          swept over (block_q, block_k), head layout (16x64 vs 8x128),
+          and backward arm, vs jax.experimental's reference TPU kernel.
 
 Usage:  python scripts/mfu_hunt.py [peak|flash|all]  (default all)
+Unknown probe names exit nonzero so an unattended queue retries/surfaces
+the typo instead of recording a silent no-op success.
 """
 from __future__ import annotations
 
@@ -159,6 +160,10 @@ def probe_flash() -> None:
 
 def main(argv) -> int:
     which = argv[1] if len(argv) > 1 else "all"
+    if which not in ("peak", "flash", "all"):
+        print(f"# mfu_hunt: unknown probe {which!r} "
+              "(expected peak|flash|all)", file=sys.stderr)
+        return 2
     import jax
 
     print(f"# mfu_hunt: backend={jax.default_backend()} "

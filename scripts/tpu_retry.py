@@ -53,18 +53,23 @@ def probe_tunnel(timeout: float) -> bool:
         stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
         start_new_session=True,
     )
+    def finished() -> bool:
+        return p.poll() is not None
+
     deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if p.poll() is not None:
-            out = ""
-            if p.stdout is not None:
-                ready, _, _ = select.select([p.stdout], [], [], 2.0)
-                if ready:
-                    out = os.read(p.stdout.fileno(), 4096).decode(
-                        "utf-8", "replace"
-                    )
-            return p.returncode == 0 and "65536" in out
+    while time.monotonic() < deadline and not finished():
         time.sleep(1.0)
+    # one final poll AFTER the deadline loop: a child that completed during
+    # the last sleep window must count as success, not be tree-killed
+    if finished():
+        out = ""
+        if p.stdout is not None:
+            ready, _, _ = select.select([p.stdout], [], [], 2.0)
+            if ready:
+                out = os.read(p.stdout.fileno(), 4096).decode(
+                    "utf-8", "replace"
+                )
+        return p.returncode == 0 and "65536" in out
     _kill_tree(p)
     return False
 
