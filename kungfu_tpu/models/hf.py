@@ -1,13 +1,14 @@
-"""HF checkpoint interop: load Llama-family weights into TransformerLM.
+"""HF checkpoint interop: load Llama/Mistral-family weights into TransformerLM.
 
 The flagship decoder already speaks the Llama-class architecture — RoPE
 (rotate-half convention), GQA, SwiGLU, RMSNorm, untied or tied head, no
-biases — so a HF `LlamaForCausalLM` state dict maps onto the param tree
-1:1 (transposes only: torch Linear stores [out, in], flax Dense [in, out]).
-This is the "switch to this framework" on-ramp for ecosystem users: load a
-pretrained checkpoint, then fine-tune with any distributed optimizer in
-`kungfu_tpu.optimizers` or serve it through `generate()` (KV cache,
-optional int8).
+biases — so a HF `LlamaForCausalLM` (or `MistralForCausalLM`: same layout
+plus sliding-window attention, which maps onto `TransformerConfig.window`)
+state dict maps onto the param tree 1:1 (transposes only: torch Linear
+stores [out, in], flax Dense [in, out]).  This is the "switch to this
+framework" on-ramp for ecosystem users: load a pretrained checkpoint, then
+fine-tune with any distributed optimizer in `kungfu_tpu.optimizers` or
+serve it through `generate()` (KV cache, optional int8).
 
 No reference analog (the reference is model-agnostic DP with no LM stack);
 beyond-parity interop.
@@ -39,7 +40,11 @@ def _v(w) -> np.ndarray:
 
 
 def config_from_llama(hf_cfg, dtype=jnp.float32, **overrides) -> TransformerConfig:
-    """TransformerConfig matching a transformers LlamaConfig."""
+    """TransformerConfig matching a transformers Llama/Mistral config.
+
+    Mistral's `sliding_window` (each query attends the last W positions)
+    maps onto `TransformerConfig.window` — identical mask semantics, and
+    the flash kernels additionally SKIP the dead blocks."""
     if getattr(hf_cfg, "rope_scaling", None):
         raise NotImplementedError(
             "rope_scaling checkpoints are not supported (plain rotary only)"
@@ -64,9 +69,11 @@ def config_from_llama(hf_cfg, dtype=jnp.float32, **overrides) -> TransformerConf
             f"heads ({hf_cfg.hidden_size // hf_cfg.num_attention_heads}) "
             "is not supported"
         )
+    window = getattr(hf_cfg, "sliding_window", None) or 0
     kw = dict(
         vocab_size=hf_cfg.vocab_size,
         d_model=hf_cfg.hidden_size,
+        window=int(window),
         n_layers=hf_cfg.num_hidden_layers,
         n_heads=hf_cfg.num_attention_heads,
         n_kv_heads=(
@@ -91,7 +98,9 @@ def config_from_llama(hf_cfg, dtype=jnp.float32, **overrides) -> TransformerConf
 
 def load_llama(hf_model, dtype=jnp.float32, **cfg_overrides
                ) -> Tuple[TransformerConfig, Any]:
-    """(TransformerConfig, params) from a transformers LlamaForCausalLM.
+    """(TransformerConfig, params) from a transformers Llama- or
+    Mistral-family ForCausalLM (identical module layout; Mistral adds the
+    sliding window, mapped in config_from_llama).
 
     Weight map (sd = hf state dict under `model.`):
       embed_tokens.weight               -> embed.embedding   [V, D] as-is

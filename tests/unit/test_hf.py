@@ -48,6 +48,29 @@ def test_logits_match_transformers(tie, kv):
     np.testing.assert_allclose(got, want, atol=2e-4)
 
 
+def test_mistral_logits_match_transformers():
+    """MistralForCausalLM (same layout + sliding window) loads through the
+    same path; sliding_window=8 < seq 16 so the window mask actually
+    bites and its semantics must match HF's."""
+    from transformers import MistralConfig, MistralForCausalLM
+
+    torch.manual_seed(0)
+    hf = MistralForCausalLM(MistralConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, sliding_window=8, rms_norm_eps=1e-6,
+    )).eval()
+    tokens = _tokens()
+    with torch.no_grad():
+        want = hf(torch.tensor(tokens, dtype=torch.long)).logits.numpy()
+    cfg, params = load_llama(hf)
+    assert cfg.window == 8 and cfg.norm == "rms"
+    got = np.asarray(
+        TransformerLM(cfg).apply({"params": params}, jnp.asarray(tokens))
+    )
+    np.testing.assert_allclose(got, want, atol=2e-4)
+
+
 def test_param_tree_matches_init():
     """Loaded params must have exactly model.init's tree structure and
     shapes — that is what lets trainers fine-tune the checkpoint."""
