@@ -164,3 +164,33 @@ def test_transformer_custom_blocks_lower():
         "custom flash_block_q/k produced an identical module: the config "
         "values are not reaching the kernel"
     )
+
+
+def test_int8_decode_step_lowers():
+    """KV-cache decode with the int8 cache (quantize + int8
+    dynamic_update_slice + fused dequant einsum) compiles for TPU — the
+    serving path's on-chip viability, incl. its layout/tiling."""
+    import dataclasses
+
+    import flax.linen as nn
+
+    from kungfu_tpu.models.transformer import TransformerConfig, TransformerLM
+
+    cfg = TransformerConfig(
+        vocab_size=256, d_model=128, n_layers=1, n_heads=4, n_kv_heads=2,
+        d_ff=256, max_len=128, dtype=jnp.bfloat16, causal=True, rope=True,
+        attention="full",
+    )
+    dcfg = dataclasses.replace(cfg, decode=True, kv_cache_dtype="int8")
+    dmodel = TransformerLM(dcfg)
+    tokens = jnp.zeros((2, 8), jnp.int32)
+    variables = dmodel.init(jax.random.PRNGKey(0), tokens[:, :1])
+    params = nn.meta.unbox(variables["params"])
+    cache = variables["cache"]
+
+    def step(p, c, t):
+        return dmodel.apply({"params": p, "cache": c}, t, mutable=["cache"])
+
+    # prefill (8 tokens) and single-token decode both must lower
+    _export_ok(step, params, cache, tokens)
+    _export_ok(step, params, cache, tokens[:, :1])
