@@ -213,6 +213,8 @@ class Attention(nn.Module):
                     "cache", "scale_v", jnp.zeros, (B, cfg.max_len, Hkv),
                     jnp.float32,
                 )
+            else:
+                kscale = vscale = None
             cache_idx = self.variable(
                 "cache", "idx", lambda: jnp.zeros((), jnp.int32)
             )
@@ -236,46 +238,41 @@ class Attention(nn.Module):
                 ).astype(jnp.int8)
                 return qx, sc
 
+            def store(cache_var, scale_var, x):
+                """Write x at the cursor (quantizing + scale write if int8)."""
+                if quant:
+                    x, sc = quantize(x)
+                    scale_var.value = jax.lax.dynamic_update_slice(
+                        scale_var.value, sc, (0, idx0, 0)
+                    )
+                else:
+                    x = x.astype(cache_var.value.dtype)
+                cache_var.value = jax.lax.dynamic_update_slice(
+                    cache_var.value, x, (0, idx0, 0, 0)
+                )
+
+            def load(cache_var, scale_var):
+                """Full cache in the model dtype.  int8: the dequant (exact
+                for magnitudes <= 127 in bf16) fuses into the attention
+                einsum's operand read, so the cache crosses HBM as int8
+                bytes."""
+                if not quant:
+                    return cache_var.value
+                return cache_var.value.astype(cfg.dtype) * (
+                    scale_var.value.astype(cfg.dtype)[..., None]
+                )
+
             if not self.is_initializing():
                 # init() traces the module once to create the cache — it
                 # must not write tokens or advance the cursor
-                if quant:
-                    kq, ks = quantize(k)
-                    vq, vs = quantize(v)
-                    kscale.value = jax.lax.dynamic_update_slice(
-                        kscale.value, ks, (0, idx0, 0)
-                    )
-                    vscale.value = jax.lax.dynamic_update_slice(
-                        vscale.value, vs, (0, idx0, 0)
-                    )
-                    k_store, v_store = kq, vq
-                else:
-                    k_store = k.astype(cache_k.value.dtype)
-                    v_store = v.astype(cache_v.value.dtype)
-                cache_k.value = jax.lax.dynamic_update_slice(
-                    cache_k.value, k_store, (0, idx0, 0, 0)
-                )
-                cache_v.value = jax.lax.dynamic_update_slice(
-                    cache_v.value, v_store, (0, idx0, 0, 0)
-                )
+                store(cache_k, kscale, k)
+                store(cache_v, vscale, v)
                 cache_idx.value = idx0 + L
                 cache_ovf.value = jnp.logical_or(
                     cache_ovf.value, idx0 + L > cfg.max_len
                 )
-            if quant:
-                # dequant in the model dtype: int8 magnitudes (<= 127) are
-                # exact in bf16, and XLA fuses this elementwise chain into
-                # the einsum's operand read — the cache crosses HBM as
-                # int8 bytes
-                kf = cache_k.value.astype(cfg.dtype) * (
-                    kscale.value.astype(cfg.dtype)[..., None]
-                )
-                vf = cache_v.value.astype(cfg.dtype) * (
-                    vscale.value.astype(cfg.dtype)[..., None]
-                )
-            else:
-                kf = cache_k.value
-                vf = cache_v.value
+            kf = load(cache_k, kscale)
+            vf = load(cache_v, vscale)
             scale = 1.0 / (D ** 0.5)
             # grouped-query einsum against the UN-repeated cache: decode is
             # cache-read-bound, so neither a jnp.repeat materialization
