@@ -53,10 +53,12 @@ class TransformerConfig:
     attention: str = "auto"  # "auto" | "flash" | "full" | "ring" | "ulysses"
     causal: bool = True
     # grouped-query attention: number of K/V heads (0 = n_heads, i.e. MHA;
-    # 1 = MQA).  The flash kernels consume GQA K/V natively (index-mapped,
-    # no repeats in HBM) when tp divides n_kv_heads; ring/ulysses/full — and
-    # flash with tp not dividing n_kv_heads — broadcast K/V heads up to the
-    # query heads first.
+    # 1 = MQA).  Every attention path is GQA-native when tp divides
+    # n_kv_heads: flash index-maps the shared kv heads, full/ring use
+    # grouped einsums on the un-repeated kv (the ring's rotating payload
+    # stays Hkv-sized), ulysses all_to_alls the Hkv-sized payload when sp
+    # also divides the per-shard kv heads (internal broadcast otherwise),
+    # and decode groups queries against the un-repeated cache.
     n_kv_heads: int = 0
     # rotary position embeddings instead of the learned pos_embed table.
     # Applied to q/k on the GLOBAL sequence positions before any
