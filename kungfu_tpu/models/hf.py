@@ -39,6 +39,28 @@ def _v(w) -> np.ndarray:
     return np.asarray(w.detach().cpu(), np.float32)
 
 
+def _proj(linear, with_bias: bool) -> dict:
+    """Projection weights, validating bias presence BOTH ways: a missing
+    expected bias and an unexpected existing one are each load-time
+    errors — silently dropping checkpoint weights is the failure mode
+    every guard in this file exists to prevent."""
+    out = {"kernel": _t(linear.weight)}
+    if with_bias:
+        if linear.bias is None:
+            raise ValueError(
+                "config expects attention biases but the checkpoint's "
+                "projection has none"
+            )
+        out["bias"] = _v(linear.bias)
+    elif linear.bias is not None:
+        raise NotImplementedError(
+            "checkpoint projection carries a bias the config does not "
+            "map; pass/keep attention_bias=True (q/k/v) — other bias "
+            "layouts are unsupported"
+        )
+    return out
+
+
 def config_from_llama(hf_cfg, dtype=jnp.float32, **overrides) -> TransformerConfig:
     """TransformerConfig matching a transformers Llama/Mistral config.
 
@@ -49,8 +71,14 @@ def config_from_llama(hf_cfg, dtype=jnp.float32, **overrides) -> TransformerConf
         raise NotImplementedError(
             "rope_scaling checkpoints are not supported (plain rotary only)"
         )
-    if getattr(hf_cfg, "attention_bias", False):
-        raise NotImplementedError("attention_bias=True is not supported")
+    # Qwen2-family checkpoints carry q/k/v biases; the model supports
+    # them via TransformerConfig.attention_bias (o_proj stays bias-free
+    # on both sides).  Other bias layouts are rejected below.
+    attention_bias = bool(
+        getattr(hf_cfg, "attention_bias", False)
+        or getattr(hf_cfg, "qkv_bias", False)
+        or getattr(hf_cfg, "model_type", "") == "qwen2"
+    )
     if getattr(hf_cfg, "mlp_bias", False):
         # _t() copies only .weight — loading would silently drop the biases
         raise NotImplementedError("mlp_bias=True is not supported")
@@ -70,6 +98,22 @@ def config_from_llama(hf_cfg, dtype=jnp.float32, **overrides) -> TransformerConf
             "is not supported"
         )
     window = getattr(hf_cfg, "sliding_window", None) or 0
+    if hasattr(hf_cfg, "use_sliding_window"):
+        # Qwen2-style gating: use_sliding_window=False disables the window
+        # regardless of the sliding_window value, and max_window_layers
+        # exempts the FIRST N layers (full attention) — uniform cases map
+        # cleanly, per-layer mixtures do not
+        if not hf_cfg.use_sliding_window or not window:
+            window = 0  # disabled (or sliding_window=None): no mixture
+        else:
+            mwl = getattr(hf_cfg, "max_window_layers", 0) or 0
+            if mwl >= hf_cfg.num_hidden_layers:
+                window = 0  # every layer exempted
+            elif mwl > 0:
+                raise NotImplementedError(
+                    f"max_window_layers={mwl} mixes full and windowed "
+                    "layers per depth; TransformerConfig.window is uniform"
+                )
     kw = dict(
         vocab_size=hf_cfg.vocab_size,
         d_model=hf_cfg.hidden_size,
@@ -91,6 +135,7 @@ def config_from_llama(hf_cfg, dtype=jnp.float32, **overrides) -> TransformerConf
         norm="rms",
         norm_eps=float(hf_cfg.rms_norm_eps),
         tie_embeddings=bool(getattr(hf_cfg, "tie_word_embeddings", False)),
+        attention_bias=attention_bias,
     )
     kw.update(overrides)
     return TransformerConfig(**kw)
@@ -129,10 +174,13 @@ def load_llama(hf_model, dtype=jnp.float32, **cfg_overrides
             "ln1": {"scale": _v(layer.input_layernorm.weight)},
             "ln2": {"scale": _v(layer.post_attention_layernorm.weight)},
             "attn": {
-                "q": {"kernel": _t(sa.q_proj.weight)},
-                "k": {"kernel": _t(sa.k_proj.weight)},
-                "v": {"kernel": _t(sa.v_proj.weight)},
-                "out": {"kernel": _t(sa.o_proj.weight)},
+                "q": _proj(sa.q_proj, cfg.attention_bias),
+                "k": _proj(sa.k_proj, cfg.attention_bias),
+                "v": _proj(sa.v_proj, cfg.attention_bias),
+                # _proj(with_bias=False) also REJECTS an o_proj bias:
+                # the model is o-bias-free, and HF Llama attention_bias
+                # puts one there — dropping it would corrupt every layer
+                "out": _proj(sa.o_proj, False),
             },
             "mlp": {
                 "gate": {"kernel": _t(mlp.gate_proj.weight)},

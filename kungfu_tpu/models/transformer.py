@@ -83,6 +83,10 @@ class TransformerConfig:
     # Both store a single "scale" param, so the tree shape is identical.
     norm: str = "layer"  # "layer" | "rms"
     norm_eps: float = 1e-6
+    # bias vectors on the q/k/v projections only (Qwen2-style; the output
+    # projection and MLP stay bias-free).  Default False keeps the
+    # historical param tree.
+    attention_bias: bool = False
     # dropout on embeddings and each residual branch, active only when the
     # model is applied with train=True and an rngs={"dropout": key}
     # (MeshTrainer threads a per-step key to 4-arg loss functions)
@@ -169,14 +173,19 @@ def apply_rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
-def _dense(features, name, kernel_axes, dtype):
+def _dense(features, name, kernel_axes, dtype, use_bias: bool = False):
     return nn.Dense(
         features,
-        use_bias=False,
+        use_bias=use_bias,
         dtype=dtype,
         name=name,
         kernel_init=nn.with_logical_partitioning(
             nn.initializers.normal(stddev=0.02), kernel_axes
+        ),
+        # bias shards with the projection's OUTPUT dim (kernel_axes[-1]):
+        # under tp the q/k/v outputs are head-sharded, so the bias is too
+        bias_init=nn.with_logical_partitioning(
+            nn.initializers.zeros, (kernel_axes[-1],)
         ),
     )
 
@@ -191,9 +200,10 @@ class Attention(nn.Module):
         Hkv = cfg.kv_heads
         B, L, _ = x.shape
         qkv_axes = ("embed", "heads")
-        q = _dense(cfg.d_model, "q", qkv_axes, cfg.dtype)(x).reshape(B, L, H, D)
-        k = _dense(Hkv * D, "k", qkv_axes, cfg.dtype)(x).reshape(B, L, Hkv, D)
-        v = _dense(Hkv * D, "v", qkv_axes, cfg.dtype)(x).reshape(B, L, Hkv, D)
+        ab = cfg.attention_bias
+        q = _dense(cfg.d_model, "q", qkv_axes, cfg.dtype, ab)(x).reshape(B, L, H, D)
+        k = _dense(Hkv * D, "k", qkv_axes, cfg.dtype, ab)(x).reshape(B, L, Hkv, D)
+        v = _dense(Hkv * D, "v", qkv_axes, cfg.dtype, ab)(x).reshape(B, L, Hkv, D)
 
         if cfg.decode:
             # KV-cache decode: write this call's k/v at the cache cursor,

@@ -71,6 +71,39 @@ def test_mistral_logits_match_transformers():
     np.testing.assert_allclose(got, want, atol=2e-4)
 
 
+def test_qwen2_logits_match_transformers():
+    """Qwen2ForCausalLM: Llama layout + q/k/v biases + tied embeddings.
+    The attention_bias config adds bias leaves to exactly the three
+    projections; o_proj and the MLP stay bias-free on both sides."""
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+
+    torch.manual_seed(0)
+    hf = Qwen2ForCausalLM(Qwen2Config(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-6,
+        tie_word_embeddings=True, use_sliding_window=False,
+    )).eval()
+    tokens = _tokens()
+    with torch.no_grad():
+        want = hf(torch.tensor(tokens, dtype=torch.long)).logits.numpy()
+    cfg, params = load_llama(hf)
+    assert cfg.attention_bias and cfg.tie_embeddings and cfg.window == 0
+    assert "bias" in params["block_0"]["attn"]["q"]
+    assert "bias" not in params["block_0"]["attn"]["out"]
+    got = np.asarray(
+        TransformerLM(cfg).apply({"params": params}, jnp.asarray(tokens))
+    )
+    np.testing.assert_allclose(got, want, atol=2e-4)
+
+    # mixed per-depth windowing cannot map onto the uniform config
+    hf.config.use_sliding_window = True
+    hf.config.sliding_window = 8
+    hf.config.max_window_layers = 1  # of 2 layers
+    with pytest.raises(NotImplementedError, match="max_window_layers"):
+        load_llama(hf)
+
+
 def test_param_tree_matches_init():
     """Loaded params must have exactly model.init's tree structure and
     shapes — that is what lets trainers fine-tune the checkpoint."""
