@@ -191,3 +191,72 @@ def load_llama(hf_model, dtype=jnp.float32, **cfg_overrides
     if not cfg.tie_embeddings:
         params["lm_head"] = {"kernel": _t(hf_model.lm_head.weight)}
     return cfg, params
+
+
+def save_into(hf_model, params) -> None:
+    """Write TransformerLM params back into a transformers model IN PLACE
+    (the inverse of load_llama) — fine-tune here, serve anywhere.
+
+    `hf_model` supplies the architecture (typically the checkpoint the
+    params were loaded from, or a fresh `LlamaForCausalLM(config)`); its
+    config must describe the same shapes.  After this call
+    `hf_model.save_pretrained(...)` persists the tuned weights in HF
+    format."""
+    import torch
+
+    def put(linear_or_param, arr, transpose):
+        a = np.asarray(arr, np.float32)
+        if transpose:
+            a = a.T
+        t = getattr(linear_or_param, "data", linear_or_param)
+        if tuple(t.shape) != a.shape:
+            raise ValueError(f"shape mismatch: {tuple(t.shape)} vs {a.shape}")
+        with torch.no_grad():
+            t.copy_(torch.from_numpy(np.ascontiguousarray(a)))
+
+    m = hf_model.model
+    n_blocks = sum(1 for k in params if k.startswith("block_"))
+    if n_blocks != len(m.layers):
+        # the loop below would silently DROP extra fine-tuned blocks (the
+        # reverse direction fails loudly with a KeyError)
+        raise ValueError(
+            f"params carry {n_blocks} blocks but the target model has "
+            f"{len(m.layers)} layers"
+        )
+    put(m.embed_tokens.weight, params["embed"]["embedding"], False)
+    put(m.norm.weight, params["ln_f"]["scale"], False)
+    for i, layer in enumerate(m.layers):
+        p = params[f"block_{i}"]
+        sa, mlp = layer.self_attn, layer.mlp
+        put(layer.input_layernorm.weight, p["ln1"]["scale"], False)
+        put(layer.post_attention_layernorm.weight, p["ln2"]["scale"], False)
+        for name, proj in (("q", sa.q_proj), ("k", sa.k_proj),
+                           ("v", sa.v_proj), ("out", sa.o_proj)):
+            put(proj.weight, p["attn"][name]["kernel"], True)
+            if "bias" in p["attn"][name]:
+                if proj.bias is None:
+                    raise ValueError(f"{name}_proj has no bias slot")
+                put(proj.bias, p["attn"][name]["bias"], False)
+            elif proj.bias is not None:
+                raise ValueError(
+                    f"target {name}_proj expects a bias the params lack"
+                )
+        put(mlp.gate_proj.weight, p["mlp"]["gate"]["kernel"], True)
+        put(mlp.up_proj.weight, p["mlp"]["in"]["kernel"], True)
+        put(mlp.down_proj.weight, p["mlp"]["out"]["kernel"], True)
+    tied_target = bool(getattr(hf_model.config, "tie_word_embeddings", False))
+    if "lm_head" in params:
+        if tied_target:
+            # HF ties lm_head.weight TO embed_tokens.weight (one tensor):
+            # writing the untied head here would silently overwrite the
+            # embedding matrix written above
+            raise ValueError(
+                "params carry an untied lm_head but the target model ties "
+                "embeddings; use an untied target config"
+            )
+        put(hf_model.lm_head.weight, params["lm_head"]["kernel"], True)
+    elif not tied_target:
+        raise ValueError(
+            "params have no lm_head (tied embeddings) but the target "
+            "model is untied"
+        )

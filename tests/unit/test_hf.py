@@ -135,6 +135,60 @@ def test_generate_from_loaded_weights():
     np.testing.assert_array_equal(got, want)
 
 
+@pytest.mark.parametrize("family", ["llama", "llama-tied", "qwen2"])
+def test_save_into_round_trip(family):
+    """load -> perturb -> save_into a FRESH HF model -> HF logits must
+    match our forward on the perturbed params (the fine-tune-here,
+    serve-anywhere contract).  Covers untied, tied, and biased params."""
+    from kungfu_tpu.models.hf import save_into
+
+    if family == "qwen2":
+        from transformers import Qwen2Config, Qwen2ForCausalLM
+
+        torch.manual_seed(0)
+        hf = Qwen2ForCausalLM(Qwen2Config(
+            vocab_size=64, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=64,
+            tie_word_embeddings=False, use_sliding_window=False,
+        )).eval()
+        fresh_cls, fresh_cfg = Qwen2ForCausalLM, hf.config
+    else:
+        hf = _tiny_hf(tie=family == "llama-tied")
+        from transformers import LlamaForCausalLM as fresh_cls
+
+        fresh_cfg = hf.config
+    cfg, params = load_llama(hf)
+    params = jax.tree.map(lambda x: np.asarray(x) * 1.01 + 0.003, params)
+    ours = np.asarray(
+        TransformerLM(cfg).apply(
+            {"params": params}, jnp.asarray(_tokens())
+        )
+    )
+    fresh = fresh_cls(fresh_cfg).eval()
+    save_into(fresh, params)
+    with torch.no_grad():
+        theirs = fresh(
+            torch.tensor(_tokens(), dtype=torch.long)
+        ).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, atol=2e-4)
+
+
+def test_save_into_rejects_mismatched_targets():
+    from kungfu_tpu.models.hf import save_into
+
+    hf = _tiny_hf()
+    cfg, params = load_llama(hf)
+    tied = _tiny_hf(tie=True)
+    with pytest.raises(ValueError, match="ties embeddings"):
+        save_into(tied, params)  # would overwrite the shared embed tensor
+    small = _tiny_hf()
+    small.config.num_hidden_layers = 1
+    fresh = LlamaForCausalLM(small.config).eval()
+    with pytest.raises(ValueError, match="blocks"):
+        save_into(fresh, params)  # would silently drop block_1
+
+
 def test_unsupported_features_raise():
     for field, value, pat in (
         ("rope_scaling", {"rope_type": "linear", "factor": 2.0},
