@@ -30,28 +30,32 @@ import jax.numpy as jnp
 from .transformer import TransformerConfig
 
 
-def _t(w) -> np.ndarray:
+def _t(w, dtype=np.float32) -> np.ndarray:
     """torch [out, in] Linear weight -> flax [in, out] Dense kernel."""
-    return np.ascontiguousarray(np.asarray(w.detach().cpu(), np.float32).T)
+    return np.ascontiguousarray(
+        np.asarray(w.detach().cpu().float(), np.float32).T
+    ).astype(dtype, copy=False)
 
 
-def _v(w) -> np.ndarray:
-    return np.asarray(w.detach().cpu(), np.float32)
+def _v(w, dtype=np.float32) -> np.ndarray:
+    return np.asarray(
+        w.detach().cpu().float(), np.float32
+    ).astype(dtype, copy=False)
 
 
-def _proj(linear, with_bias: bool) -> dict:
+def _proj(linear, with_bias: bool, dtype=np.float32) -> dict:
     """Projection weights, validating bias presence BOTH ways: a missing
     expected bias and an unexpected existing one are each load-time
     errors — silently dropping checkpoint weights is the failure mode
     every guard in this file exists to prevent."""
-    out = {"kernel": _t(linear.weight)}
+    out = {"kernel": _t(linear.weight, dtype)}
     if with_bias:
         if linear.bias is None:
             raise ValueError(
                 "config expects attention biases but the checkpoint's "
                 "projection has none"
             )
-        out["bias"] = _v(linear.bias)
+        out["bias"] = _v(linear.bias, dtype)
     elif linear.bias is not None:
         raise NotImplementedError(
             "checkpoint projection carries a bias the config does not "
@@ -141,8 +145,8 @@ def config_from_llama(hf_cfg, dtype=jnp.float32, **overrides) -> TransformerConf
     return TransformerConfig(**kw)
 
 
-def load_llama(hf_model, dtype=jnp.float32, **cfg_overrides
-               ) -> Tuple[TransformerConfig, Any]:
+def load_llama(hf_model, dtype=jnp.float32, param_dtype=None,
+               **cfg_overrides) -> Tuple[TransformerConfig, Any]:
     """(TransformerConfig, params) from a transformers Llama- or
     Mistral-family ForCausalLM (identical module layout; Mistral adds the
     sliding window, mapped in config_from_llama).
@@ -161,35 +165,40 @@ def load_llama(hf_model, dtype=jnp.float32, **cfg_overrides
     Head ordering needs no shuffle: both sides emit projection features
     head-major and reshape to [B, L, H, D], and both apply rotate-half
     rotary with the same theta schedule.
+
+    `param_dtype` sets the STORAGE dtype of the loaded tree (default f32
+    master weights — right for fine-tuning; `jnp.bfloat16` halves memory
+    for inference-only serving).  `dtype` remains the compute dtype.
     """
+    pd = np.dtype(jnp.dtype(param_dtype)) if param_dtype else np.float32
     cfg = config_from_llama(hf_model.config, dtype=dtype, **cfg_overrides)
     m = hf_model.model
     params: dict = {
-        "embed": {"embedding": _v(m.embed_tokens.weight)},
-        "ln_f": {"scale": _v(m.norm.weight)},
+        "embed": {"embedding": _v(m.embed_tokens.weight, pd)},
+        "ln_f": {"scale": _v(m.norm.weight, pd)},
     }
     for i, layer in enumerate(m.layers):
         sa, mlp = layer.self_attn, layer.mlp
         params[f"block_{i}"] = {
-            "ln1": {"scale": _v(layer.input_layernorm.weight)},
-            "ln2": {"scale": _v(layer.post_attention_layernorm.weight)},
+            "ln1": {"scale": _v(layer.input_layernorm.weight, pd)},
+            "ln2": {"scale": _v(layer.post_attention_layernorm.weight, pd)},
             "attn": {
-                "q": _proj(sa.q_proj, cfg.attention_bias),
-                "k": _proj(sa.k_proj, cfg.attention_bias),
-                "v": _proj(sa.v_proj, cfg.attention_bias),
+                "q": _proj(sa.q_proj, cfg.attention_bias, pd),
+                "k": _proj(sa.k_proj, cfg.attention_bias, pd),
+                "v": _proj(sa.v_proj, cfg.attention_bias, pd),
                 # _proj(with_bias=False) also REJECTS an o_proj bias:
                 # the model is o-bias-free, and HF Llama attention_bias
                 # puts one there — dropping it would corrupt every layer
-                "out": _proj(sa.o_proj, False),
+                "out": _proj(sa.o_proj, False, pd),
             },
             "mlp": {
-                "gate": {"kernel": _t(mlp.gate_proj.weight)},
-                "in": {"kernel": _t(mlp.up_proj.weight)},
-                "out": {"kernel": _t(mlp.down_proj.weight)},
+                "gate": {"kernel": _t(mlp.gate_proj.weight, pd)},
+                "in": {"kernel": _t(mlp.up_proj.weight, pd)},
+                "out": {"kernel": _t(mlp.down_proj.weight, pd)},
             },
         }
     if not cfg.tie_embeddings:
-        params["lm_head"] = {"kernel": _t(hf_model.lm_head.weight)}
+        params["lm_head"] = {"kernel": _t(hf_model.lm_head.weight, pd)}
     return cfg, params
 
 
@@ -201,62 +210,73 @@ def save_into(hf_model, params) -> None:
     params were loaded from, or a fresh `LlamaForCausalLM(config)`); its
     config must describe the same shapes.  After this call
     `hf_model.save_pretrained(...)` persists the tuned weights in HF
-    format."""
+    format.
+
+    All structural and shape validation happens BEFORE the first write:
+    a rejected call leaves `hf_model` untouched (a mid-loop raise would
+    otherwise corrupt what may be the caller's only copy of the original
+    checkpoint).
+    """
     import torch
 
-    def put(linear_or_param, arr, transpose):
+    writes = []  # (torch tensor, ready numpy array) — committed at the end
+
+    def plan(linear_or_param, arr, transpose):
         a = np.asarray(arr, np.float32)
         if transpose:
             a = a.T
         t = getattr(linear_or_param, "data", linear_or_param)
         if tuple(t.shape) != a.shape:
             raise ValueError(f"shape mismatch: {tuple(t.shape)} vs {a.shape}")
-        with torch.no_grad():
-            t.copy_(torch.from_numpy(np.ascontiguousarray(a)))
+        writes.append((t, np.ascontiguousarray(a)))
 
     m = hf_model.model
     n_blocks = sum(1 for k in params if k.startswith("block_"))
     if n_blocks != len(m.layers):
-        # the loop below would silently DROP extra fine-tuned blocks (the
-        # reverse direction fails loudly with a KeyError)
+        # silently DROPPING extra fine-tuned blocks (the reverse direction
+        # fails loudly with a KeyError) must not happen
         raise ValueError(
             f"params carry {n_blocks} blocks but the target model has "
             f"{len(m.layers)} layers"
         )
-    put(m.embed_tokens.weight, params["embed"]["embedding"], False)
-    put(m.norm.weight, params["ln_f"]["scale"], False)
-    for i, layer in enumerate(m.layers):
-        p = params[f"block_{i}"]
-        sa, mlp = layer.self_attn, layer.mlp
-        put(layer.input_layernorm.weight, p["ln1"]["scale"], False)
-        put(layer.post_attention_layernorm.weight, p["ln2"]["scale"], False)
-        for name, proj in (("q", sa.q_proj), ("k", sa.k_proj),
-                           ("v", sa.v_proj), ("out", sa.o_proj)):
-            put(proj.weight, p["attn"][name]["kernel"], True)
-            if "bias" in p["attn"][name]:
-                if proj.bias is None:
-                    raise ValueError(f"{name}_proj has no bias slot")
-                put(proj.bias, p["attn"][name]["bias"], False)
-            elif proj.bias is not None:
-                raise ValueError(
-                    f"target {name}_proj expects a bias the params lack"
-                )
-        put(mlp.gate_proj.weight, p["mlp"]["gate"]["kernel"], True)
-        put(mlp.up_proj.weight, p["mlp"]["in"]["kernel"], True)
-        put(mlp.down_proj.weight, p["mlp"]["out"]["kernel"], True)
     tied_target = bool(getattr(hf_model.config, "tie_word_embeddings", False))
-    if "lm_head" in params:
-        if tied_target:
-            # HF ties lm_head.weight TO embed_tokens.weight (one tensor):
-            # writing the untied head here would silently overwrite the
-            # embedding matrix written above
-            raise ValueError(
-                "params carry an untied lm_head but the target model ties "
-                "embeddings; use an untied target config"
-            )
-        put(hf_model.lm_head.weight, params["lm_head"]["kernel"], True)
-    elif not tied_target:
+    if "lm_head" in params and tied_target:
+        # HF ties lm_head.weight TO embed_tokens.weight (one tensor):
+        # writing the untied head would silently overwrite the embedding
+        raise ValueError(
+            "params carry an untied lm_head but the target model ties "
+            "embeddings; use an untied target config"
+        )
+    if "lm_head" not in params and not tied_target:
         raise ValueError(
             "params have no lm_head (tied embeddings) but the target "
             "model is untied"
         )
+
+    plan(m.embed_tokens.weight, params["embed"]["embedding"], False)
+    plan(m.norm.weight, params["ln_f"]["scale"], False)
+    for i, layer in enumerate(m.layers):
+        p = params[f"block_{i}"]
+        sa, mlp = layer.self_attn, layer.mlp
+        plan(layer.input_layernorm.weight, p["ln1"]["scale"], False)
+        plan(layer.post_attention_layernorm.weight, p["ln2"]["scale"], False)
+        for name, proj in (("q", sa.q_proj), ("k", sa.k_proj),
+                           ("v", sa.v_proj), ("out", sa.o_proj)):
+            plan(proj.weight, p["attn"][name]["kernel"], True)
+            if "bias" in p["attn"][name]:
+                if proj.bias is None:
+                    raise ValueError(f"{name}_proj has no bias slot")
+                plan(proj.bias, p["attn"][name]["bias"], False)
+            elif proj.bias is not None:
+                raise ValueError(
+                    f"target {name}_proj expects a bias the params lack"
+                )
+        plan(mlp.gate_proj.weight, p["mlp"]["gate"]["kernel"], True)
+        plan(mlp.up_proj.weight, p["mlp"]["in"]["kernel"], True)
+        plan(mlp.down_proj.weight, p["mlp"]["out"]["kernel"], True)
+    if "lm_head" in params:
+        plan(hf_model.lm_head.weight, params["lm_head"]["kernel"], True)
+
+    with torch.no_grad():
+        for t, a in writes:
+            t.copy_(torch.from_numpy(a))

@@ -174,14 +174,35 @@ def test_save_into_round_trip(family):
     np.testing.assert_allclose(ours, theirs, atol=2e-4)
 
 
+def test_bf16_param_storage():
+    """param_dtype=bf16 halves the tree's bytes; logits stay within bf16
+    rounding of the f32-master load (inference-serving memory lever)."""
+    hf = _tiny_hf()
+    cfg32, p32 = load_llama(hf)
+    cfg16, p16 = load_llama(hf, dtype=jnp.bfloat16,
+                            param_dtype=jnp.bfloat16)
+    bytes32 = sum(np.asarray(x).nbytes for x in jax.tree.leaves(p32))
+    bytes16 = sum(np.asarray(x).nbytes for x in jax.tree.leaves(p16))
+    assert bytes16 * 2 == bytes32
+    tokens = _tokens()
+    a = np.asarray(TransformerLM(cfg32).apply(
+        {"params": p32}, jnp.asarray(tokens)), np.float32)
+    b = np.asarray(TransformerLM(cfg16).apply(
+        {"params": p16}, jnp.asarray(tokens)), np.float32)
+    np.testing.assert_allclose(a, b, atol=0.15)
+
+
 def test_save_into_rejects_mismatched_targets():
     from kungfu_tpu.models.hf import save_into
 
     hf = _tiny_hf()
     cfg, params = load_llama(hf)
     tied = _tiny_hf(tie=True)
+    before = tied.model.embed_tokens.weight.detach().clone()
     with pytest.raises(ValueError, match="ties embeddings"):
         save_into(tied, params)  # would overwrite the shared embed tensor
+    # validate-then-commit: a rejected call must leave the target untouched
+    assert torch.equal(tied.model.embed_tokens.weight, before)
     small = _tiny_hf()
     small.config.num_hidden_layers = 1
     fresh = LlamaForCausalLM(small.config).eval()
