@@ -41,6 +41,9 @@ def main() -> int:
     ap.add_argument("--lr", type=float, default=1e-3)
     ap.add_argument("--generate", type=int, default=0, metavar="N")
     ap.add_argument("--kv-int8", action="store_true")
+    ap.add_argument("--save-dir", default="",
+                    help="write the tuned weights back in HF format "
+                         "(save_into + save_pretrained)")
     args = ap.parse_args()
 
     import jax
@@ -68,7 +71,8 @@ def main() -> int:
             num_key_value_heads=2, max_position_embeddings=128,
         ))
     cfg, params = load_llama(hf, dtype=jnp.float32)
-    del hf  # torch weights copied; free them
+    hf_cfg = hf.config
+    del hf  # torch weights copied; a fresh model is rebuilt for --save-dir
     model = TransformerLM(cfg)
     n_params = sum(np.asarray(x).size for x in jax.tree.leaves(params))
     print(f"# loaded llama: {n_params / 1e6:.2f}M params, "
@@ -96,17 +100,31 @@ def main() -> int:
     dt = time.perf_counter() - t0
     tps = args.steps * tokens.size / dt
 
+    tuned = None
+    if args.generate > 0 or args.save_dir:  # one device->host gather
+        tuned = jax.tree.map(np.asarray, trainer.eval_params(state))
+
     if args.generate > 0:
         import dataclasses
 
         gcfg = dataclasses.replace(
             cfg, kv_cache_dtype="int8" if args.kv_int8 else cfg.kv_cache_dtype
         )
-        tuned = jax.tree.map(np.asarray, trainer.eval_params(state))
         out = np.asarray(
             generate(gcfg, tuned, jnp.asarray(tokens[:1, :8]), args.generate)
         )
         print(f"# generated {out[0, 8:].tolist()}", flush=True)
+
+    if args.save_dir:
+        from transformers import LlamaForCausalLM
+
+        from kungfu_tpu.models.hf import save_into
+
+        target = LlamaForCausalLM(hf_cfg)  # fresh shell, built only now
+        save_into(target, tuned)
+        target.save_pretrained(args.save_dir)
+        print(f"# tuned weights saved in HF format at {args.save_dir}",
+              flush=True)
 
     print(f"RESULT: example=llama_finetune loss={loss:.4f} "
           f"steps={args.steps} tokens_per_sec={tps:.0f}", flush=True)
