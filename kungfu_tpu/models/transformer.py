@@ -589,6 +589,8 @@ def generate(
     max_new_tokens: int,
     temperature: float = 0.0,
     rng: Optional[jax.Array] = None,
+    mesh: Optional[Mesh] = None,
+    rules=None,
 ) -> jax.Array:
     """Autoregressive generation with a KV cache (prefill + jitted scan).
 
@@ -597,6 +599,15 @@ def generate(
     temperature 0, categorical sampling otherwise.  Returns
     [B, prompt_len + max_new_tokens] tokens.  Beyond-parity capability: the
     reference is training-only.
+
+    `mesh`: tensor-parallel serving — params are placed under the rules
+    table (q/k/v/mlp kernels shard over tp, Megatron-style) and GSPMD
+    propagates the sharding through the decode scan, KV cache included
+    (the cache inherits the head sharding from the sharded k/v writes).
+    Serves models whose weights exceed one chip.  Numerics match the
+    single-device path up to reduction-order ULPs (the tp psum sums
+    partials in a different order), so greedy tokens agree except at
+    exact logit near-ties.
     """
     assert prompt.ndim == 2
     b, prompt_len = prompt.shape
@@ -607,7 +618,8 @@ def generate(
     assert prompt_len + max_new_tokens <= cfg.max_len, (
         f"{prompt_len}+{max_new_tokens} exceeds max_len={cfg.max_len}"
     )
-    # decode overrides: full attention on the cache, no mesh, and a dense
+    # decode overrides: full attention on the cache, no shard_map region
+    # (under `mesh`, sharding is GSPMD-propagated instead), and a dense
     # head (a head="hidden"-trained config shares the same param tree, so
     # its params decode unchanged)
     dcfg = dataclasses.replace(
@@ -617,7 +629,16 @@ def generate(
         rng = jax.random.PRNGKey(0)
     run = _generate_compiled(dcfg, b, prompt_len, max_new_tokens, temperature)
     model = TransformerLM(dcfg)
-    cache = model.init(jax.random.PRNGKey(0), prompt[:, :1])["cache"]
+    variables = model.init(jax.random.PRNGKey(0), prompt[:, :1])
+    cache = variables["cache"]
+    if mesh is not None:
+        from ..parallel.sharding import param_shardings
+
+        # the init above already carries the partition metadata — no
+        # second trace needed
+        params = jax.device_put(
+            params, param_shardings(mesh, variables["params"], rules)
+        )
     return run(params, cache, prompt, rng)
 
 
