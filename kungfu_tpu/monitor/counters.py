@@ -118,3 +118,13 @@ _global = Counters()
 
 def global_counters() -> Counters:
     return _global
+
+
+def counters_if_enabled() -> Optional[Counters]:
+    """Global byte counters, or None when monitoring is off — hot paths must
+    not pay lock+deque overhead nobody reads (gate mirrors the reference's
+    KUNGFU_CONFIG_ENABLE_MONITORING, peer.go:92-99).  Callers evaluate this
+    once per object: the env gate cannot meaningfully change mid-process."""
+    from .server import enabled
+
+    return _global if enabled() else None

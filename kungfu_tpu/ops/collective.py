@@ -135,6 +135,16 @@ def hierarchical_all_reduce(
     return out.reshape(-1)[: x.size].reshape(x.shape)
 
 
+def cross_all_reduce(x: jax.Array, dcn_axis: str, op: str = "sum") -> jax.Array:
+    """Cross-host-only allreduce (reference session/allreduce.go:38
+    CrossAllReduce): reduce over the DCN axis alone, leaving intra-host
+    values un-mixed.  Where the reference runs it among one local root per
+    host, here every local rank reduces with its same-ici-coordinate
+    counterparts on the other hosts — same cross-host semantics, L-way more
+    cross-host bandwidth."""
+    return all_reduce(x, dcn_axis, op)
+
+
 # --- derived collectives --------------------------------------------------------------
 
 
@@ -163,6 +173,18 @@ def reduce(x: jax.Array, axis_name: AxisName, root: int = 0, op: str = "sum") ->
     s = all_reduce(x, axis_name, op)
     idx = _flat_axis_index(axis_name)
     return jnp.where(idx == root, s, jnp.zeros_like(s))
+
+
+def gather(x: jax.Array, axis_name: AxisName, root: int = 0) -> jax.Array:
+    """Gather-to-root: root holds every peer's slice stacked on a new
+    leading dim; non-roots get zeros (reference root-gather,
+    session/session.go:185-207).  SPMD has no asymmetric receive, so the
+    gather is an all_gather with non-root results masked — the wire cost is
+    higher than a true root-gather but it rides ICI, and XLA drops the
+    dead branches when the non-root outputs are unused."""
+    g = lax.all_gather(x, axis_name)
+    idx = _flat_axis_index(axis_name)
+    return jnp.where(idx == root, g, jnp.zeros_like(g))
 
 
 def barrier(axis_name: AxisName) -> jax.Array:

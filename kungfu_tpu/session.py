@@ -42,15 +42,6 @@ from .utils import get_logger, stall_detector
 log = get_logger("kungfu.session")
 
 
-def _counters():
-    """Global byte counters, or None when monitoring is off — the hot path
-    must not pay lock+deque overhead nobody reads (gate mirrors the
-    reference's KUNGFU_CONFIG_ENABLE_MONITORING, peer.go:92-99).  Evaluated
-    once per Session: the env gate cannot meaningfully change mid-process."""
-    from .monitor.server import enabled
-    from .monitor.counters import global_counters
-
-    return global_counters() if enabled() else None
 
 
 class OpStats:
@@ -104,7 +95,9 @@ class Session:
         self.strategy = strategy
         self.host_count = host_count
         self.stats = OpStats()
-        self._byte_counters = _counters()
+        from .monitor.counters import counters_if_enabled
+
+        self._byte_counters = counters_if_enabled()
         self._fns: Dict[Any, Callable] = {}
         names = self.mesh.axis_names
         self._hierarchical_axes = ("ici", "dcn") if ("ici" in names and "dcn" in names) else None
@@ -210,6 +203,13 @@ class Session:
         elif kind == "all_gather":
             def body(x):
                 return C.all_gather(jnp.squeeze(x, 0), axis)[None]
+        elif kind == "gather":
+            root = kw["root"]
+            def body(x):
+                return C.gather(jnp.squeeze(x, 0), axis, root=root)[None]
+        elif kind == "cross_all_reduce":
+            def body(x):
+                return C.cross_all_reduce(jnp.squeeze(x, 0), "dcn", op)[None]
         elif kind == "barrier":
             def body(x):
                 return C.barrier(axis)[None]
@@ -223,15 +223,19 @@ class Session:
 
     # -- public collective API (reference session/{allreduce,allgather,session}.go) ---
 
-    def _dispatch(self, kind: str, x: jax.Array, op: str = "sum",
-                  strategy: Optional[Strategy] = None, **kw) -> jax.Array:
-        """Enqueue one compiled collective without waiting for it."""
+    def _check_stacked(self, x) -> jax.Array:
         x = jnp.asarray(x)
         if x.shape[0] != self.size:
             raise ValueError(
                 f"leading dim {x.shape[0]} != session size {self.size}; "
                 "per-peer tensors are stacked on dim 0"
             )
+        return x
+
+    def _dispatch(self, kind: str, x: jax.Array, op: str = "sum",
+                  strategy: Optional[Strategy] = None, **kw) -> jax.Array:
+        """Enqueue one compiled collective without waiting for it."""
+        x = self._check_stacked(x)
         impl = self._impl(strategy)
         fn = self._compiled(kind, op, impl, **kw)
         return fn(x)
@@ -351,6 +355,30 @@ class Session:
 
     def all_gather(self, x, name: str = ""):
         return self._run("all_gather", x, name=name)
+
+    def gather(self, x, root: int = 0, name: str = ""):
+        """Gather-to-root (reference session/session.go:185-207): the root
+        row holds every peer's value stacked on a new dim; other rows are
+        zeros."""
+        return self._run("gather", x, name=name, root=root)
+
+    def cross_all_reduce(self, x, op: str = "sum", name: str = ""):
+        """Cross-host-only allreduce (reference session/allreduce.go:38).
+
+        Requires the hierarchical ici×dcn mesh.  On a genuinely single-host
+        session it is the identity, matching the reference where a 1-host
+        cluster has no cross graph; a multi-host session on a flat mesh is
+        an error — silently skipping the cross reduction would change
+        semantics."""
+        if self._hierarchical_axes is None:
+            if self.host_count > 1:
+                raise ValueError(
+                    f"cross_all_reduce needs an ici×dcn mesh, but this "
+                    f"session spans {self.host_count} hosts on a flat mesh "
+                    f"{self._axes}; build it with make_hierarchical_mesh"
+                )
+            return self._check_stacked(x)
+        return self._run("cross_all_reduce", x, op=op, name=name)
 
     def barrier(self) -> None:
         x = jnp.zeros((self.size, 1), jnp.int32)

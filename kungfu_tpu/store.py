@@ -37,6 +37,15 @@ from .utils import get_logger
 
 log = get_logger("kungfu.store")
 
+
+def _counters():
+    """The reference accounts BOTH directions at the rchannel transport
+    (monitor/counters.go:13-110); the store is the only host-side transport
+    here, so it is where ingress is counted."""
+    from .monitor.counters import counters_if_enabled
+
+    return counters_if_enabled()
+
 # store listens on worker_port + offset.  Default worker ports are
 # 10000-10999 (plan), putting stores at 25000-25999: below the Linux
 # ephemeral range (32768+) so outbound connections cannot squat our binds,
@@ -202,13 +211,20 @@ class StoreServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self.store = Store()
         self.versioned = VersionedStore()
+        self._counters = _counters()
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
+                # per-remote-host keys, like the reference's per-peer
+                # counters (counters.go:13-110)
+                ckey = f"store:{self.client_address[0]}"
+                c = outer._counters
                 try:
                     while True:
                         op, version, name, payload = _read_frame(self.request)
+                        if c is not None and payload:
+                            c.add_ingress(ckey, len(payload))
                         if op == _OP_SAVE:
                             blob = Blob.unpack(payload)
                             if version:
@@ -229,6 +245,8 @@ class StoreServer:
                             else:
                                 data = blob.pack()
                                 self.request.sendall(struct.pack(">BQ", _ST_OK, len(data)) + data)
+                                if c is not None:
+                                    c.add_egress(ckey, len(data))
                         else:
                             return
                 except (ConnectionError, OSError):
@@ -283,6 +301,7 @@ class StoreClient:
             self.DEFAULT_OP_TIMEOUT if op_timeout is None else op_timeout
         )
         self._global_lock = threading.Lock()
+        self._counters = _counters()
 
     def _endpoint(self, peer: PeerID) -> Tuple[str, int]:
         return (peer.host, store_port(peer.port))
@@ -332,6 +351,13 @@ class StoreClient:
                     _write_frame(sock, op, version, name, payload)
                     status, plen = struct.unpack(">BQ", _read_exact(sock, 9))
                     body = _read_exact(sock, plen) if plen else b""
+                    c = self._counters
+                    if c is not None:
+                        ckey = f"store:{ep[0]}:{ep[1]}"
+                        if payload:
+                            c.add_egress(ckey, len(payload))
+                        if body:
+                            c.add_ingress(ckey, len(body))
                     return status, body
                 except (ConnectionError, OSError):
                     sock.close()

@@ -119,6 +119,41 @@ class TestOtherCollectives:
         for r in range(sess.size):
             np.testing.assert_allclose(out[r], x, rtol=1e-6)
 
+    def test_gather_root_only(self, sess):
+        # reference root-gather (session/session.go:185-207): root holds the
+        # stack, non-roots zeros
+        x = per_peer_values(sess.size, shape=(3,), seed=11)
+        out = np.asarray(sess.gather(x, root=2))
+        assert out.shape == (sess.size, sess.size, 3)
+        np.testing.assert_allclose(out[2], x, rtol=1e-6)
+        assert np.all(out[0] == 0) and np.all(out[7] == 0)
+
+    def test_cross_all_reduce_hierarchical(self, hier_sess):
+        # reference CrossAllReduce (session/allreduce.go:38): reduce over
+        # hosts only — each (host h, local l) slot sums with the same local
+        # slot on every other host
+        n = hier_sess.size
+        hosts = hier_sess.mesh.shape["dcn"]
+        local = n // hosts
+        x = per_peer_values(n, shape=(4,), seed=12)
+        out = np.asarray(hier_sess.cross_all_reduce(x))
+        grid = x.reshape(hosts, local, 4)
+        want = np.broadcast_to(grid.sum(axis=0), (hosts, local, 4)).reshape(n, 4)
+        np.testing.assert_allclose(out, want, rtol=1e-5)
+
+    def test_cross_all_reduce_single_host_identity(self, sess):
+        x = per_peer_values(sess.size, seed=13)
+        out = np.asarray(sess.cross_all_reduce(x))
+        np.testing.assert_allclose(out, x, rtol=1e-6)
+        with pytest.raises(ValueError):  # same shape contract as every op
+            sess.cross_all_reduce(x[:3])
+
+    def test_cross_all_reduce_multi_host_flat_mesh_rejected(self):
+        # silently skipping the cross reduction would change semantics
+        sess = Session(make_mesh(dp=-1), host_count=4)
+        with pytest.raises(ValueError, match="ici×dcn"):
+            sess.cross_all_reduce(per_peer_values(sess.size, seed=14))
+
     def test_barrier(self, sess):
         sess.barrier()  # completes
 

@@ -68,6 +68,39 @@ def test_tcp_save_request_roundtrip(server):
     client.close()
 
 
+def test_store_counts_ingress_and_egress(monkeypatch):
+    """Both traffic directions land in /metrics (reference counts both at
+    the transport, monitor/counters.go:13-110): server ingress on SAVE,
+    client ingress on REQUEST responses."""
+    from kungfu_tpu.monitor.counters import global_counters
+
+    monkeypatch.setenv("KFT_CONFIG_ENABLE_MONITORING", "1")
+    srv = StoreServer(host="127.0.0.1", port=0).start()
+    try:
+        client = StoreClient(retries=3, retry_interval=0.01)
+        peer = _peer_for(srv)
+        arr = np.random.RandomState(1).randn(64, 3).astype(np.float32)
+        client.save(peer, "w", arr)
+        got = client.request(peer, "w")
+        np.testing.assert_array_equal(got, arr)
+        client.close()
+        etot, itot = global_counters().totals()
+        srv_keys = [k for k in itot if k == "store:127.0.0.1"]
+        cli_keys = [k for k in itot if k.startswith(f"store:127.0.0.1:{srv.port}")]
+        assert srv_keys, f"server ingress missing: {sorted(itot)}"
+        assert cli_keys, f"client ingress missing: {sorted(itot)}"
+        # SAVE payload >= raw bytes (meta header added by Blob.pack)
+        assert itot["store:127.0.0.1"] >= arr.nbytes
+        assert itot[cli_keys[0]] >= arr.nbytes
+        # egress mirrors: client pushes the SAVE, server answers the REQUEST
+        assert etot.get(cli_keys[0], 0) >= arr.nbytes
+        assert etot.get("store:127.0.0.1", 0) >= arr.nbytes
+        text = global_counters().prometheus_text()
+        assert "ingress_total_bytes" in text and "store:127.0.0.1" in text
+    finally:
+        srv.close()
+
+
 def test_tcp_request_missing_nowait(server):
     client = StoreClient(retries=3, retry_interval=0.01)
     assert client.request(_peer_for(server), "nope", wait=False) is None
