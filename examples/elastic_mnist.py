@@ -98,12 +98,20 @@ def main():
         from kungfu_tpu.optimizers.monitor import get_noise_scale
 
         gns = f" gns={float(np.asarray(get_noise_scale(out['state'].opt_state))):.4f}"
+    lat = ""
+    if out["resize_p50_s"] is not None:
+        lat = (f" resize_p50_s={out['resize_p50_s']} "
+               f"resize_p95_s={out['resize_p95_s']}")
     print(
         f"RESULT: loss={out['loss']:.4f} trained={out['trained_samples']} "
         f"resizes={out['resizes']} final_size={out['final_size']} "
-        f"seconds={out['seconds']:.1f}{gns}",
+        f"seconds={out['seconds']:.1f}{lat}{gns}",
         flush=True,
     )
+    if out["resize_events"]:
+        import json
+
+        print("RESIZE_EVENTS: " + json.dumps(out["resize_events"]), flush=True)
 
 
 if __name__ == "__main__":

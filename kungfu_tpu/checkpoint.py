@@ -48,7 +48,16 @@ def reset_orbax_runtime_caches() -> None:
     and every subsequent async save dies with 'failed to connect'.  Call
     this whenever the distributed runtime is torn down.  (Private orbax
     surface — gated so an orbax upgrade degrades to a no-op.)
+
+    Never-imported orbax has no caches: importing it HERE just to clear
+    nothing costs ~11s per process on a small host (measured as the
+    dominant phase of the first elastic resize) — so this is a no-op
+    unless orbax is already in sys.modules.
     """
+    import sys
+
+    if not any(m == "orbax" or m.startswith("orbax.") for m in sys.modules):
+        return
     try:  # pragma: no cover - exercised via elastic integration tests
         from orbax.checkpoint._src.futures import signaling_client
 

@@ -195,19 +195,46 @@ def _snapshot_local_replica(tree) -> Any:
     return first_local_replica(tree)
 
 
+def _maybe_enable_compile_cache() -> None:
+    """Opt-in persistent XLA compilation cache (KFT_COMPILE_CACHE_DIR).
+
+    Resize latency is dominated by the rebuild/compile phase (measured in
+    the resize_latency record): every resize tears the backend down
+    (jax.clear_caches + _clear_backends), so in-memory compiled fns cannot
+    survive.  The disk cache CAN — it keys on HLO + topology, so a resize
+    back to a previously-seen mesh size skips XLA compilation entirely.
+    The reference has no analog (its TF graphs never recompile on resize;
+    recompilation is the price of the XLA design, and this is its rebate).
+    """
+    d = os.environ.get("KFT_COMPILE_CACHE_DIR")
+    if not d:
+        return
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", d)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+
 def _teardown_backend() -> None:
     import jax
     import jax._src.xla_bridge as xb
 
+    t0 = time.perf_counter()
     try:
         jax.distributed.shutdown()
     except Exception as e:  # pragma: no cover
         log.warning("distributed shutdown: %s", e)
+    t1 = time.perf_counter()
     jax.clear_caches()
     xb._clear_backends()
+    t2 = time.perf_counter()
     from ..checkpoint import reset_orbax_runtime_caches
 
     reset_orbax_runtime_caches()
+    if os.environ.get("KFT_DEBUG_TEARDOWN"):
+        log.info("teardown: shutdown=%.3fs clear=%.3fs orbax=%.3fs",
+                 t1 - t0, t2 - t1, time.perf_counter() - t2)
 
 
 def run_elastic(
@@ -235,10 +262,19 @@ def run_elastic(
     import kungfu_tpu
     from ..train import DataParallelTrainer, TrainState
 
+    _maybe_enable_compile_cache()
     peer = kungfu_tpu.init()
     client = ConfigClient(peer.config.config_server) if peer.config.config_server else None
     schedule = StepBasedSchedule(cfg.schedule)
     resizes = 0
+    # per-resize latency accounting (reference resize profiler,
+    # experimental/hook/elastic.py:12-48 — it wraps the reconfig op the
+    # same way).  Phases: snapshot -> ckpt_release -> teardown -> reinit
+    # (jax.distributed rendezvous at the new version port) -> rebuild
+    # (mesh + program construction) -> sync (compile + run of the state
+    # broadcast) -> first_step (train-step recompile on the new mesh).
+    resize_events: list = []
+    _first_step_after_resize = False
 
     import inspect
 
@@ -386,16 +422,29 @@ def run_elastic(
                         # SIGTERM this (now-removed) worker at any moment
                         print(f"DETACHED: rank left cluster at version {version}",
                               flush=True)
+                    ev = {"version": version, "old_size": peer.size,
+                          "new_size": cluster.size(), "phases": {}}
+
+                    def _phase(name, _t=[time.perf_counter()]):
+                        now = time.perf_counter()
+                        ev["phases"][name] = round(now - _t[0], 4)
+                        _t[0] = now
+
                     snap_params, snap_opt = snap(state)
+                    _phase("snapshot")
                     if ckpt is not None:
                         # flush queued async saves and drop the orbax manager
                         # BEFORE the runtime it is bound to is torn down (a
                         # detaching primary must not abandon queued saves)
                         ckpt.release()
+                        _phase("ckpt_release")
                     _teardown_backend()
+                    _phase("teardown")
                     if not peer.update_cluster(cluster, version):
                         sys.exit(0)
+                    _phase("reinit")
                     trainer, programs = build()
+                    _phase("rebuild")
                     if ckpt is not None:
                         # primariness follows the POST-resize rank: the new
                         # rank 0 re-acquires a manager bound to the NEW runtime
@@ -403,15 +452,29 @@ def run_elastic(
                     (offset, step), synced = programs.sync_state(
                         (offset, step), {"params": snap_params, "opt": snap_opt}
                     )
+                    _phase("sync")
                     state = TrainState(synced["params"], synced["opt"], step)
                     data = make_data(peer.rank, peer.size, offset)
                     skip_check_at = step
                     resizes += 1
+                    resize_events.append(ev)
+                    _first_step_after_resize = True
                 else:  # unreachable given digest consensus; log if it ever is
                     log.warning("agreed version %d but no matching doc cached", version)
 
         batch = trainer.shard_batch(next(data))
-        state, metrics = trainer.train_step(state, batch)
+        if _first_step_after_resize:
+            import jax
+
+            t_fs = time.perf_counter()
+            state, metrics = trainer.train_step(state, batch)
+            jax.block_until_ready(metrics)  # force the recompile into the timing
+            ev = resize_events[-1]
+            ev["phases"]["first_step"] = round(time.perf_counter() - t_fs, 4)
+            ev["total_s"] = round(sum(ev["phases"].values()), 4)
+            _first_step_after_resize = False
+        else:
+            state, metrics = trainer.train_step(state, batch)
         offset += cfg.batch_size * trainer.world
         step += 1
 
@@ -432,12 +495,27 @@ def run_elastic(
 
     loss = float(np.asarray(metrics["loss"]))
     dt = time.time() - t_start
+    totals = sorted(e.get("total_s", sum(e["phases"].values()))
+                    for e in resize_events)
+
+    def _pct(p: float) -> Optional[float]:
+        if not totals:
+            return None
+        import math
+
+        # nearest-rank percentile: ceil(p*n)-1 (int(p*n) is upper-biased —
+        # with 2 resizes it would report the max as the median)
+        return round(totals[max(0, math.ceil(p * len(totals)) - 1)], 4)
+
     return {
         "loss": loss,
         "trained_samples": offset,
         "resizes": resizes,
         "final_size": peer.size,
         "seconds": dt,
+        "resize_events": resize_events,
+        "resize_p50_s": _pct(0.50),
+        "resize_p95_s": _pct(0.95),
         "state": state,
         "trainer": trainer,
     }

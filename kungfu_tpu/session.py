@@ -302,7 +302,14 @@ class Session:
         one program containing every tensor's reduction, one dispatch, and
         XLA's all-reduce combiner batching the wires.  A/B via `python -m
         kungfu_tpu.benchmarks` [--no-fuse]; measured numbers live in
-        BENCH_CONFIGS.json (allreduce-scaling config).
+        BENCH_CONFIGS.json (allreduce-scaling config).  Measured: fused
+        beats per-tensor in absolute step time at EVERY mesh size, so
+        fused stays the unconditional default (1.71x @np2, 1.54x @np4,
+        1.39x @np8 on the CPU mesh, BENCH_CONFIGS speedup_by_np) — the
+        r4 record's apparent
+        efficiency inversion at np=8 was each arm self-normalizing by its
+        own np=2 baseline (per-tensor's inflated by ~161 per-dispatch
+        overheads that amortize with np), not a crossover in this path.
 
         fuse=False: dispatch every tensor's collective separately, then sync
         once.  TPU executes enqueued programs in order, so this is N

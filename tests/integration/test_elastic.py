@@ -1,5 +1,6 @@
 """Elastic resize integration test — the reference's
 test_tensorflow_resize.py:31-79 analog, via the launcher's watch mode."""
+import json
 import os
 import subprocess
 import sys
@@ -56,6 +57,18 @@ class TestElasticE2E:
         for line in results:
             assert "resizes=2" in line, line
             assert "trained=4480" in line, line
+            # per-resize latency is recorded (reference resize profiler
+            # analog, experimental/hook/elastic.py:12-48)
+            assert "resize_p50_s=" in line and "resize_p95_s=" in line, line
+        events_lines = [l for l in out.splitlines() if "RESIZE_EVENTS:" in l]
+        assert events_lines, out[-3000:]
+        events = json.loads(events_lines[0].split("RESIZE_EVENTS:", 1)[1])
+        assert len(events) == 2
+        for ev in events:
+            for phase in ("snapshot", "teardown", "reinit", "rebuild",
+                          "sync", "first_step"):
+                assert phase in ev["phases"], ev
+            assert ev["total_s"] > 0
 
 
 @pytest.mark.slow
