@@ -12,7 +12,12 @@ Reference-named aliases (for users migrating from KungFu):
     MonitorGradientNoiseScaleOptimizer -> gradient_noise_scale
 """
 from .sync import all_reduce_gradients, synchronous_sgd, synchronous_averaging, SMAState
-from .gossip import pair_averaging, GossipState
+from .gossip import (
+    pair_averaging,
+    GossipState,
+    HostPairAveraging,
+    OverlappedHostPairAveraging,
+)
 from .adaptive import adaptive_sgd, AdaptiveSGDState
 from .presets import lm_adamw
 from .monitor import (

@@ -145,13 +145,14 @@ class HostPairAveraging:
             [np.asarray(l, dtype=np.float32).reshape(-1) for l in leaves]
         )
 
-    def _defuse(self, flat, like):
+    def _defuse(self, flat, like, sizes=None):
+        sizes = self._sizes if sizes is None else sizes
         leaves, treedef = jax.tree.flatten(like)
         out, off, k = [], 0, 0
         for l in leaves:
             if self._mixable(l):
-                sz = self._sizes[k]
-                out.append(jnp.asarray(flat[off : off + sz].reshape(l.shape), dtype=l.dtype))
+                sz = sizes[k]
+                out.append(jnp.asarray(flat[off : off + sz].reshape(jnp.shape(l)), dtype=jnp.asarray(l).dtype))
                 off += sz
                 k += 1
             else:
@@ -194,3 +195,153 @@ class HostPairAveraging:
         SaveVariable call, async_sgd.py:138-140)."""
         self.peer.save(self.NAME, self._fuse(params))
         self._published = True
+
+
+class OverlappedHostPairAveraging(HostPairAveraging):
+    """HostPairAveraging with every host round-trip off the critical path.
+
+    The blocking variant's per-step cost is fuse (device->host of the whole
+    model), a TCP pull, the host average, and the publish transfer — all
+    serialized with the device step (measured 6.8 s/step on a tunneled
+    backend, BENCH_CONFIGS resnet50-gossip r4).  Here a worker thread owns
+    all store I/O and model transfers:
+
+      publish()  hands the (device) param tree to the thread; the
+                 device->host transfer and store save happen there,
+                 overlapping the next step's compute.
+      thread     pulls a random peer's model and pre-places it on device
+                 (host->device also off-path).
+      mix()      consumes the latest COMPLETED pull: a device-side f32
+                 lerp of the param tree — no host work, no blocking I/O.
+
+    Cost: one extra step of staleness (a pull started at step k mixes at
+    step k+1) on top of the pull-side staleness both variants share —
+    AD-PSGD's convergence analysis is built on tolerating exactly this
+    (reference async_sgd.py:73-140 pulls "possibly stale" by design).
+    Call close() when done (also runs at gc via __del__).
+    """
+
+    def __init__(self, peer, seed: int = 0):
+        super().__init__(peer, seed)
+        import threading
+
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = False
+        self._pull_dev = None      # latest completed pull, f32 flat ON DEVICE
+        self._publish_tree = None  # latest publish request (device pytree)
+        self._publish_inflight = False  # popped but save() not yet done
+        self._thread = threading.Thread(
+            target=self._worker, name="gossip-overlap", daemon=True
+        )
+        self._thread.start()
+
+    def _sizes_of(self, params):
+        return [int(jnp.asarray(l).size)
+                for l in jax.tree.leaves(params) if self._mixable(l)]
+
+    def _worker(self) -> None:
+        while True:
+            self._wake.wait()
+            self._wake.clear()
+            if self._stop:
+                return
+            with self._lock:
+                pub, self._publish_tree = self._publish_tree, None
+                if pub is not None:
+                    self._publish_inflight = True
+            try:
+                if pub is not None:
+                    # D2H transfer + fuse + save, all while the device is
+                    # free to run the next step
+                    try:
+                        self.peer.save(self.NAME, self._fuse(pub))
+                        self._published = True
+                    finally:
+                        with self._lock:
+                            self._publish_inflight = False
+                if self.peer.size > 1 and self._published:
+                    other = self.peer.request(
+                        self._random_peer(), self.NAME, wait=False
+                    )
+                    if other is not None:
+                        dev = jnp.asarray(
+                            other.reshape(-1), dtype=jnp.float32
+                        )  # H2D pre-placement, also off-path
+                        with self._lock:
+                            self._pull_dev = dev
+            except Exception as e:  # pragma: no cover - peer churn mid-pull
+                # async gossip never fails the training step over a lost
+                # partner; next wake retries with a fresh random peer
+                from ..utils import get_logger
+
+                get_logger("kungfu.gossip").warning("overlap worker: %s", e)
+
+    def mix(self, params):
+        if not self._published:
+            # step-0 bootstrap publish stays synchronous: peers must be
+            # able to pull *something* before the first overlap completes
+            self.peer.save(self.NAME, self._fuse(params))
+            self._published = True
+        with self._lock:
+            flat, self._pull_dev = self._pull_dev, None
+        if flat is not None:
+            sizes = self._sizes_of(params)
+            if int(flat.size) != sum(sizes):
+                # a peer mid-elastic-resize (or running a different model)
+                # published an incompatible shape: skip the pull — async
+                # gossip never fails the training step over a bad partner
+                from ..utils import get_logger
+
+                get_logger("kungfu.gossip").warning(
+                    "skipping pulled model: %d elements != local %d",
+                    int(flat.size), sum(sizes),
+                )
+            else:
+                # _defuse slices the shared fused layout (explicit sizes:
+                # self._sizes is owned by the worker thread's _fuse); f32
+                # average then cast back, matching the host kernel's
+                # precision contract up to the defuse-side dtype cast
+                other = self._defuse(flat, params, sizes=sizes)
+
+                def avg(a, b):
+                    if not self._mixable(a):
+                        return a
+                    return (
+                        (jnp.asarray(a, jnp.float32) + jnp.asarray(b, jnp.float32)) / 2
+                    ).astype(jnp.asarray(a).dtype)
+
+                params = jax.tree.map(avg, params, other)
+        self._wake.set()  # start the next pull immediately
+        return params
+
+    def publish(self, params) -> None:
+        with self._lock:
+            self._publish_tree = params  # latest wins; thread does the D2H
+        self._wake.set()
+
+    def flush(self, timeout: float = 10.0) -> bool:
+        """Block until the queued publish (if any) has reached the store.
+        Returns False if the timeout expired with a publish still pending."""
+        import time
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._publish_tree is None and not self._publish_inflight:
+                    return True
+            self._wake.set()
+            time.sleep(0.005)
+        return False
+
+    def close(self) -> None:
+        self._stop = True
+        self._wake.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=5)
+
+    def __del__(self):  # pragma: no cover - gc-time best effort
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -197,6 +197,79 @@ def test_host_pair_averaging_two_peers():
         s.close()
 
 
+def test_overlapped_host_pair_averaging_two_peers():
+    """Overlapped variant reaches the same mixed state as the blocking one,
+    with store I/O on the worker thread (mix consumes the previous pull)."""
+    import time
+
+    from kungfu_tpu.optimizers.gossip import OverlappedHostPairAveraging
+
+    servers = [StoreServer(host="127.0.0.1", port=0).start() for _ in range(2)]
+    peers_ids = [_peer_for(s) for s in servers]
+    clients = [StoreClient(retries=3, retry_interval=0.01) for _ in range(2)]
+
+    class StubPeer:
+        def __init__(self, rank):
+            self.rank, self.size = rank, 2
+
+        def save(self, name, arr, version=""):
+            servers[self.rank].save(name, np.asarray(arr), version=version)
+
+        def request(self, target, name, version="", wait=True, timeout=30.0):
+            return clients[self.rank].request(
+                peers_ids[target], name, version=version, wait=wait
+            )
+
+    import jax.numpy as jnp
+
+    p0, p1 = (OverlappedHostPairAveraging(StubPeer(r)) for r in range(2))
+    try:
+        m0 = {"w": jnp.full((4,), 0.0, jnp.float32), "step": jnp.int32(3)}
+        m1 = {"w": jnp.full((4,), 8.0, jnp.float32), "step": jnp.int32(3)}
+        m0 = p0.mix(m0)  # bootstrap publish; no pull completed yet
+        np.testing.assert_allclose(np.asarray(m0["w"]), 0.0)
+        m1 = p1.mix(m1)  # bootstrap publish; kicks p1's background pull
+
+        def mix_until_changed(p, m, want, tries=100):
+            for _ in range(tries):
+                time.sleep(0.02)  # let the worker thread complete a pull
+                got = p.mix(m)
+                if not np.allclose(np.asarray(got["w"]), np.asarray(m["w"])):
+                    return got
+            raise AssertionError(f"no pull consumed; wanted {want}")
+
+        # p1 pulls p0's 0-model: (8+0)/2 = 4; int leaf untouched
+        m1 = mix_until_changed(p1, m1, 4.0)
+        np.testing.assert_allclose(np.asarray(m1["w"]), 4.0)
+        assert int(m1["step"]) == 3
+        # async publish lands after flush(); store holds the POST-step model
+        m1 = {"w": m1["w"] + 1.0, "step": m1["step"]}  # -> 5
+        p1.publish(m1)
+        p1.flush()
+        blob = clients[0].request(peers_ids[1], OverlappedHostPairAveraging.NAME)
+        np.testing.assert_allclose(np.asarray(blob).reshape(-1), 5.0)
+        # p0 may first consume a STALE pull of p1's bootstrap model (8 ->
+        # mix 4) buffered before the publish — that staleness is the
+        # variant's contract (async_sgd.py pulls "possibly stale").  Probe
+        # with a fresh zero model until the buffered pull reflects p1's
+        # post-step publish: (0+5)/2 = 2.5.
+        probe = {"w": jnp.zeros((4,), jnp.float32), "step": jnp.int32(3)}
+        for _ in range(200):
+            time.sleep(0.02)
+            got = p0.mix(probe)
+            if np.allclose(np.asarray(got["w"]), 2.5):
+                break
+        else:
+            raise AssertionError("never mixed p1's post-step model")
+    finally:
+        p0.close()
+        p1.close()
+        for c in clients:
+            c.close()
+        for s in servers:
+            s.close()
+
+
 def test_blob_scalar_and_raw_roundtrip():
     # 0-d scalars keep their rank (regression: `if self.shape` dropped ())
     s = Blob.unpack(Blob.from_array(np.array(3.5, np.float64)).pack()).to_array()
