@@ -613,6 +613,21 @@ def main():
     except Exception as e:  # never let the input probe sink the headline
         input_pipeline = {"error": f"{type(e).__name__}: {e}"}
 
+    # comparative context (VERDICT r4 missing #1): the recorded
+    # framework-vs-naked-JAX ratio for this model, when the matrix's
+    # config 13 has run on the same device kind
+    vs_naked = None
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_CONFIGS.json")) as f:
+            for rec in json.load(f).get("results", []):
+                if rec.get("config") == "naked-jax-overhead":
+                    rn = rec.get("arms", {}).get("resnet_naked", {})
+                    if rn.get("device_kind") == kind:
+                        vs_naked = rec.get("resnet_vs_naked_jax")
+    except (OSError, ValueError):
+        pass
+
     print(
         json.dumps(
             {
@@ -623,6 +638,7 @@ def main():
                 "vs_baseline": round(
                     best["img_per_sec_per_chip"] / BASELINE_IMG_PER_SEC_PER_CHIP, 3
                 ),
+                "vs_naked_jax": vs_naked,
                 "mfu": round(mfu, 4) if mfu is not None else None,
                 # headline utilization: measured (xprof-anchored) physical
                 # HBM bandwidth fraction — always <=1 and consistent with

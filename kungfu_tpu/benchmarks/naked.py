@@ -1,0 +1,247 @@
+"""No-framework ("naked JAX") baseline arms for the headline benchmarks.
+
+The reference's headline evidence is *comparative*: its bench harness runs
+the same model under --method CPU|NCCL|NCCL+CPU|HOROVOD and reports the
+framework's throughput against the alternatives
+(srcs/python/kungfu/tensorflow/v1/benchmarks/__main__.py:112-120,
+README.md:203-219 "vs Horovod / vs parameter servers").  The analog here:
+each arm below re-implements the SAME training math as the framework's
+headline configs using only public jax + flax + optax APIs — plain
+``jax.jit`` with ``NamedSharding`` in/out (GSPMD inserts the data-parallel
+gradient reduction), a hand-rolled ``lax.scan`` multi-step, no Session, no
+DataParallelTrainer, no kungfu optimizer wrapper.  It is the program a
+careful user would write WITHOUT this framework; the recorded ratio is the
+framework's step overhead (target: <= 2%, BENCH_CONFIGS
+``naked-jax-overhead``).
+
+Arms:
+  resnet-naked     ResNet-50 bf16 training step (mirror of bench.py
+                   run_config: bf16 BN, stats threaded through the scan,
+                   SGD momentum)
+  gpt-naked        flagship 340M GPT step (mirror of baseline_matrix
+                   config 9's best row: seq 2048, RoPE, flash attention,
+                   AdamW)
+  gpt-framework    the framework's GPT step via the same CLI/protocol, so
+                   config 13 can A/B both through identical subprocesses
+                   (the ResNet framework arm is bench.py --one).
+
+Each arm prints one ``#NAKED <json>`` line with step_ms and throughput.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from functools import partial
+
+
+def _sync_scalar(x) -> float:
+    import numpy as np
+
+    return float(np.asarray(x))
+
+
+def resnet_naked(batch_per_chip: int, steps: int) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax import lax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from ..models.resnet import ResNet50
+    from ..models.slp import softmax_cross_entropy
+
+    devices = np.asarray(jax.devices())
+    mesh = Mesh(devices, ("dp",))
+    n_chips = len(devices)
+    global_batch = batch_per_chip * n_chips
+
+    model = ResNet50(num_classes=1000, norm_dtype=jnp.bfloat16)
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 224, 224, 3), jnp.bfloat16),
+        train=False,
+    )
+    opt = optax.sgd(0.1, momentum=0.9)
+
+    repl = NamedSharding(mesh, P())
+    data = NamedSharding(mesh, P("dp"))
+    params = jax.device_put(variables["params"], repl)
+    bstats = jax.device_put(variables["batch_stats"], repl)
+    opt_state = jax.device_put(opt.init(params), repl)
+
+    rng = np.random.RandomState(0)
+    images = jax.device_put(
+        jnp.asarray(rng.randn(global_batch, 224, 224, 3).astype(np.float32),
+                    jnp.bfloat16), data)
+    labels = jax.device_put(
+        jnp.asarray(rng.randint(0, 1000, size=global_batch).astype(np.int32)),
+        data)
+
+    @partial(jax.jit, donate_argnums=(0, 1, 2))
+    def run_n(params, opt_state, bstats, images, labels):
+        def one(carry, _):
+            p, o, bs = carry
+
+            def loss(p):
+                logits, mut = model.apply(
+                    {"params": p, "batch_stats": bs}, images, train=True,
+                    mutable=["batch_stats"],
+                )
+                return softmax_cross_entropy(logits, labels), mut
+
+            (l, mut), grads = jax.value_and_grad(loss, has_aux=True)(p)
+            updates, o = opt.update(grads, o, p)
+            p = optax.apply_updates(p, updates)
+            return (p, o, mut["batch_stats"]), l
+
+        (params, opt_state, bstats), losses = lax.scan(
+            one, (params, opt_state, bstats), None, length=steps
+        )
+        return params, opt_state, bstats, losses[-1]
+
+    # compile + warm, then time a second dispatch (same protocol as
+    # bench.py run_config)
+    params, opt_state, bstats, l = run_n(params, opt_state, bstats, images, labels)
+    _sync_scalar(l)
+    t0 = time.perf_counter()
+    params, opt_state, bstats, l = run_n(params, opt_state, bstats, images, labels)
+    _sync_scalar(l)
+    dt = time.perf_counter() - t0
+
+    return {
+        "arm": "resnet-naked",
+        "img_per_sec_per_chip": round(steps * global_batch / dt / n_chips, 2),
+        "step_ms": round(dt / steps * 1e3, 3),
+        "batch_per_chip": batch_per_chip,
+        "n_chips": n_chips,
+        "device_kind": jax.devices()[0].device_kind,
+        "backend": jax.default_backend(),
+    }
+
+
+GPT_OVERRIDES = dict(
+    vocab_size=32000, d_model=1024, n_layers=24, n_heads=16, d_ff=4096,
+    causal=True, rope=True, attention="auto",
+)
+
+
+def _gpt_model(seq_len: int):
+    import jax.numpy as jnp
+
+    from ..models.transformer import TransformerConfig, TransformerLM
+
+    cfg = TransformerConfig(max_len=seq_len, dtype=jnp.bfloat16, **GPT_OVERRIDES)
+    return cfg, TransformerLM(cfg)
+
+
+def gpt_naked(batch_per_chip: int, steps: int, seq_len: int) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    import flax.linen as nn
+    from jax import lax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from ..models.transformer import lm_loss
+
+    cfg, model = _gpt_model(seq_len)
+    devices = np.asarray(jax.devices())
+    mesh = Mesh(devices, ("dp",))
+    n_chips = len(devices)
+    global_batch = batch_per_chip * n_chips
+
+    params = nn.meta.unbox(
+        model.init(jax.random.PRNGKey(0), jnp.zeros((1, seq_len), jnp.int32))["params"]
+    )
+    opt = optax.adamw(3e-4, b1=0.9, b2=0.95)
+    repl = NamedSharding(mesh, P())
+    data = NamedSharding(mesh, P("dp"))
+    params = jax.device_put(params, repl)
+    opt_state = jax.device_put(opt.init(params), repl)
+    rng = np.random.RandomState(0)
+    tokens = jax.device_put(
+        jnp.asarray(rng.randint(0, cfg.vocab_size,
+                                size=(global_batch, seq_len)).astype(np.int32)),
+        data)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def run_n(params, opt_state, tokens):
+        def one(carry, _):
+            p, o = carry
+
+            def loss(p):
+                return lm_loss(model.apply({"params": p}, tokens), tokens)
+
+            l, grads = jax.value_and_grad(loss)(p)
+            updates, o = opt.update(grads, o, p)
+            p = optax.apply_updates(p, updates)
+            return (p, o), l
+
+        (params, opt_state), losses = lax.scan(
+            one, (params, opt_state), None, length=steps
+        )
+        return params, opt_state, losses[-1]
+
+    params, opt_state, l = run_n(params, opt_state, tokens)
+    _sync_scalar(l)
+    t0 = time.perf_counter()
+    params, opt_state, l = run_n(params, opt_state, tokens)
+    _sync_scalar(l)
+    dt = time.perf_counter() - t0
+
+    return {
+        "arm": "gpt-naked",
+        "tokens_per_sec_per_chip": round(
+            steps * global_batch * seq_len / dt / n_chips, 1),
+        "step_ms": round(dt / steps * 1e3, 3),
+        "batch_per_chip": batch_per_chip,
+        "seq_len": seq_len,
+        "n_chips": n_chips,
+        "device_kind": jax.devices()[0].device_kind,
+        "backend": jax.default_backend(),
+    }
+
+
+def gpt_framework(batch_per_chip: int, steps: int, seq_len: int) -> dict:
+    """The framework's GPT step (DataParallelTrainer + synchronous_sgd),
+    through the same CLI so config 13's A/B subprocesses are symmetric."""
+    import optax
+
+    from ..optimizers import synchronous_sgd
+    from .baseline_matrix import _lm_throughput
+
+    d = _lm_throughput(
+        synchronous_sgd(optax.adamw(3e-4, b1=0.9, b2=0.95)),
+        per_replica=False, batch_per_chip=batch_per_chip, steps=steps,
+        seq_len=seq_len, cfg_overrides=GPT_OVERRIDES,
+    )
+    d["arm"] = "gpt-framework"
+    return d
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="kungfu_tpu.benchmarks.naked")
+    ap.add_argument("arm", choices=["resnet-naked", "gpt-naked", "gpt-framework"])
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--seq-len", type=int, default=2048)
+    args = ap.parse_args(argv)
+
+    from ..env import apply_platform_override
+
+    apply_platform_override()
+    if args.arm == "resnet-naked":
+        d = resnet_naked(args.batch, args.steps)
+    elif args.arm == "gpt-naked":
+        d = gpt_naked(args.batch, args.steps, args.seq_len)
+    else:
+        d = gpt_framework(args.batch, args.steps, args.seq_len)
+    print("#NAKED " + json.dumps(d), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -197,6 +197,25 @@ class HostPairAveraging:
         self._published = True
 
 
+def _overlap_worker(ref, wake) -> None:
+    """Worker loop for OverlappedHostPairAveraging.
+
+    Module-level with a weakref on purpose: a bound-method thread target
+    would strongly pin the instance forever (the thread is a GC root),
+    leaking a thread plus up to two full model copies per abandoned
+    averager.  Holding only the ref + the event, the instance stays
+    collectable; the bounded wait lets the thread notice the deref and
+    exit within a second of collection."""
+    while True:
+        wake.wait(timeout=1.0)
+        wake.clear()
+        self = ref()
+        if self is None or self._stop:
+            return
+        self._worker_iteration()
+        del self
+
+
 class OverlappedHostPairAveraging(HostPairAveraging):
     """HostPairAveraging with every host round-trip off the critical path.
 
@@ -217,13 +236,16 @@ class OverlappedHostPairAveraging(HostPairAveraging):
     Cost: one extra step of staleness (a pull started at step k mixes at
     step k+1) on top of the pull-side staleness both variants share —
     AD-PSGD's convergence analysis is built on tolerating exactly this
-    (reference async_sgd.py:73-140 pulls "possibly stale" by design).
-    Call close() when done (also runs at gc via __del__).
+    (reference async_sgd.py:73-140 pulls "possibly stale" by design) —
+    plus one on-device param copy per publish (donation safety, see
+    publish()).  Call close() when done; an abandoned instance is still
+    collectable (the worker holds only a weakref) and __del__ closes it.
     """
 
     def __init__(self, peer, seed: int = 0):
         super().__init__(peer, seed)
         import threading
+        import weakref
 
         self._lock = threading.Lock()
         self._wake = threading.Event()
@@ -231,8 +253,13 @@ class OverlappedHostPairAveraging(HostPairAveraging):
         self._pull_dev = None      # latest completed pull, f32 flat ON DEVICE
         self._publish_tree = None  # latest publish request (device pytree)
         self._publish_inflight = False  # popped but save() not yet done
+        self._publish_error = None      # last publish failure, cleared on publish()
+        # the thread holds only a WEAKREF to self (plus the event): a
+        # dropped instance becomes collectable, __del__ runs close(), and
+        # the bounded wait lets the thread notice and exit on its own
         self._thread = threading.Thread(
-            target=self._worker, name="gossip-overlap", daemon=True
+            target=_overlap_worker, args=(weakref.ref(self), self._wake),
+            name="gossip-overlap", daemon=True,
         )
         self._thread.start()
 
@@ -240,42 +267,42 @@ class OverlappedHostPairAveraging(HostPairAveraging):
         return [int(jnp.asarray(l).size)
                 for l in jax.tree.leaves(params) if self._mixable(l)]
 
-    def _worker(self) -> None:
-        while True:
-            self._wake.wait()
-            self._wake.clear()
-            if self._stop:
-                return
-            with self._lock:
-                pub, self._publish_tree = self._publish_tree, None
-                if pub is not None:
-                    self._publish_inflight = True
-            try:
-                if pub is not None:
-                    # D2H transfer + fuse + save, all while the device is
-                    # free to run the next step
-                    try:
-                        self.peer.save(self.NAME, self._fuse(pub))
-                        self._published = True
-                    finally:
-                        with self._lock:
-                            self._publish_inflight = False
-                if self.peer.size > 1 and self._published:
-                    other = self.peer.request(
-                        self._random_peer(), self.NAME, wait=False
-                    )
-                    if other is not None:
-                        dev = jnp.asarray(
-                            other.reshape(-1), dtype=jnp.float32
-                        )  # H2D pre-placement, also off-path
-                        with self._lock:
-                            self._pull_dev = dev
-            except Exception as e:  # pragma: no cover - peer churn mid-pull
-                # async gossip never fails the training step over a lost
-                # partner; next wake retries with a fresh random peer
-                from ..utils import get_logger
+    def _worker_iteration(self) -> None:
+        with self._lock:
+            pub, self._publish_tree = self._publish_tree, None
+            if pub is not None:
+                self._publish_inflight = True
+        try:
+            if pub is not None:
+                # D2H transfer + fuse + save, all while the device is
+                # free to run the next step
+                try:
+                    self.peer.save(self.NAME, self._fuse(pub))
+                    self._published = True
+                except Exception as e:
+                    with self._lock:
+                        self._publish_error = e
+                    raise
+                finally:
+                    with self._lock:
+                        self._publish_inflight = False
+            if self.peer.size > 1 and self._published:
+                other = self.peer.request(
+                    self._random_peer(), self.NAME, wait=False
+                )
+                if other is not None:
+                    dev = jnp.asarray(
+                        other.reshape(-1), dtype=jnp.float32
+                    )  # H2D pre-placement, also off-path
+                    with self._lock:
+                        self._pull_dev = dev
+        except Exception as e:  # pragma: no cover - peer churn mid-pull
+            # async gossip never fails the training step over a lost
+            # partner; next wake retries with a fresh random peer (a
+            # FAILED PUBLISH is still surfaced through flush())
+            from ..utils import get_logger
 
-                get_logger("kungfu.gossip").warning("overlap worker: %s", e)
+            get_logger("kungfu.gossip").warning("overlap worker: %s", e)
 
     def mix(self, params):
         if not self._published:
@@ -316,18 +343,30 @@ class OverlappedHostPairAveraging(HostPairAveraging):
         return params
 
     def publish(self, params) -> None:
+        # on-device copy first: trainers jit their step with donated
+        # param/opt buffers (trainer.py donate=True), so by the time the
+        # worker thread reads these arrays the next step may have consumed
+        # them ("Array has been deleted").  jnp.copy dispatches a device
+        # copy asynchronously — no host block, and the copy is ours alone.
+        params = jax.tree.map(
+            lambda l: jnp.copy(l) if isinstance(l, jax.Array) else l, params
+        )
         with self._lock:
             self._publish_tree = params  # latest wins; thread does the D2H
+            self._publish_error = None
         self._wake.set()
 
     def flush(self, timeout: float = 10.0) -> bool:
         """Block until the queued publish (if any) has reached the store.
-        Returns False if the timeout expired with a publish still pending."""
+        Returns False if the timeout expired with a publish still pending
+        OR the publish failed (the worker logs the exception)."""
         import time
 
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             with self._lock:
+                if self._publish_error is not None:
+                    return False
                 if self._publish_tree is None and not self._publish_inflight:
                     return True
             self._wake.set()

@@ -17,8 +17,12 @@ _REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__
 def _run(args, timeout=600, env_extra=None):
     env = dict(os.environ)
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
-    env.setdefault("JAX_PLATFORMS", "cpu")
-    env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    # OVERRIDE, not setdefault: the tunnel environment exports
+    # JAX_PLATFORMS=axon globally, and a child inheriting it hangs on a
+    # wedged tunnel instead of using the CPU mesh this test is written for
+    # (same rule as benchmarks/scaling._ensure_devices)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
     if env_extra:
         env.update(env_extra)
     return subprocess.run(

@@ -1,0 +1,39 @@
+"""Container-isolated cluster drill (CI-optional).
+
+Mirrors the reference's docker-compose cluster tests
+(benchmarks/adaptation/gen-compose.py + scripts/tests/cluster-test-2.sh):
+N isolated network namespaces, a config server on a bridge, a grow/shrink
+schedule, and a killed "container" mid-job.  Skips automatically where
+network namespaces are unavailable (non-root, restricted kernels, CI
+sandboxes without CAP_NET_ADMIN).
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+DRILL = os.path.join(REPO, "scripts", "netns_cluster_drill.py")
+
+
+def _netns_available() -> bool:
+    sys.path.insert(0, os.path.dirname(DRILL))
+    try:
+        from netns_cluster_drill import netns_available
+
+        return netns_available()
+    finally:
+        sys.path.pop(0)
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not _netns_available(),
+                    reason="network namespaces unavailable (need root+veth)")
+def test_netns_cluster_drill():
+    r = subprocess.run(
+        [sys.executable, DRILL, "--total-samples", "4480"],
+        capture_output=True, text=True, timeout=700, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-2000:]
+    assert "PASS: netns cluster drill" in r.stdout

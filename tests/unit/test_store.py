@@ -270,6 +270,93 @@ def test_overlapped_host_pair_averaging_two_peers():
             s.close()
 
 
+class _SoloPeer:
+    """size-1 peer: save captures the blob, pulls always miss."""
+
+    rank, size = 0, 1
+
+    def __init__(self):
+        self._blob = None
+
+    def save(self, name, arr, version=""):
+        self._blob = np.asarray(arr)
+
+    def request(self, *a, **k):
+        return None
+
+
+def test_overlapped_gossip_publish_survives_donation():
+    """publish() must copy before handing off: trainers donate param
+    buffers into the next jitted step, which deletes the originals while
+    the worker thread is still reading them."""
+    import jax
+    import jax.numpy as jnp
+
+    from kungfu_tpu.optimizers.gossip import OverlappedHostPairAveraging
+
+    peer = _SoloPeer()
+    p = OverlappedHostPairAveraging(peer)
+    try:
+        params = {"w": jnp.arange(64, dtype=jnp.float32)}
+        p.mix(params)  # bootstrap
+
+        @jax.jit
+        def step(w):
+            return w + 1.0
+
+        donating = jax.jit(lambda w: w * 2.0, donate_argnums=0)
+        p.publish(params)
+        _ = donating(params["w"])  # donates/deletes the published buffer
+        assert p.flush(timeout=10.0), "publish failed after donation"
+        np.testing.assert_allclose(peer._blob, np.arange(64, dtype=np.float32))
+    finally:
+        p.close()
+
+
+def test_overlapped_gossip_instance_collectable():
+    """The worker thread holds only a weakref: dropping the instance
+    without close() must not leak it (or its buffered model copies)."""
+    import gc
+    import weakref
+
+    import jax.numpy as jnp
+
+    from kungfu_tpu.optimizers.gossip import OverlappedHostPairAveraging
+
+    p = OverlappedHostPairAveraging(_SoloPeer())
+    p.mix({"w": jnp.ones((4,), jnp.float32)})
+    ref = weakref.ref(p)
+    del p
+    gc.collect()
+    assert ref() is None, "instance leaked (worker thread pins it)"
+
+
+def test_overlapped_gossip_flush_reports_failed_publish():
+    import jax.numpy as jnp
+
+    from kungfu_tpu.optimizers.gossip import OverlappedHostPairAveraging
+
+    class FailingPeer(_SoloPeer):
+        def __init__(self):
+            super().__init__()
+            self.boots = 0
+
+        def save(self, name, arr, version=""):
+            self.boots += 1
+            if self.boots > 1:  # let the bootstrap publish succeed
+                raise ConnectionError("store down")
+            super().save(name, arr, version)
+
+    p = OverlappedHostPairAveraging(FailingPeer())
+    try:
+        params = {"w": jnp.ones((4,), jnp.float32)}
+        p.mix(params)  # bootstrap save (succeeds)
+        p.publish(params)
+        assert p.flush(timeout=10.0) is False
+    finally:
+        p.close()
+
+
 def test_blob_scalar_and_raw_roundtrip():
     # 0-d scalars keep their rank (regression: `if self.shape` dropped ())
     s = Blob.unpack(Blob.from_array(np.array(3.5, np.float64)).pack()).to_array()
