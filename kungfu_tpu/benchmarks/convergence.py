@@ -16,6 +16,10 @@ Configs:
   gossip-host       HostPairAveraging        (true async p2p blob store) —
                     run as 4 separate worker processes under the launcher,
                     i.e. the reference's actual AD-PSGD deployment shape.
+  gossip-host-overlapped  OverlappedHostPairAveraging — same deployment
+                    shape with store I/O on a worker thread; its arm
+                    MEASURES the one-extra-step-staleness cost instead of
+                    asserting it harmless.
 
 The task is datasets.synthetic_mnist (deterministic, linearly separable
 with noise): every optimizer must beat chance by a wide margin, and the
@@ -135,7 +139,7 @@ def run_in_process(name: str, steps: int, batch: int, lr: float, log_every: int)
 
 
 def run_host_gossip(steps: int, batch: int, lr: float, log_every: int = 50,
-                    np_workers: int = 4):
+                    np_workers: int = 4, overlapped: bool = False):
     """True-async AD-PSGD: np separate worker processes under the launcher,
     gossiping through their TCP blob stores (the reference deployment
     shape).  Returns rank 0's RESULT line."""
@@ -148,7 +152,7 @@ def run_host_gossip(steps: int, batch: int, lr: float, log_every: int = 50,
         "--host-gossip-worker",
         "--steps", str(steps), "--batch", str(batch), "--lr", str(lr),
         "--log-every", str(log_every),
-    ]
+    ] + (["--overlapped"] if overlapped else [])
     r = subprocess.run(
         cmd, capture_output=True, text=True, timeout=900, env=env,
         cwd=os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
@@ -164,8 +168,13 @@ def run_host_gossip(steps: int, batch: int, lr: float, log_every: int = 50,
 
 
 def host_gossip_worker(steps: int, batch: int, lr: float,
-                       log_every: int = 50) -> None:
-    """One AD-PSGD worker: local SGD + HostPairAveraging.mix() per step."""
+                       log_every: int = 50, overlapped: bool = False) -> None:
+    """One AD-PSGD worker: local SGD + HostPairAveraging.mix() per step.
+
+    overlapped=True swaps in OverlappedHostPairAveraging — same gossip
+    semantics with store I/O on a worker thread (one extra step of pull
+    staleness).  Recorded as its own convergence arm so the overlap's
+    staleness cost is measured, not asserted."""
     import kungfu_tpu
     from ..env import apply_platform_override
 
@@ -176,14 +185,18 @@ def host_gossip_worker(steps: int, batch: int, lr: float,
     import optax
 
     from ..models.slp import SLP, softmax_cross_entropy
-    from ..optimizers.gossip import HostPairAveraging
+    from ..optimizers.gossip import (
+        HostPairAveraging,
+        OverlappedHostPairAveraging,
+    )
 
     peer = kungfu_tpu.init()
     model = SLP()
     params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))["params"]
     tx = optax.sgd(lr)
     opt = tx.init(params)
-    hpa = HostPairAveraging(peer, seed=42)
+    cls = OverlappedHostPairAveraging if overlapped else HostPairAveraging
+    hpa = cls(peer, seed=42)
 
     def loss_fn(p, b):
         images, labels = b
@@ -205,14 +218,21 @@ def host_gossip_worker(steps: int, batch: int, lr: float,
         hpa.publish(params)
         if step % log_every == 0 or step == steps - 1:
             curve.append([step, round(float(loss), 4)])
+    if overlapped:
+        # the last publish must land before peers stop pulling
+        if not hpa.flush():
+            print("# WARN: final gossip publish did not land", file=sys.stderr)
     kungfu_tpu.run_barrier()
+    if overlapped:
+        hpa.close()
     if peer.rank == 0:
         acc = _accuracy(model, params, eval_x.reshape(-1, 28, 28, 1), eval_y)
         print(
             "CONVERGENCE-RESULT: "
             + json.dumps(
                 {
-                    "optimizer": "gossip-host",
+                    "optimizer": "gossip-host-overlapped"
+                    if overlapped else "gossip-host",
                     "world": peer.size,
                     "steps": steps,
                     "final_loss": curve[-1][1],
@@ -245,10 +265,12 @@ def main(argv=None) -> int:
     ap.add_argument("--skip-host-gossip", action="store_true")
     ap.add_argument("--host-gossip-worker", action="store_true",
                     help=argparse.SUPPRESS)
+    ap.add_argument("--overlapped", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
 
     if args.host_gossip_worker:
-        host_gossip_worker(args.steps, args.batch, args.lr, args.log_every)
+        host_gossip_worker(args.steps, args.batch, args.lr, args.log_every,
+                           overlapped=args.overlapped)
         return 0
 
     _force_cpu_mesh(8)
@@ -260,14 +282,17 @@ def main(argv=None) -> int:
               file=sys.stderr)
         results.append(r)
     if not args.skip_host_gossip:
-        try:
-            r = run_host_gossip(args.steps, args.batch, args.lr, args.log_every)
-            print(f"# gossip-host: loss {r['final_loss']} acc {r['eval_accuracy']}",
-                  file=sys.stderr)
-        except Exception as e:  # never lose the 5 finished in-process runs
-            r = {"optimizer": "gossip-host", "error": f"{type(e).__name__}: {e}"}
-            print(f"# gossip-host FAILED: {r['error']}", file=sys.stderr)
-        results.append(r)
+        for overlapped in (False, True):
+            arm = "gossip-host-overlapped" if overlapped else "gossip-host"
+            try:
+                r = run_host_gossip(args.steps, args.batch, args.lr,
+                                    args.log_every, overlapped=overlapped)
+                print(f"# {arm}: loss {r['final_loss']} acc "
+                      f"{r['eval_accuracy']}", file=sys.stderr)
+            except Exception as e:  # never lose the finished runs
+                r = {"optimizer": arm, "error": f"{type(e).__name__}: {e}"}
+                print(f"# {arm} FAILED: {r['error']}", file=sys.stderr)
+            results.append(r)
 
     with open(args.out, "w") as f:
         json.dump({"task": "synthetic_mnist", "results": results}, f, indent=1)
