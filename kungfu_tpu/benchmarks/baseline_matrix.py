@@ -601,6 +601,21 @@ def config_inception(steps: int = 10) -> dict:
         return {"config": "inception-v3-ssgd", "error": f"{type(e).__name__}: {e}"}
 
 
+def _row_checkpointer(config_name: str, out_path: str, rows: list):
+    """Persist `rows` under config_name (partial:true) after every measured
+    arm, so a wedge-then-tree-kill (the retry loop's response to a hung
+    dispatch) loses only the in-flight row, never the completed ones.  The
+    config's final record replaces the partial under the same key."""
+    def checkpoint():
+        if out_path:
+            _merge_into(out_path, {
+                "config": config_name, "partial": True,
+                "note": "incremental rows; a full record replaces this",
+                "rows": rows,
+            })
+    return checkpoint
+
+
 def config_gpt_mfu(steps: int = 8, out_path: str = "") -> dict:
     """Config 9 (beyond parity): flagship GPT-style LM MFU on-chip.
 
@@ -632,13 +647,7 @@ def config_gpt_mfu(steps: int = 8, out_path: str = "") -> dict:
     # persist to out_path AFTER EVERY ARM: a wedge (hang -> tree-kill by
     # the retry loop) at row k still leaves rows 1..k-1 recorded — without
     # this, the safe-rows-first ordering guarantees nothing.
-    def checkpoint_rows():
-        if out_path:
-            _merge_into(out_path, {
-                "config": "gpt-lm-mfu", "partial": True,
-                "note": "incremental rows; a full record replaces this",
-                "rows": rows,
-            })
+    checkpoint_rows = _row_checkpointer("gpt-lm-mfu", out_path, rows)
 
     for batch, remat, chunked, heads in dict.fromkeys((
         (b0, False, False, 16),
@@ -691,7 +700,8 @@ def config_gpt_mfu(steps: int = 8, out_path: str = "") -> dict:
     }
 
 
-def config_gpt_decode(new_tokens: int = 256, tiny: bool = False) -> dict:
+def config_gpt_decode(new_tokens: int = 256, tiny: bool = False,
+                      out_path: str = "") -> dict:
     """Config 12 (beyond parity): flagship KV-cache decode throughput.
 
     Autoregressive generation (prefill 64 + jitted scan over new tokens)
@@ -745,6 +755,7 @@ def config_gpt_decode(new_tokens: int = 256, tiny: bool = False) -> dict:
         import dataclasses
 
         rows, best = [], None
+        checkpoint_rows = _row_checkpointer("gpt-decode", out_path, rows)
         # the int8 arm A/Bs the quantized KV cache (half the cache-read
         # bytes) at the larger batch, where decode is most cache-bound
         for batch, kv_dtype in ((8, "model"), (32, "model"), (32, "int8")):
@@ -759,6 +770,7 @@ def config_gpt_decode(new_tokens: int = 256, tiny: bool = False) -> dict:
             except Exception as e:
                 rows.append({"batch": batch, "kv_cache_dtype": kv_dtype,
                              "error": f"{type(e).__name__}: {e}"[:200]})
+                checkpoint_rows()
                 continue
             dn = new_tokens - half
             per_tok = (dt_full - dt_half) / dn if dn > 0 else 0.0
@@ -771,6 +783,7 @@ def config_gpt_decode(new_tokens: int = 256, tiny: bool = False) -> dict:
                                       f"({dt_full:.4f}s vs {dt_half:.4f}s)",
                              "dt_full_s": round(dt_full, 4),
                              "dt_half_s": round(dt_half, 4)})
+                checkpoint_rows()
                 continue
             row = {
                 "batch": batch,
@@ -782,6 +795,7 @@ def config_gpt_decode(new_tokens: int = 256, tiny: bool = False) -> dict:
                 ),
             }
             rows.append(row)
+            checkpoint_rows()
             # the int8 arm is informational (A/B), NOT headline-eligible:
             # the metric name has always meant full-precision decode, and a
             # model-dtype regression must not hide behind a quantized win
@@ -966,7 +980,7 @@ def config_resnet_roofline() -> dict:
     return rec
 
 
-def config_attention() -> dict:
+def config_attention(out_path: str = "") -> dict:
     """Flash (Pallas) vs full (einsum) attention on-chip, fwd+grad, per
     sequence length — the kernel-evidence record (ops/flash.py claim site).
     """
@@ -976,6 +990,8 @@ def config_attention() -> dict:
 
     try:
         rows = []
+        checkpoint_rows = _row_checkpointer(
+            "attention-flash-vs-full", out_path, rows)
         # the (2048, 8, 128) row holds B*L*H*D constant vs (2048, 16, 64):
         # it isolates the MXU head-width effect (head_dim 64 half-fills the
         # 128-lane contraction) from total work
@@ -994,6 +1010,7 @@ def config_attention() -> dict:
                 rows.append({"seq_len": L, "heads": heads,
                              "head_dim": head_dim,
                              "error": f"{type(e).__name__}: {e}"[:200]})
+                checkpoint_rows()
                 continue
             row = {
                 "seq_len": L,
@@ -1012,6 +1029,7 @@ def config_attention() -> dict:
             if "flash_xla_bwd" in out:
                 row["flash_xla_bwd_ms"] = round(out["flash_xla_bwd"] * 1e3, 3)
             rows.append(row)
+            checkpoint_rows()
         ok_rows = [r for r in rows if "flash_speedup" in r]
         if not ok_rows:
             return {"config": "attention-flash-vs-full",
@@ -1103,14 +1121,16 @@ CONFIGS = {
     "3": ("bert-base-sma", lambda args: config_bert_sma()),
     "4": ("resnet50-gossip", lambda args: config_resnet50_gossip()),
     "5": ("elastic-resize-gns", lambda args: config_elastic_gns(full=args.full)),
-    "6": ("attention-flash-vs-full", lambda args: config_attention()),
+    "6": ("attention-flash-vs-full",
+          lambda args: config_attention(out_path=os.path.abspath(args.out))),
     "7": ("vgg16-ssgd", lambda args: config_vgg16()),
     "8": ("inception-v3-ssgd", lambda args: config_inception()),
     "9": ("gpt-lm-mfu",
           lambda args: config_gpt_mfu(out_path=os.path.abspath(args.out))),
     "10": ("allreduce-scaling", lambda args: config_allreduce_scaling()),
     "11": ("resnet50-roofline-ab", lambda args: config_resnet_roofline()),
-    "12": ("gpt-decode", lambda args: config_gpt_decode()),
+    "12": ("gpt-decode",
+           lambda args: config_gpt_decode(out_path=os.path.abspath(args.out))),
     "13": ("naked-jax-overhead", lambda args: config_naked_overhead()),
 }
 
@@ -1202,10 +1222,16 @@ def main(argv=None) -> int:
                 # also merge its measurement and THEN die in teardown
                 # (observed: the TPU tunnel wedging the JAX runtime at
                 # exit); if the stored record changed during this spawn,
-                # keep the child's record.
-                if _load_results(out).get(name) != before:
-                    return
-                rec = {"config": name, "error": err}
+                # keep the child's record — UNLESS it is only a per-row
+                # partial checkpoint, which must still carry the failure
+                # diagnostic (the wedge happened after its last row)
+                now = _load_results(out).get(name)
+                if now != before:
+                    if not (isinstance(now, dict) and now.get("partial")):
+                        return
+                    rec = {**now, "error": err}
+                else:
+                    rec = {"config": name, "error": err}
                 _merge_into(out, rec)
                 print(json.dumps(rec), flush=True)
 
