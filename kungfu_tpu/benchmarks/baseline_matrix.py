@@ -632,6 +632,21 @@ def config_gpt_mfu(steps: int = 8, out_path: str = "") -> dict:
         vocab_size=32000, d_model=1024, n_layers=24, n_heads=16, d_ff=4096,
         causal=True, rope=True, attention="auto",
     )
+    # flash-kernel tiling knobs: after scripts/mfu_hunt.py flash finds the
+    # best (block_q, block_k) on-chip, re-run this config with
+    # KFT_FLASH_BQ/KFT_FLASH_BK to apply the winner — no code edit needed
+    for env_key, cfg_key in (("KFT_FLASH_BQ", "flash_block_q"),
+                             ("KFT_FLASH_BK", "flash_block_k")):
+        v = os.environ.get(env_key, "").strip()
+        if not v:
+            continue
+        try:
+            overrides[cfg_key] = int(v)
+        except ValueError:
+            # a SET-but-invalid knob must fail loudly: silently measuring
+            # default blocks while the operator records "tuned" poisons
+            # the record this knob exists to produce
+            raise SystemExit(f"{env_key}={v!r} is not an integer")
     rows, best = [], None
     b0 = int(os.environ.get("KFT_GPT_BATCH", "8"))
     # Ordered: two known-safe rows first (a wedge must find them already
