@@ -37,3 +37,17 @@ def test_netns_cluster_drill():
     )
     assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-2000:]
     assert "PASS: netns cluster drill" in r.stdout
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not _netns_available(),
+                    reason="network namespaces unavailable (need root+veth)")
+def test_netns_hierarchical_drill():
+    """dcn x ici collectives across isolated namespaces: every cross-host
+    phase of hierarchical_all_reduce crosses the veth wire."""
+    r = subprocess.run(
+        [sys.executable, DRILL, "--hierarchical"],
+        capture_output=True, text=True, timeout=700, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-2000:]
+    assert "PASS: netns hierarchical drill" in r.stdout
