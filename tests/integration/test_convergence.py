@@ -21,7 +21,7 @@ def test_convergence_harness_all_families(tmp_path):
     names = {x["optimizer"] for x in doc["results"]}
     assert names == {
         "ssgd", "sma", "gossip-random", "gossip-roundrobin", "ada",
-        "gossip-host",
+        "gossip-host", "gossip-host-overlapped",
     }
     for x in doc["results"]:
         # every family must beat 10-class chance decisively
