@@ -458,15 +458,31 @@ def _probe_backend(timeout: float) -> str | None:
     on the same init hang) — so establish up front, cheaply and killably,
     whether the chip answers at all.  Returns an error string or None.
     """
+    # the child decides platform health: a TPU-class device, or CPU only
+    # when EXPLICITLY requested (KFT_PLATFORM/JAX_PLATFORMS=cpu via
+    # apply_platform_override).  Without the platform check, a fast axon
+    # failure under the sitecustomize's "axon,cpu" registration would
+    # fall back to CPU and the sweep would record host numbers as the
+    # on-chip headline (same guard as scripts/tpu_retry.py's probe).
     rc, out, err = _run_child(
         [sys.executable, "-c",
+         "import os; "
          "from kungfu_tpu.env import apply_platform_override; "
          "apply_platform_override(); "
-         "import jax; d=jax.devices(); print('PROBE_OK', d[0].device_kind)"],
+         "import jax; d = jax.devices(); plat = d[0].platform; "
+         "want_cpu = (os.environ.get('KFT_PLATFORM') == 'cpu' "
+         "or os.environ.get('JAX_PLATFORMS') == 'cpu'); "
+         "ok = plat in ('tpu', 'axon') or (plat == 'cpu' and want_cpu); "
+         "print(('PROBE_OK ' + d[0].device_kind) if ok "
+         "else ('PROBE_FALLBACK ' + plat))"],
         timeout=timeout,
     )
     if rc == 0 and "PROBE_OK" in out:
         return None
+    if rc == 0 and "PROBE_FALLBACK" in out:
+        return ("backend fell back to an unrequested platform "
+                f"({out.strip().split()[-1]}); refusing to record host "
+                "numbers as on-chip results")
     if rc == 124:
         return f"backend init probe timed out after {timeout:.0f}s (tunnel wedged)"
     return f"backend init probe failed (rc={rc}): {err.strip()[-300:]}"

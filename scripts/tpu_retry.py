@@ -29,10 +29,33 @@ import subprocess
 import sys
 import time
 
+# the probe must prove a TPU-CLASS device answered, not merely that a
+# dispatch completed: the tunnel sitecustomize registers "axon,cpu", so a
+# fast axon failure silently falls back to CPU — a dispatch-only probe
+# would then declare the tunnel healthy and drain the whole queue on CPU,
+# overwriting committed on-chip records with host numbers.  The CHILD
+# decides and prints a sentinel (single source of truth; mirrors
+# bench.py's PROBE_OK convention): TPU-class platform => OK, CPU => OK
+# only when the operator EXPLICITLY requested cpu (KFT_PLATFORM=cpu or
+# JAX_PLATFORMS=cpu exactly — the ambient tunnel export is "axon" and
+# never reads as a cpu request).
 PROBE = (
-    "import jax, jax.numpy as jnp; "
-    "print(float(jnp.sum(jnp.ones((256, 256))).block_until_ready()))"
+    "import os, jax, jax.numpy as jnp; "
+    "want_cpu = (os.environ.get('KFT_PLATFORM') == 'cpu' "
+    "or os.environ.get('JAX_PLATFORMS') == 'cpu'); "
+    # the sitecustomize forces jax_platforms via jax.config, so an
+    # explicit cpu request must override the same way (env alone loses)
+    "want_cpu and jax.config.update('jax_platforms', 'cpu'); "
+    "plat = jax.devices()[0].platform; "
+    "x = float(jnp.sum(jnp.ones((8, 8)) * 31.0).block_until_ready()); "
+    "ok = x == 1984.0 and (plat in ('tpu', 'axon') or "
+    "(plat == 'cpu' and want_cpu)); "
+    "print('PROBE_OK' if ok else f'PROBE_FALLBACK {plat}')"
 )
+
+
+def _probe_ok(out: str) -> bool:
+    return "PROBE_OK" in out
 
 
 def probe_tunnel(timeout: float) -> bool:
@@ -69,7 +92,7 @@ def probe_tunnel(timeout: float) -> bool:
                 out = os.read(p.stdout.fileno(), 4096).decode(
                     "utf-8", "replace"
                 )
-        return p.returncode == 0 and "65536" in out
+        return p.returncode == 0 and _probe_ok(out)
     _kill_tree(p)
     return False
 

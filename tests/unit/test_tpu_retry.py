@@ -83,3 +83,30 @@ def test_main_waits_while_down(tmp_path, monkeypatch):
     rc = tpu_retry.main(["--queue", str(q), "--interval", "5"])
     assert rc == 0
     assert sleeps == [5.0]
+
+
+def test_probe_requires_tpu_class_device():
+    """A dispatch that completed on the CPU FALLBACK must read as tunnel
+    DOWN: the sitecustomize registers axon,cpu, and a fast axon failure
+    would otherwise drain the queue on CPU, overwriting on-chip records.
+    The child decides and prints a sentinel; the parent keys on it."""
+    assert tpu_retry._probe_ok("PROBE_OK")
+    assert tpu_retry._probe_ok("some banner\nPROBE_OK\n")
+    assert not tpu_retry._probe_ok("PROBE_FALLBACK cpu")
+    assert not tpu_retry._probe_ok("65536.0")
+
+
+def test_probe_child_honors_explicit_cpu(monkeypatch):
+    """An operator-requested off-chip run (JAX_PLATFORMS=cpu) is healthy,
+    not tunnel-down: the probe child itself runs the real decision."""
+    import subprocess
+    import sys
+
+    env = dict(__import__("os").environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("KFT_PLATFORM", None)
+    r = subprocess.run(
+        [sys.executable, "-c", tpu_retry.PROBE],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    assert r.returncode == 0 and "PROBE_OK" in r.stdout, r.stdout + r.stderr
