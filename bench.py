@@ -151,6 +151,7 @@ def run_config(batch_per_chip: int, steps: int, flops: bool):
     rng = jax.random.PRNGKey(0)
     variables = model.init(rng, jnp.zeros((1, 224, 224, 3), jnp.bfloat16), train=False)
     params, batch_stats = variables["params"], variables["batch_stats"]
+    n_grad_elems = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(params))
 
     tx = synchronous_sgd(optax.sgd(0.1, momentum=0.9))
     trainer = DataParallelTrainer(loss_fn, tx, has_aux=True)
@@ -192,7 +193,32 @@ def run_config(batch_per_chip: int, steps: int, flops: bool):
         "device_kind": jax.devices()[0].device_kind,
         "stem": stem,
         "remat": remat,
+        "bytes_on_wire": _bytes_on_wire_per_strategy(n_grad_elems),
     }
+
+
+def _bytes_on_wire_per_strategy(n_grad_elems: int):
+    """Per-step gradient-allreduce wire bytes by compression strategy.
+
+    The gradient payload is fixed per model, so this is exact arithmetic
+    (kungfu_tpu.compression CompressionConfig.wire_bytes), independent of
+    backend; the shared 2(n-1)/n algorithmic factor cancels in the ratios.
+    Measured per-scheme step times live in the separate compression bench
+    (python -m kungfu_tpu.benchmarks --bench compression).
+    """
+    try:
+        from kungfu_tpu import compression as comp
+
+        out = {"grad_elements": n_grad_elems}
+        for scheme in ("none", "bf16", "int8", "fp8"):
+            cfg = comp.resolve(scheme)
+            out[scheme if scheme != "none" else "fp32"] = cfg.wire_bytes(
+                n_grad_elems, 4
+            )
+        out["int8_vs_fp32_ratio"] = round(out["fp32"] / out["int8"], 3)
+        return out
+    except Exception:  # never let accounting sink the headline number
+        return None
 
 
 def _bench_dataset_dir(n_images: int):
@@ -318,6 +344,10 @@ def run_files_train(batch_per_chip: int, steps: int):
         "n_chips": n_chips,
         "global_batch": global_batch,
         "device_kind": jax.devices()[0].device_kind,
+        "bytes_on_wire": _bytes_on_wire_per_strategy(
+            sum(int(np.prod(l.shape))
+                for l in jax.tree.leaves(variables["params"]))
+        ),
     }
 
 
@@ -684,6 +714,10 @@ def main():
                 "device_kind": kind,
                 "flops_per_image": round(flops_per_img / 1e9, 2),
                 "flops_source": flops_src,
+                # gradient-allreduce wire bytes per compression strategy
+                # (exact arithmetic; see kungfu_tpu/benchmarks/compression.py
+                # for the measured per-scheme A/B)
+                "bytes_on_wire": best.get("bytes_on_wire"),
                 "input_pipeline": input_pipeline,
                 "sweep": [
                     {

@@ -12,6 +12,13 @@ Run on the 8-virtual-device CPU mesh (or a real pod slice):
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python examples/fsdp_transformer.py --fsdp 4 --dp 2 --steps 30
 
+Gradient compression (docs/compression.md): `--compress int8` quantizes the
+cross-replica dp gradient mean — in hybrid sharded DP that is the slow
+(typically cross-host/DCN) hop, while the fsdp reduce_scatter/all_gather
+traffic stays full precision.  ~3.9x fewer bytes on that leg; the loss curve
+should be indistinguishable (per-block int8 error ~0.4% of each block's
+dynamic range).
+
 Composition notes (FSDPTrainer vs MeshTrainer):
   * FSDPTrainer owns the data axes; it flattens params to chunks, so it
     composes with activation-level TP only via the model's own shard_map
@@ -37,6 +44,9 @@ def main():
     ap.add_argument("--d-model", type=int, default=128)
     ap.add_argument("--n-layers", type=int, default=4)
     ap.add_argument("--seq", type=int, default=64)
+    ap.add_argument("--compress", default=None,
+                    help="dp-leg gradient wire format: int8 | int8-sr | fp8 "
+                         "| bf16 (default: uncompressed)")
     args = ap.parse_args()
 
     from kungfu_tpu.env import apply_platform_override
@@ -73,7 +83,19 @@ def main():
     params = nn.meta.unbox(model.init(jax.random.PRNGKey(0), tokens0)["params"])
     n_params = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(params))
 
-    trainer = FSDPTrainer(loss_fn, optax.adam(1e-3), mesh=mesh)
+    compress = None
+    if args.compress:
+        from kungfu_tpu import compression as comp
+
+        # a CompressionConfig is a plain frozen value: build one explicitly
+        # (comp.CompressionConfig(scheme="int8", block=128)) or resolve a
+        # registered name from the CLI
+        compress = comp.resolve(args.compress)
+        print(f"dp-leg gradient wire: {compress.describe()} "
+              f"({compress.compression_ratio(1 << 20):.2f}x fewer bytes)")
+
+    trainer = FSDPTrainer(loss_fn, optax.adam(1e-3), mesh=mesh,
+                          compression=compress)
     state = trainer.init(params)
 
     # every param/moment leaf is chunked (n_fsdp, chunk) and sharded on dim 0

@@ -66,9 +66,32 @@ def uid() -> int:
     return default_peer().uid()
 
 
+_barrier_seq = 0
+
+
 def run_barrier() -> None:
-    """Global barrier (reference python/__init__.py run_barrier)."""
-    default_peer().current_session().barrier()
+    """Global barrier (reference python/__init__.py run_barrier).
+
+    Multi-process on the CPU backend: the pinned jaxlib has no cross-process
+    CPU collectives ("Multiprocess computations aren't implemented"), so the
+    barrier rides the jax.distributed coordination service instead — a pure
+    host-side gRPC rendezvous with identical semantics.  Every peer calls
+    run_barrier in the same order, so the monotonically increasing barrier
+    id matches across processes.
+    """
+    import jax
+
+    peer = default_peer()
+    if peer.size > 1 and jax.process_count() > 1 and jax.default_backend() == "cpu":
+        from jax._src import distributed
+
+        client = getattr(distributed.global_state, "client", None)
+        if client is not None:
+            global _barrier_seq
+            _barrier_seq += 1
+            client.wait_at_barrier(f"kungfu_run_barrier_{_barrier_seq}", 60_000)
+            return
+    peer.current_session().barrier()
 
 
 def calc_stats() -> dict:

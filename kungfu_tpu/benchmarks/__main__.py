@@ -19,7 +19,11 @@ import sys
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="kungfu_tpu.benchmarks")
     p.add_argument("--bench", default="all_reduce",
-                   choices=["all_reduce", "p2p", "attention"])
+                   choices=["all_reduce", "p2p", "attention", "compression"])
+    p.add_argument("--size", type=int, default=1 << 22,
+                   help="elements for --bench compression")
+    p.add_argument("--out", default=None,
+                   help="write the compression JSON record here too")
     p.add_argument("--model", default="resnet50-imagenet",
                    help="comma-separated fake models (see models.fakemodel.REGISTRY)")
     p.add_argument("--method", default="auto",
@@ -43,6 +47,14 @@ def main(argv=None) -> int:
             batch=args.batch, seq_len=args.seq_len, heads=args.heads,
             head_dim=args.head_dim, steps=args.steps, warmup=args.warmup,
             grad=not args.no_grad,
+        )
+        return 0
+
+    if args.bench == "compression":
+        from .compression import bench_compression
+
+        bench_compression(
+            size=args.size, steps=args.steps, warmup=args.warmup, out=args.out
         )
         return 0
 

@@ -1,0 +1,102 @@
+"""Cross-version JAX API shims.
+
+The repo targets a range of JAX releases (the pinned CI build is 0.4.x; the
+TPU tunnel images track newer 0.7.x): a handful of APIs drifted between
+them and every call site that straddles the gap routes through here instead
+of sprouting its own try/except.
+
+  shard_map   `jax.shard_map` (new) vs `jax.experimental.shard_map` (old);
+              the new API spells replication checking `check_vma`, the old
+              one `check_rep` — same meaning, different keyword.
+  axis_size   `lax.axis_size` only exists on newer JAX.  The portable
+              spelling is `lax.psum(1, axis)`: psum of a value that does
+              not depend on the axis constant-folds to `axis_size * x` at
+              trace time, so it returns a static Python int, usable for
+              shapes and permutations.
+  pcast       `lax.pcast` marks values varying across an axis for the new
+              varying-manual-axes (vma) type system; old JAX has no vma
+              types, so the cast is the identity there.
+"""
+from __future__ import annotations
+
+from typing import Sequence, Tuple, Union
+
+import jax
+from jax import lax
+
+AxisName = Union[str, Tuple[str, ...]]
+
+try:  # jax >= 0.6
+    from jax import shard_map as _new_shard_map
+
+    _NEW_SHARD_MAP = True
+except ImportError:  # pragma: no cover - exercised on the pinned 0.4.x CI
+    from jax.experimental.shard_map import shard_map as _old_shard_map
+
+    _NEW_SHARD_MAP = False
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma=None):
+    """`shard_map` with the replication-check kwarg spelled portably.
+
+    check_vma=None leaves each JAX version's default in place; True/False
+    forwards as `check_vma` (new) or `check_rep` (old).
+    """
+    if _NEW_SHARD_MAP:
+        kw = {} if check_vma is None else {"check_vma": check_vma}
+        return _new_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+        )
+    kw = {} if check_vma is None else {"check_rep": check_vma}
+    return _old_shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+    )
+
+
+def _one_axis_size(axis_name) -> int:
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    # psum of an axis-independent constant folds statically to the axis size
+    return int(lax.psum(1, axis_name))
+
+
+def axis_size(axis_name: AxisName) -> int:
+    """Static size of one mesh axis (or the product over a tuple of axes).
+
+    Must be called with the axes in scope (inside shard_map/pmap), exactly
+    like `lax.axis_index`.
+    """
+    if isinstance(axis_name, (tuple, list)):
+        n = 1
+        for a in axis_name:
+            n *= _one_axis_size(a)
+        return n
+    return _one_axis_size(axis_name)
+
+
+def pcast(x, axis_name: AxisName, to: str = "varying"):
+    """`lax.pcast` where it exists; identity on pre-vma JAX."""
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, axis_name, to=to)
+    return x
+
+
+def tree_pcast(tree, axis_name: AxisName, to: str = "varying"):
+    return jax.tree.map(lambda x: pcast(x, axis_name, to=to), tree)
+
+
+def vma_of(*xs) -> frozenset:
+    """Union of the varying-manual-axes of `xs` (empty set on pre-vma JAX)."""
+    if not hasattr(jax, "typeof"):
+        return frozenset()
+    return frozenset().union(
+        *(getattr(jax.typeof(x), "vma", frozenset()) for x in xs)
+    )
+
+
+def shape_dtype_struct(shape, dtype, vma: frozenset = frozenset()):
+    """ShapeDtypeStruct carrying vma where the JAX version supports it."""
+    try:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except TypeError:  # pre-vma JAX: no vma kwarg (and no vma checking)
+        return jax.ShapeDtypeStruct(shape, dtype)

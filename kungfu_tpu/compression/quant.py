@@ -1,0 +1,153 @@
+"""Block-wise quantize/dequantize kernels — pure JAX, TPU-lowerable.
+
+EQuARX-style block quantization (PAPERS.md): a tensor is viewed as blocks of
+`block` consecutive elements, each block carries one f32 scale = absmax/codemax,
+and elements are stored as int8 codes (or fp8 e4m3 values).  Everything is
+expressed as reshape/reduce/elementwise ops, so XLA lowers it onto TPU (VPU)
+with no custom kernel, and it nests freely inside shard_map/jit — which is
+what lets the compressed collectives in `collectives.py` ride the same
+compiled programs as the uncompressed ones.
+
+Rounding: deterministic round-to-nearest by default; `stochastic=True`
+(int8) adds a uniform dither before the floor, making the quantizer unbiased
+(E[dequant(quant(x))] = x).  Stochastic rounding needs a PRNG key; inside a
+collective the key must differ per participant (fold in `lax.axis_index`)
+or the dither correlates across peers and the bias returns.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .config import CompressionConfig, FP8_E4M3_MAX, INT8_MAX
+
+# fp8 support depends on the ml_dtypes build; gate rather than import-fail
+_FP8_DTYPE = getattr(jnp, "float8_e4m3fn", None)
+
+
+class QTensor(NamedTuple):
+    """Quantized view of an array blocked along its LAST axis.
+
+    data:  (..., nblocks, block) codes — int8, fp8, or bf16 (scale-free).
+    scale: (..., nblocks, 1) f32 per-block scales (ones for bf16).
+    """
+
+    data: jax.Array
+    scale: jax.Array
+
+
+def blocked_shape(n: int, block: int) -> Tuple[int, int]:
+    """(nblocks, padded_len) for n elements at the given block size."""
+    nblocks = -(-n // block)
+    return nblocks, nblocks * block
+
+
+def pad_to_block(flat: jax.Array, block: int) -> jax.Array:
+    """Zero-pad a 1-D array to a whole number of blocks."""
+    pad = (-flat.size) % block
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    return flat
+
+
+def quantize(
+    x: jax.Array, cfg: CompressionConfig, key: Optional[jax.Array] = None
+) -> QTensor:
+    """Quantize (..., L) blockwise along the last axis; L % cfg.block == 0.
+
+    The caller owns padding (see `pad_to_block`) because the collectives
+    must coordinate padding with the mesh-axis sharding anyway.
+    """
+    if x.shape[-1] % cfg.block:
+        raise ValueError(
+            f"last dim {x.shape[-1]} not a multiple of block {cfg.block}; "
+            "pad with pad_to_block first"
+        )
+    lead = x.shape[:-1]
+    nblocks = x.shape[-1] // cfg.block
+    xb = x.astype(jnp.float32).reshape(*lead, nblocks, cfg.block)
+    if cfg.scheme == "bf16":
+        return QTensor(
+            data=xb.astype(jnp.bfloat16),
+            scale=jnp.ones((*lead, nblocks, 1), jnp.float32),
+        )
+    absmax = jnp.max(jnp.abs(xb), axis=-1, keepdims=True)
+    if cfg.scheme == "int8":
+        scale = jnp.where(absmax > 0, absmax / INT8_MAX, 1.0)
+        y = xb / scale
+        if cfg.stochastic:
+            if key is None:
+                key = jax.random.PRNGKey(0)
+            # floor(y + U[0,1)) is the unbiased dithered rounding
+            y = jnp.floor(y + jax.random.uniform(key, y.shape, jnp.float32))
+        else:
+            y = jnp.round(y)
+        data = jnp.clip(y, -INT8_MAX, INT8_MAX).astype(jnp.int8)
+        return QTensor(data=data, scale=scale)
+    if cfg.scheme == "fp8":
+        if _FP8_DTYPE is None:  # pragma: no cover - old ml_dtypes build
+            raise NotImplementedError("this JAX build has no float8_e4m3fn")
+        scale = jnp.where(absmax > 0, absmax / FP8_E4M3_MAX, 1.0)
+        y = jnp.clip(xb / scale, -FP8_E4M3_MAX, FP8_E4M3_MAX)
+        return QTensor(data=y.astype(_FP8_DTYPE), scale=scale)
+    raise ValueError(f"scheme {cfg.scheme!r} is not a dense quantizer")
+
+
+def dequantize(qt: QTensor) -> jax.Array:
+    """QTensor -> f32 array of shape (..., nblocks * block)."""
+    full = qt.data.astype(jnp.float32) * qt.scale
+    return full.reshape(*full.shape[:-2], full.shape[-2] * full.shape[-1])
+
+
+def roundtrip(
+    x: jax.Array, cfg: CompressionConfig, key: Optional[jax.Array] = None
+) -> jax.Array:
+    """dequant(quant(x)) with the same shape/dtype as x — the local lossy
+    image of x under this config.  Error-feedback residuals are
+    `x - roundtrip(x)`; also the measurement kernel for quantization-error
+    counters."""
+    if cfg.scheme == "none":
+        return x
+    if cfg.is_sparse:
+        flat = x.astype(jnp.float32).reshape(-1)
+        vals, idx = sparsify(flat, cfg, key)
+        out = jnp.zeros_like(flat).at[idx].set(vals)
+        return out.reshape(x.shape).astype(x.dtype)
+    flat = pad_to_block(x.astype(jnp.float32).reshape(-1), cfg.block)
+    out = dequantize(quantize(flat, cfg, key))
+    return out[: x.size].reshape(x.shape).astype(x.dtype)
+
+
+def quantization_error(
+    x: jax.Array, cfg: CompressionConfig, key: Optional[jax.Array] = None
+) -> jax.Array:
+    """Relative L2 quantization error ||x - Q(x)|| / (||x|| + eps), one
+    scalar — the number the monitor's quantization-error gauge records."""
+    err = (x - roundtrip(x, cfg, key)).astype(jnp.float32)
+    num = jnp.sqrt(jnp.sum(err * err))
+    den = jnp.sqrt(jnp.sum(jnp.square(x.astype(jnp.float32)))) + 1e-12
+    return num / den
+
+
+def sparsify(
+    flat: jax.Array, cfg: CompressionConfig, key: Optional[jax.Array] = None
+) -> Tuple[jax.Array, jax.Array]:
+    """(values, indices) of the kept coordinates of a 1-D array.
+
+    topk keeps the largest-magnitude k·n coordinates (deterministic);
+    randk keeps a uniform random k·n subset (unbiased support, needs a key).
+    """
+    if not cfg.is_sparse:
+        raise ValueError(f"scheme {cfg.scheme!r} is not a sparsifier")
+    n = flat.size
+    kn = max(1, int(round(cfg.k * n)))
+    if cfg.scheme == "topk":
+        _, idx = lax.top_k(jnp.abs(flat), kn)
+    else:
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        idx = jax.random.permutation(key, n)[:kn]
+    return flat[idx], idx.astype(jnp.int32)

@@ -65,6 +65,11 @@ class Counters:
         self._window_s = window_s
         self._egress: Dict[str, RateWindow] = {}
         self._ingress: Dict[str, RateWindow] = {}
+        # compressed-collective accounting: logical payload vs bytes the
+        # wire actually carried, per op name, + last relative quant error
+        self._logical: Dict[str, RateWindow] = {}
+        self._wire: Dict[str, RateWindow] = {}
+        self._quant_err: Dict[str, float] = {}
 
     def _get(self, table: Dict[str, RateWindow], key: str) -> RateWindow:
         w = table.get(key)
@@ -79,6 +84,40 @@ class Counters:
     def add_ingress(self, key: str, nbytes: int) -> None:
         with self._lock:
             self._get(self._ingress, key).add(nbytes)
+
+    def add_wire(self, key: str, logical_bytes: int, wire_bytes: int) -> None:
+        """Record one collective's byte accounting: `logical_bytes` is the
+        uncompressed payload, `wire_bytes` what the chosen wire format moved
+        (config.wire_bytes).  Equal for uncompressed collectives."""
+        with self._lock:
+            self._get(self._logical, key).add(logical_bytes)
+            self._get(self._wire, key).add(wire_bytes)
+
+    def record_quant_error(self, key: str, rel_error: float) -> None:
+        """Last observed relative L2 quantization error for an op (gauge)."""
+        with self._lock:
+            self._quant_err[key] = float(rel_error)
+
+    def wire_totals(self) -> Tuple[Dict[str, int], Dict[str, int]]:
+        """(logical, wire) cumulative bytes per op name."""
+        with self._lock:
+            return (
+                {k: w.total for k, w in self._logical.items()},
+                {k: w.total for k, w in self._wire.items()},
+            )
+
+    def compression_ratios(self) -> Dict[str, float]:
+        """logical/wire per op — 1.0 = uncompressed, ~3.9 = int8@256."""
+        logical, wire = self.wire_totals()
+        return {
+            k: logical[k] / wire[k]
+            for k in logical
+            if wire.get(k, 0) > 0
+        }
+
+    def quant_errors(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._quant_err)
 
     def egress_rates(self) -> Dict[str, float]:
         with self._lock:
@@ -110,6 +149,18 @@ class Counters:
             lines.append(f"# TYPE {metric} {'counter' if 'total' in metric else 'gauge'}")
             for key in sorted(table):
                 lines.append(f'{metric}{{peer="{key}"}} {table[key]}')
+        ltot, wtot = self.wire_totals()
+        for metric, table, kind in (
+            ("collective_logical_total_bytes", ltot, "counter"),
+            ("collective_wire_total_bytes", wtot, "counter"),
+            ("collective_compression_ratio", self.compression_ratios(), "gauge"),
+            ("collective_quantization_error", self.quant_errors(), "gauge"),
+        ):
+            if not table:
+                continue
+            lines.append(f"# TYPE {metric} {kind}")
+            for key in sorted(table):
+                lines.append(f'{metric}{{op="{key}"}} {table[key]}')
         return "\n".join(lines) + "\n"
 
 

@@ -246,19 +246,20 @@ def ppermute_pair_exchange(
 
 
 def _axis_size(axis_name: AxisName) -> int:
-    if isinstance(axis_name, (tuple, list)):
-        size = 1
-        for a in axis_name:
-            size *= lax.axis_size(a)
-        return size
-    return lax.axis_size(axis_name)
+    # `lax.axis_size` does not exist on the pinned JAX; compat routes to it
+    # where available and to the static psum(1, axis) fold otherwise
+    from .. import compat
+
+    return compat.axis_size(axis_name)
 
 
 def _flat_axis_index(axis_name: AxisName) -> jax.Array:
     """Row-major flat index over one or several axes."""
+    from .. import compat
+
     if isinstance(axis_name, (tuple, list)):
         idx = jnp.zeros((), jnp.int32)
         for a in axis_name:
-            idx = idx * lax.axis_size(a) + lax.axis_index(a)
+            idx = idx * compat.axis_size(a) + lax.axis_index(a)
         return idx
     return lax.axis_index(axis_name)

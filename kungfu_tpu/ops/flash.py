@@ -35,6 +35,8 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .. import compat
+
 NEG_INF = -1e30
 
 
@@ -237,9 +239,7 @@ def _flash_fwd(q, k, v, scale: float, causal: bool, block_q: int, block_k: int,
     )
     # under shard_map (check_vma) outputs must declare how they vary across
     # mesh axes: they vary exactly as the union of the inputs
-    vma = frozenset().union(
-        *(getattr(jax.typeof(x), "vma", frozenset()) for x in (qp, kp, vp))
-    )
+    vma = compat.vma_of(qp, kp, vp)
     kv_spec = pl.BlockSpec((1, lk, d), lambda b, i: (_kv_row(b, h, hkv), 0, 0))
     o, lse = pl.pallas_call(
         kern,
@@ -254,8 +254,8 @@ def _flash_fwd(q, k, v, scale: float, causal: bool, block_q: int, block_k: int,
             pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, lq, d), q.dtype, vma=vma),
-            jax.ShapeDtypeStruct((bh, 1, lq), jnp.float32, vma=vma),
+            compat.shape_dtype_struct((bh, lq, d), q.dtype, vma=vma),
+            compat.shape_dtype_struct((bh, 1, lq), jnp.float32, vma=vma),
         ],
         interpret=_use_interpret() if interpret is None else interpret,
     )(qp, kp, vp)
@@ -489,10 +489,7 @@ def _bwd_pallas(q, k, v, o, lse, g, scale: float, causal: bool,
     lse_p = rows(lse)
     delta_p = rows(delta)
 
-    vma = frozenset().union(
-        *(getattr(jax.typeof(x), "vma", frozenset())
-          for x in (qp, kp, vp, dop, lse_p, delta_p))
-    )
+    vma = compat.vma_of(qp, kp, vp, dop, lse_p, delta_p)
     dq_kern = functools.partial(
         _bwd_dq_kernel, scale=scale, causal=causal, block_k=block_k,
         seq_len=seq_len, window=window,
@@ -510,7 +507,7 @@ def _bwd_pallas(q, k, v, o, lse, g, scale: float, causal: bool,
             pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, lq, d), q.dtype, vma=vma),
+        out_shape=compat.shape_dtype_struct((bh, lq, d), q.dtype, vma=vma),
         interpret=interpret,
     )(qp, kp, vp, dop, lse_p, delta_p)
 
@@ -535,8 +532,8 @@ def _bwd_pallas(q, k, v, o, lse, g, scale: float, causal: bool,
                 pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
             ],
             out_shape=[
-                jax.ShapeDtypeStruct((bh, lk, d), k.dtype, vma=vma),
-                jax.ShapeDtypeStruct((bh, lk, d), v.dtype, vma=vma),
+                compat.shape_dtype_struct((bh, lk, d), k.dtype, vma=vma),
+                compat.shape_dtype_struct((bh, lk, d), v.dtype, vma=vma),
             ],
             interpret=interpret,
         )(kp, vp, qp, dop, lse_p, delta_p)
@@ -564,8 +561,8 @@ def _bwd_pallas(q, k, v, o, lse, g, scale: float, causal: bool,
                 pl.BlockSpec((1, block_k, d), lambda b, j, g_: (b, j, 0)),
             ],
             out_shape=[  # fp32: cross-group accumulation must be exact
-                jax.ShapeDtypeStruct((bhkv, lk, d), jnp.float32, vma=vma),
-                jax.ShapeDtypeStruct((bhkv, lk, d), jnp.float32, vma=vma),
+                compat.shape_dtype_struct((bhkv, lk, d), jnp.float32, vma=vma),
+                compat.shape_dtype_struct((bhkv, lk, d), jnp.float32, vma=vma),
             ],
             interpret=interpret,
         )(kp, vp, qp, dop, lse_p, delta_p)

@@ -11,14 +11,26 @@ Reference-named aliases (for users migrating from KungFu):
     AdaptiveSGDOptimizer               -> adaptive_sgd
     MonitorGradientNoiseScaleOptimizer -> gradient_noise_scale
 """
-from .sync import all_reduce_gradients, synchronous_sgd, synchronous_averaging, SMAState
+from .sync import (
+    all_reduce_gradients,
+    synchronous_sgd,
+    synchronous_averaging,
+    CompressedGradState,
+    SMAState,
+)
 from .gossip import (
     pair_averaging,
     GossipState,
     HostPairAveraging,
     OverlappedHostPairAveraging,
 )
-from .adaptive import adaptive_sgd, AdaptiveSGDState
+from .adaptive import (
+    adaptive_sgd,
+    AdaptiveSGDState,
+    noise_adaptive_compression,
+    get_compression_state,
+    NoiseAdaptiveCompressionState,
+)
 from .presets import lm_adamw
 from .monitor import (
     gradient_noise_scale,
@@ -41,7 +53,9 @@ __all__ = [
     "all_reduce_gradients", "synchronous_sgd", "synchronous_averaging",
     "pair_averaging", "adaptive_sgd", "gradient_noise_scale", "gradient_variance",
     "get_noise_scale", "get_gradient_variance",
+    "noise_adaptive_compression", "get_compression_state",
     "SMAState", "GossipState", "AdaptiveSGDState", "NoiseScaleState", "GradVarianceState",
+    "CompressedGradState", "NoiseAdaptiveCompressionState",
     "SynchronousSGDOptimizer", "SynchronousAveragingOptimizer",
     "PairAveragingOptimizer", "AdaptiveSGDOptimizer",
     "MonitorGradientNoiseScaleOptimizer", "MonitorGradientVarianceOptimizer",

@@ -30,6 +30,8 @@ import jax.numpy as jnp
 from jax import lax
 import optax
 
+from .. import compat
+
 
 class GossipState(NamedTuple):
     inner: optax.OptState
@@ -53,13 +55,24 @@ def pair_averaging(
     shifts: Optional[Sequence[int]] = None,
     selector: str = "random",  # "random" | "roundrobin" (async_sgd peer selectors)
     seed: int = 0,
+    compression=None,
 ) -> optax.GradientTransformation:
     """PairAveragingOptimizer: directed randomized gossip + local gradients.
 
     Must run under shard_map with `axis_name` in scope.  `axis_size` (the
     data-parallel world size) must be given when it cannot be inferred before
     trace time; it is needed to build the static shift permutations.
+
+    `compression` (kungfu_tpu.compression) diets the pull's wire format:
+    dense configs (bf16/int8/fp8) quantize the pulled model; sparse configs
+    (topk/randk) exchange only k·n coordinates per pull — gossip tolerates
+    the partial mix the same way it tolerates stale pulls (AD-PSGD's
+    convergence argument), so this is the cheapest wire of any optimizer
+    family here.
     """
+    from .. import compression as Comp
+
+    cfg = Comp.resolve(compression) if compression is not None else None
 
     def init_fn(params):
         return GossipState(
@@ -71,21 +84,26 @@ def pair_averaging(
     def update_fn(updates, state, params):
         if params is None:
             raise ValueError("pair_averaging requires params")
-        n = axis_size if axis_size is not None else lax.axis_size(axis_name)
+        n = axis_size if axis_size is not None else compat.axis_size(axis_name)
         ss = tuple(shifts) if shifts is not None else _shift_set(n)
+
+        key, sub = jax.random.split(state.key)
+        sub, wire_key = jax.random.split(sub)
 
         def pull(shift: int):
             perm = [((i + shift) % n, i) for i in range(n)]  # i receives from i+shift
 
             def f(p):
+                if cfg is not None and cfg.scheme != "none":
+                    return Comp.compressed_pair_average(
+                        p, axis_name, perm, cfg, key=wire_key
+                    )
                 other = lax.ppermute(p, axis_name, perm)
                 return (p + other) * 0.5
 
             return f
 
         branches = [lambda t, s=s: jax.tree.map(pull(s), t) for s in ss]
-
-        key, sub = jax.random.split(state.key)
         if n <= 1 or ss == (0,):
             mixed = params
         elif selector == "roundrobin":

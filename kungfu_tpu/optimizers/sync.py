@@ -24,6 +24,7 @@ from jax import lax
 import optax
 
 from ..ops import collective as C
+from .. import compression as Comp
 
 AxisName = Union[str, Tuple[str, ...]]
 
@@ -64,23 +65,110 @@ def _mean_reducer(axis_name: AxisName, impl: str):
 
 
 def all_reduce_gradients(
-    axis_name: AxisName = "dp", impl: str = "pmean"
+    axis_name: AxisName = "dp",
+    impl: str = "pmean",
+    compression: Comp.AxisCompression = None,
+    seed: int = 0,
 ) -> optax.GradientTransformation:
     """Gradient-averaging transform: the core of S-SGD (sync_sgd.py:81-112).
 
-    Equivalent to the reference's group_all_reduce(grads) + /np.  Stateless.
-    `impl` selects the collective schedule (see _mean_reducer) — the in-step
-    analog of the reference's swappable allreduce strategies.
+    Equivalent to the reference's group_all_reduce(grads) + /np.  Stateless
+    when uncompressed.  `impl` selects the collective schedule (see
+    _mean_reducer) — the in-step analog of the reference's swappable
+    allreduce strategies.
+
+    `compression` selects the wire format (kungfu_tpu.compression): a
+    CompressionConfig / registered name applies to the whole reduction; a
+    dict maps axis names to per-axis configs — with impl="hierarchical"
+    and axis_name=(dcn, ici), {"dcn": "int8"} quantizes only the slow DCN
+    leg.  Quantized configs with error_feedback=True keep an EF residual
+    pytree in the transform state (error_feedback.py), so compression error
+    re-enters the next step's gradients instead of being lost.
     """
-    reducer = _mean_reducer(axis_name, impl)
+    if compression is None:
+        reducer = _mean_reducer(axis_name, impl)
+
+        def init_fn(params):
+            del params
+            return optax.EmptyState()
+
+        def update_fn(updates, state, params=None):
+            del params
+            return jax.tree.map(reducer, updates), state
+
+        return optax.GradientTransformation(init_fn, update_fn)
+
+    return _compressed_all_reduce_gradients(axis_name, impl, compression, seed)
+
+
+class CompressedGradState(NamedTuple):
+    ef: Comp.EFState
+    key: jax.Array
+
+
+def _compressed_reducer(axis_name: AxisName, impl: str,
+                        compression: Comp.AxisCompression):
+    """Per-leaf compressed mean-reduction for the selected schedule."""
+    if impl == "hierarchical":
+        if not (isinstance(axis_name, (tuple, list)) and len(axis_name) == 2):
+            raise ValueError(
+                f"hierarchical reduction needs (dcn, ici) axes, got {axis_name!r}"
+            )
+        dcn, ici = axis_name
+        ici_cfg = Comp.resolve_for_axis(compression, ici)
+        dcn_cfg = Comp.resolve_for_axis(compression, dcn)
+
+        def reduce_leaf(g, key):
+            return Comp.hierarchical_all_reduce(
+                g, ici, dcn, ici_cfg, dcn_cfg, op="mean", key=key
+            )
+
+        # the residual tracks the error of the leg that quantizes first
+        local_cfg = ici_cfg if ici_cfg.is_quantized else dcn_cfg
+        return reduce_leaf, local_cfg
+
+    # flat axis (or axis tuple): one wire format for the whole reduction
+    cfg = Comp.resolve_for_axis(compression, axis_name)
+
+    def reduce_leaf(g, key):
+        return Comp.all_reduce(g, axis_name, cfg, op="mean", key=key)
+
+    return reduce_leaf, cfg
+
+
+def _compressed_all_reduce_gradients(
+    axis_name: AxisName, impl: str, compression: Comp.AxisCompression, seed: int
+) -> optax.GradientTransformation:
+    reduce_leaf, local_cfg = _compressed_reducer(axis_name, impl, compression)
+    use_ef = local_cfg.error_feedback and local_cfg.scheme != "none"
 
     def init_fn(params):
-        del params
-        return optax.EmptyState()
+        return CompressedGradState(
+            ef=Comp.error_feedback.init(params),
+            key=jax.random.PRNGKey(seed),
+        )
 
     def update_fn(updates, state, params=None):
         del params
-        return jax.tree.map(reducer, updates), state
+        key, sub = jax.random.split(state.key)
+        corrected = (
+            Comp.error_feedback.correct(updates, state.ef) if use_ef else updates
+        )
+        leaves, treedef = jax.tree.flatten(corrected)
+        keys = jax.random.split(sub, len(leaves) + 1)
+        reduced = jax.tree.unflatten(
+            treedef, [reduce_leaf(g, k) for g, k in zip(leaves, keys)]
+        )
+        # keep the inner optimizer's expected dtype
+        reduced = jax.tree.map(
+            lambda r, u: r.astype(jnp.asarray(u).dtype), reduced, updates
+        )
+        ef = (
+            Comp.error_feedback.residual_update(corrected, local_cfg, keys[-1])
+            if use_ef
+            else state.ef
+        )
+        return reduced, CompressedGradState(ef=ef, key=key)
 
     return optax.GradientTransformation(init_fn, update_fn)
 
@@ -89,14 +177,20 @@ def synchronous_sgd(
     inner: optax.GradientTransformation,
     axis_name: AxisName = "dp",
     impl: str = "pmean",
+    compression: Comp.AxisCompression = None,
 ) -> optax.GradientTransformation:
     """SynchronousSGDOptimizer: average grads across the mesh, then `inner`.
 
     Reference semantics (optimizers/sync_sgd.py:15-112, Horovod-equivalent):
     every worker applies the same averaged gradient, so parameters stay
-    bitwise identical across replicas.
+    bitwise identical across replicas.  `compression` selects the gradient
+    wire format (see all_reduce_gradients) — the reduced result is still
+    identical on every replica, so the invariant survives quantization.
     """
-    return optax.chain(all_reduce_gradients(axis_name, impl=impl), inner)
+    return optax.chain(
+        all_reduce_gradients(axis_name, impl=impl, compression=compression),
+        inner,
+    )
 
 
 class SMAState(NamedTuple):

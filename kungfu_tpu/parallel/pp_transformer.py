@@ -41,11 +41,7 @@ import flax.linen as nn
 from flax.linen import spmd as flax_spmd
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:
-    from jax import shard_map as _shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _shard_map
-
+from ..compat import shard_map as _shard_map
 from ..models.transformer import Block, TransformerConfig, TransformerLM
 from .pp import pipeline_spmd
 
@@ -200,6 +196,10 @@ class PipelinedLM:
             mesh=self.mesh,
             in_specs=(P(self.pp_axis), P(dp)),
             out_specs=P(dp),
+            # the pipeline's switch-over-shifts cond mixes pp-varying and
+            # replicated carries; replication checking rejects it on both
+            # JAX generations (check_rep / check_vma)
+            check_vma=False,
         )(p["blocks"], x)
 
         # final norm + head (outside the pipe)

@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..compat import axis_size as _axis_size
+
 NEG_INF = -1e30
 
 
@@ -133,7 +135,7 @@ def ring_attention(
         impl = "flash" if jax.default_backend() == "tpu" else "einsum"
     if impl == "flash":
         return _ring_attention_flash(q, k, v, axis_name, causal, scale)
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     B, Lc, H, D = q.shape
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
@@ -174,7 +176,7 @@ def _ring_attention_flash(q, k, v, axis_name, causal, scale):
     normalized (o, lse) pair merges into the running pair (logaddexp), so
     the accumulator math stays out of the kernel and stays differentiable
     (the kernel's VJP handles the lse cotangent)."""
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     B, Lc, H, D = q.shape
     scale = scale if scale is not None else 1.0 / (D ** 0.5)

@@ -35,6 +35,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..compat import axis_size as _axis_size
+
 
 def _seq_to_heads(x, axis_name: str):
     """[B, L/n, H, D] (per device) -> [B, L, H/n, D]: gather seq, split heads."""
@@ -71,7 +73,7 @@ def ulysses_attention(
     flash kernel on TPU, plain einsum elsewhere (models/transformer.py's
     "auto" rule) — both are GQA-native.
     """
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     b, l_shard, h, d = q.shape
     hkv = k.shape[2]
     if h % n:

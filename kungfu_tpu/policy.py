@@ -30,6 +30,72 @@ class BasePolicy:
     def after_step(self, metrics: Optional[Dict[str, Any]] = None) -> None: ...
 
 
+class CompressionPolicy(BasePolicy):
+    """Host-side gradient-compression switcher driven by the GNS monitor.
+
+    The in-program variant (optimizers.noise_adaptive_compression) compiles
+    both wire formats into one step; this policy is the host-side analog
+    for trainers that pre-build one compiled step per CompressionConfig and
+    swap between them like strategy swaps (Session.set_strategy): it reads
+    the monitored noise scale after each step and calls `switch(config)`
+    when the regime changes.
+
+    Hysteresis: compress at noise_scale >= threshold, decompress only below
+    threshold * hysteresis — a band that stops the policy from thrashing
+    compiled-step caches when the EMA hovers at the boundary.
+
+    Args:
+      switch: callable(config) invoked on every regime change — typically
+        rebinds the trainer's active compiled step.
+      threshold: GNS at/above which the compressed wire turns on.
+      compressed: the config to switch to (default int8).
+      uncompressed: the config below the band (default none).
+      metric: key to read from the after_step metrics dict.
+      getter: alternative zero-arg callable returning the metric (e.g.
+        lambda: float(get_noise_scale(state.opt_state))) when the train
+        loop doesn't put it in metrics.
+    """
+
+    def __init__(self, switch, threshold: float, compressed=None,
+                 uncompressed=None, hysteresis: float = 0.5,
+                 metric: str = "noise_scale", getter=None):
+        from . import compression as Comp
+
+        self.switch = switch
+        self.threshold = float(threshold)
+        self.hysteresis = float(hysteresis)
+        self.metric = metric
+        self.getter = getter
+        self.compressed = Comp.resolve(compressed if compressed is not None else "int8")
+        self.uncompressed = Comp.resolve(uncompressed)
+        self.active = self.uncompressed
+        self.switches = 0
+
+    def _read(self, metrics) -> Optional[float]:
+        if metrics and self.metric in metrics:
+            try:
+                return float(metrics[self.metric])
+            except (TypeError, ValueError):
+                return None
+        if self.getter is not None:
+            return float(self.getter())
+        return None
+
+    def after_step(self, metrics: Optional[Dict[str, Any]] = None) -> None:
+        ns = self._read(metrics)
+        if ns is None:
+            return
+        target = self.active
+        if ns >= self.threshold:
+            target = self.compressed
+        elif ns < self.threshold * self.hysteresis:
+            target = self.uncompressed
+        if target is not self.active:
+            self.active = target
+            self.switches += 1
+            self.switch(target)
+
+
 class PolicyRunner:
     """Drives policies and the named progress variables (policy_hook.py:8-80).
 

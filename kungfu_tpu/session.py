@@ -24,17 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:  # jax >= 0.6
-    from jax import shard_map as _shard_map
-
-    def shard_map(f, mesh, in_specs, out_specs):
-        return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _old_shard_map
-
-    def shard_map(f, mesh, in_specs, out_specs):
-        return _old_shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
-
+from .compat import shard_map
 from .ops import collective as C
 from .plan import Strategy, Impl, impl_of, make_mesh
 from .utils import get_logger, stall_detector
@@ -190,8 +180,28 @@ class Session:
         reduce_impl = self._reduce_impl(op, impl)
 
         if kind == "all_reduce":
-            def body(x):
-                return reduce_impl(jnp.squeeze(x, 0))[None]
+            cfg = kw.get("compression")
+            if cfg is not None and cfg.scheme != "none":
+                from . import compression as Comp
+
+                if self._hierarchical_axes is not None:
+                    # compress the slow DCN leg only (the EQuARX placement);
+                    # ICI stays full precision
+                    def body(x):
+                        return Comp.hierarchical_all_reduce(
+                            jnp.squeeze(x, 0), "ici", "dcn",
+                            ici_config=None, dcn_config=cfg, op=op,
+                        )[None]
+                else:
+                    axis_ = axis
+
+                    def body(x):
+                        return Comp.all_reduce(
+                            jnp.squeeze(x, 0), axis_, cfg, op=op
+                        )[None]
+            else:
+                def body(x):
+                    return reduce_impl(jnp.squeeze(x, 0))[None]
         elif kind == "reduce":
             root = kw["root"]
             def body(x):
@@ -254,16 +264,42 @@ class Session:
         return out
 
     def all_reduce(self, x, op: str = "sum", name: str = "", strategy=None,
-                   tree=None):
+                   tree=None, compression=None):
         """`tree` (father array) selects the implementation family for THIS
         op only — the reference MonitoredAllReduce's explicit tree input
-        (cpu/collective.cpp:105), without touching the session default."""
+        (cpu/collective.cpp:105), without touching the session default.
+
+        `compression` (config or registered name, kungfu_tpu.compression)
+        selects the wire format for THIS op; when byte-count monitoring is
+        on, logical-vs-wire bytes and the observed quantization error land
+        in the global counters (collective_* metrics)."""
         if tree is not None:
             from .plan.graph import Graph
             from .plan.strategy import strategy_for_tree
 
             strategy = strategy_for_tree(Graph.from_forest_array(list(tree)))
-        return self._run("all_reduce", x, op=op, name=name, strategy=strategy)
+        cfg = None
+        if compression is not None:
+            from . import compression as Comp
+
+            cfg = Comp.resolve(compression)
+        out = self._run("all_reduce", x, op=op, name=name, strategy=strategy,
+                        compression=cfg)
+        c = self._byte_counters
+        if c is not None and cfg is not None:
+            from . import compression as Comp
+
+            x_arr = jnp.asarray(x)
+            elems = int(x_arr.size) // self.size  # per-peer payload
+            itemsize = int(jnp.dtype(x_arr.dtype).itemsize)
+            # same 2(n-1)/n algorithmic factor for every dense wire format,
+            # so the per-leg payload is the fair per-scheme comparison
+            c.add_wire(name or "all_reduce", elems * itemsize,
+                       cfg.wire_bytes(elems, itemsize))
+            if cfg.scheme != "none":
+                err = float(np.asarray(Comp.quantization_error(x_arr, cfg)))
+                c.record_quant_error(name or "all_reduce", err)
+        return out
 
     def _fused_group_fn(self, signature, op: str, impl: Impl) -> Callable:
         """One compiled program reducing EVERY tensor in the list.

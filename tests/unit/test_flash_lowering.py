@@ -8,6 +8,10 @@ the attention bench records the on-chip numbers.
 """
 
 import jax
+# on the pinned JAX, `jax.export` is importable but not set as a module
+# attribute until the submodule import runs (newer JAX attaches it lazily);
+# the explicit import makes `jax.export.export` below work on both
+import jax.export  # noqa: F401
 import jax.numpy as jnp
 
 from kungfu_tpu.ops.flash import flash_attention, flash_attention_with_lse
