@@ -1,0 +1,68 @@
+"""kf-lint — jaxpr-level static analysis for collective programs.
+
+KungFu's adaptation story (swap the topology, the wire format, the cluster
+size — mid-training) is only usable if every such change is cheap to trust:
+on TPU a typo'd axis name, a cond whose branches disagree about their
+collectives, a non-bijective ppermute or a raw fp32 psum on an axis the
+deployment quantizes all compile fine and then hang or silently corrupt a
+multi-minute SPMD launch.  GC3 (arXiv:2201.11840) showed collective
+programs are tractable targets for compile-time reasoning; EQuARX
+(arXiv:2506.17615) showed quantized-collective correctness rests on
+statically checkable dtype-flow invariants.  This package enforces both
+classes of invariant on traced jaxprs — before anything touches hardware.
+
+Three surfaces:
+
+  library     `analysis.check(fn, *args, mesh=..., compression=...)`
+              traces fn (no devices, no compile) and returns structured
+              `Finding`s with jaxpr provenance.
+  hooks       `Session(..., analyze=True)`, `synchronous_sgd(...,
+              analyze=True)`, `pair_averaging(..., analyze=True)`,
+              `FSDPTrainer(..., analyze=True)` — or `KUNGFU_ANALYZE=1` —
+              run the checker at trace time and raise `AnalysisError` on
+              error-severity findings before dispatch.
+  CLI         `python -m kungfu_tpu.analysis` lints the built-in program
+              corpus (optimizers, examples, benchmark programs, every
+              registered strategy implementation); `--module pkg.mod`
+              lints a module's declared PROGRAMS.
+
+Layout: findings.py (Finding/AnalysisError), extract.py (jaxpr walker +
+replication tracking), rules.py (the rule engine), check.py (entry
+points), programs.py (the built-in corpus the CLI checks).
+"""
+from .findings import (  # noqa: F401
+    ALL_RULES,
+    ERROR,
+    INFO,
+    WARNING,
+    RULE_AXIS,
+    RULE_DEADLOCK,
+    RULE_PERMUTATION,
+    RULE_REPLICATION,
+    RULE_WIRE_DTYPE,
+    AnalysisError,
+    Finding,
+    errors,
+    format_findings,
+)
+from .extract import Collective, CondSite, Extraction, OutputLeak, extract  # noqa: F401
+from .rules import RULES, RuleContext, run_rules  # noqa: F401
+from .check import (  # noqa: F401
+    abstractify,
+    assert_clean,
+    check,
+    check_and_raise,
+    check_axes_in_scope,
+    check_elastic_permutations,
+)
+
+__all__ = [
+    "ALL_RULES", "ERROR", "WARNING", "INFO",
+    "RULE_AXIS", "RULE_DEADLOCK", "RULE_PERMUTATION", "RULE_REPLICATION",
+    "RULE_WIRE_DTYPE",
+    "AnalysisError", "Finding", "errors", "format_findings",
+    "Collective", "CondSite", "Extraction", "OutputLeak", "extract",
+    "RULES", "RuleContext", "run_rules",
+    "abstractify", "assert_clean", "check", "check_and_raise",
+    "check_axes_in_scope", "check_elastic_permutations",
+]

@@ -1,0 +1,119 @@
+"""kf-lint CLI: `python -m kungfu_tpu.analysis`.
+
+Default run lints the built-in corpus (shipped optimizers, session
+strategies, parallel schedules, example/benchmark train steps) and exits 0
+iff no error-severity finding fires.  `--module pkg.mod` lints a module's
+declared `PROGRAMS` list instead (the seeded-bad-program suite in
+kungfu_tpu.testing.bad_programs is the canonical non-zero run).
+
+Analysis is pure tracing, so the CLI pins the CPU backend with 8 virtual
+devices (conftest-style) unless the caller already forced a platform.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib
+import os
+import sys
+import time
+from typing import List
+
+
+def _setup_backend() -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    import jax
+
+    # the TPU tunnel's sitecustomize can pin jax_platforms through
+    # jax.config; tracing needs no accelerator, so override like conftest
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+
+def _load_module_programs(dotted: str) -> List:
+    mod = importlib.import_module(dotted)
+    progs = getattr(mod, "PROGRAMS", None)
+    if progs is None:
+        raise SystemExit(
+            f"module {dotted!r} declares no PROGRAMS list "
+            "(expected a list of kungfu_tpu.analysis.programs.Program)"
+        )
+    return list(progs)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m kungfu_tpu.analysis",
+        description="kf-lint: static analysis of collective programs",
+    )
+    ap.add_argument("--module", default=None,
+                    help="lint a module's PROGRAMS instead of the corpus")
+    ap.add_argument("--program", action="append", default=None,
+                    help="restrict to named program(s)")
+    ap.add_argument("--tag", action="append", default=None,
+                    help="restrict to programs carrying a tag "
+                         "(optimizer, session, parallel, example, bench, "
+                         "compression)")
+    ap.add_argument("--suppress", action="append", default=[],
+                    help="rule id(s) to skip")
+    ap.add_argument("--list", action="store_true", help="list programs")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="print warnings/info findings too")
+    args = ap.parse_args(argv)
+
+    _setup_backend()
+
+    from . import format_findings
+    from .findings import ERROR
+    from .programs import ProgramUnavailable, builtin_programs, check_program
+
+    programs = (_load_module_programs(args.module) if args.module
+                else builtin_programs())
+    if args.program:
+        wanted = set(args.program)
+        programs = [p for p in programs if p.name in wanted]
+        missing = wanted - {p.name for p in programs}
+        if missing:
+            raise SystemExit(f"unknown program(s): {sorted(missing)}")
+    if args.tag:
+        tags = set(args.tag)
+        programs = [p for p in programs if tags & set(p.tags)]
+    if args.list:
+        for p in programs:
+            print(f"{p.name:32s} [{','.join(p.tags)}] {p.description}")
+        return 0
+    if not programs:
+        raise SystemExit("no programs selected")
+
+    n_err = n_warn = n_skip = 0
+    for p in programs:
+        t0 = time.perf_counter()
+        try:
+            findings = check_program(p, suppress=tuple(args.suppress))
+        except ProgramUnavailable as e:
+            n_skip += 1
+            print(f"SKIP  {p.name}: {e}")
+            continue
+        ms = (time.perf_counter() - t0) * 1e3
+        errs = [f for f in findings if f.severity == ERROR]
+        rest = [f for f in findings if f.severity != ERROR]
+        n_err += len(errs)
+        n_warn += len(rest)
+        status = "FAIL" if errs else "ok"
+        print(f"{status:5s} {p.name}  ({ms:.0f} ms, "
+              f"{len(errs)} errors, {len(rest)} warnings)")
+        shown = errs + (rest if args.verbose else [])
+        if shown:
+            for line in format_findings(shown).splitlines():
+                print(f"      {line}")
+    print(f"kf-lint: {len(programs)} programs, {n_err} errors, "
+          f"{n_warn} warnings, {n_skip} skipped")
+    return 1 if n_err else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,73 @@
+"""Finding — the structured result every kf-lint rule emits.
+
+A Finding pins one defect (or hazard) to a place in a traced program: the
+rule that fired, a severity, a human message, and jaxpr provenance (the
+nesting path of sub-jaxprs plus, when available, the user source line the
+offending equation was traced from).  `error` findings are the ones the
+trace-time hooks raise on and the CLI turns into a non-zero exit; `warning`
+findings survive in the report but never block dispatch.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence, Tuple
+
+ERROR = "error"
+WARNING = "warning"
+INFO = "info"
+
+#: rule identifiers (stable strings — suppression keys, test assertions)
+RULE_AXIS = "axis-validity"
+RULE_DEADLOCK = "deadlock"
+RULE_PERMUTATION = "permutation"
+RULE_WIRE_DTYPE = "wire-dtype"
+RULE_REPLICATION = "unreduced-gradient"
+
+ALL_RULES = (RULE_AXIS, RULE_DEADLOCK, RULE_PERMUTATION, RULE_WIRE_DTYPE,
+             RULE_REPLICATION)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule hit, with jaxpr provenance.
+
+    Attributes:
+      rule: one of ALL_RULES.
+      severity: "error" | "warning" | "info".
+      message: human-readable description of the defect.
+      path: nesting path through sub-jaxprs, e.g.
+        ("shard_map", "scan:body", "cond:branch1").
+      axes: the mesh axes involved, if any.
+      source: "file:line" of the offending equation when the trace kept it.
+    """
+
+    rule: str
+    severity: str
+    message: str
+    path: Tuple[str, ...] = ()
+    axes: Tuple[str, ...] = ()
+    source: str = ""
+
+    def format(self) -> str:
+        loc = "/".join(self.path) or "<toplevel>"
+        src = f" [{self.source}]" if self.source else ""
+        return f"{self.severity}: {self.rule} @ {loc}{src}: {self.message}"
+
+
+def errors(findings: Sequence[Finding]) -> Tuple[Finding, ...]:
+    return tuple(f for f in findings if f.severity == ERROR)
+
+
+def format_findings(findings: Sequence[Finding]) -> str:
+    if not findings:
+        return "no findings"
+    return "\n".join(f.format() for f in findings)
+
+
+class AnalysisError(Exception):
+    """Raised by the trace-time hooks when error-severity findings exist."""
+
+    def __init__(self, findings: Sequence[Finding], context: str = ""):
+        self.findings = tuple(findings)
+        head = f"kf-lint: {context}: " if context else "kf-lint: "
+        super().__init__(head + "\n" + format_findings(self.findings))

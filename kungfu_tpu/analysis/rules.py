@@ -1,0 +1,227 @@
+"""kf-lint rules over an Extraction.
+
+Each rule is a function `(ctx) -> list[Finding]` registered in RULES; the
+engine runs all of them (minus suppressed ids) over one `RuleContext`.
+Rules are pure: everything they need — the extraction, the declared mesh
+axes, axis sizes, and the compression plan — rides in the context, so the
+same engine serves the library API, the trace-time hooks and the CLI.
+
+Rule catalog (docs/analysis.md documents each failure mode on real TPUs):
+
+  axis-validity       collective axes must exist in the declared mesh;
+                      compression dict keys must name declared axes.
+  deadlock            a cond/switch whose predicate is device-varying must
+                      not contain collectives: devices disagreeing on the
+                      branch issue mismatched (or differently-channeled)
+                      collectives and the program hangs.  A replicated
+                      predicate proves uniform branch selection, so even
+                      divergent branch sequences are safe then.
+  permutation         every static ppermute permutation must be injective
+                      and in-range for the axis size (plan/graph.py's
+                      bijection checker, shared with the runtime paths).
+  wire-dtype          an axis configured for a quantized wire (int8/fp8)
+                      must not carry raw full-precision reductions; no
+                      collective may move float64.
+  unreduced-gradient  a shard_map output claimed replicated must not be
+                      device-varying: error when the program never reduces
+                      over the leaked axis (a missing psum — the classic
+                      unreduced-gradient-into-optimizer bug), warning when
+                      it does (per-device state under a replicated spec).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Sequence, Tuple
+
+from ..compression.config import AxisCompression, resolve_for_axis
+from ..plan.graph import permutation_errors
+from .extract import Extraction
+from .findings import (
+    ERROR,
+    Finding,
+    RULE_AXIS,
+    RULE_DEADLOCK,
+    RULE_PERMUTATION,
+    RULE_REPLICATION,
+    RULE_WIRE_DTYPE,
+    WARNING,
+)
+
+#: reductions a quantized axis must not see in full precision
+_RAW_REDUCTIONS = ("psum", "reduce_scatter")
+
+
+@dataclasses.dataclass
+class RuleContext:
+    extraction: Extraction
+    known_axes: Tuple[str, ...] = ()
+    compression: AxisCompression = None
+
+    @property
+    def axis_sizes(self) -> Dict[str, int]:
+        return self.extraction.axis_sizes
+
+    def quantized_axes(self) -> Dict[str, int]:
+        """{axis: block} for every known axis mapped to a quantized wire."""
+        out: Dict[str, int] = {}
+        axes = self.known_axes or tuple(self.axis_sizes)
+        for a in axes:
+            try:
+                cfg = resolve_for_axis(self.compression, a)
+            except (ValueError, TypeError):
+                continue
+            if cfg.is_quantized:
+                out[a] = cfg.block
+        return out
+
+
+def rule_axis_validity(ctx: RuleContext) -> List[Finding]:
+    out: List[Finding] = []
+    known = set(ctx.known_axes) | set(ctx.axis_sizes)
+    if not known:
+        return out
+    for c in ctx.extraction.collectives:
+        bad = tuple(a for a in c.axes if a not in known)
+        if bad:
+            out.append(Finding(
+                rule=RULE_AXIS, severity=ERROR, path=c.path, axes=bad,
+                source=c.source,
+                message=(f"{c.prim} over unknown axis {bad}; declared axes: "
+                         f"{sorted(known)}"),
+            ))
+    if isinstance(ctx.compression, dict):
+        bad = tuple(k for k in ctx.compression if k not in known)
+        if bad:
+            out.append(Finding(
+                rule=RULE_AXIS, severity=ERROR, axes=bad,
+                message=(f"compression config keys {bad} name no declared "
+                         f"mesh axis; declared axes: {sorted(known)} — the "
+                         "typo'd axis would silently stay full precision"),
+            ))
+    return out
+
+
+def rule_deadlock(ctx: RuleContext) -> List[Finding]:
+    out: List[Finding] = []
+    for site in ctx.extraction.cond_sites:
+        if not site.pred_varying or not site.has_collectives:
+            continue
+        sigs = " vs ".join(
+            "[" + ", ".join(f"{p}@{'/'.join(a)}" for p, a in sig) + "]"
+            for sig in site.branch_signatures
+        )
+        out.append(Finding(
+            rule=RULE_DEADLOCK, severity=ERROR, path=site.path,
+            axes=tuple(sorted(site.pred_varying)), source=site.source,
+            message=(
+                "collectives under a cond whose predicate is device-varying "
+                f"over {tuple(sorted(site.pred_varying))}: devices can take "
+                f"different branches and hang the collective (branches: {sigs}"
+                "). Make the predicate replicated (e.g. lax.pmax it) or hoist "
+                "the collectives out of the cond."
+            ),
+        ))
+    return out
+
+
+def rule_permutation(ctx: RuleContext) -> List[Finding]:
+    out: List[Finding] = []
+    for c in ctx.extraction.collectives:
+        if c.prim != "ppermute" or c.perm is None or not c.axes:
+            continue
+        n = ctx.axis_sizes.get(c.axes[0])
+        if n is None:
+            continue
+        for problem in permutation_errors(c.perm, n):
+            out.append(Finding(
+                rule=RULE_PERMUTATION, severity=ERROR, path=c.path,
+                axes=c.axes, source=c.source,
+                message=(f"ppermute over {c.axes[0]} (size {n}): {problem}; "
+                         "a non-bijective permutation double-sends to one "
+                         "device and starves another, which hangs on TPU"),
+            ))
+    return out
+
+
+def rule_wire_dtype(ctx: RuleContext) -> List[Finding]:
+    out: List[Finding] = []
+    quantized = ctx.quantized_axes()
+    for c in ctx.extraction.collectives:
+        if c.dtype in ("float64", "complex128"):
+            out.append(Finding(
+                rule=RULE_WIRE_DTYPE, severity=ERROR, path=c.path,
+                axes=c.axes, source=c.source,
+                message=(f"{c.prim} moves {c.dtype} over {c.axes}: 64-bit "
+                         "payloads double wire bytes and do not lower on "
+                         "TPU collectives — cast down before the exchange"),
+            ))
+        if not quantized or c.prim not in _RAW_REDUCTIONS:
+            continue
+        hit = [a for a in c.axes if a in quantized]
+        # payloads at or below one quantization block are exempt: scalars,
+        # counters and per-block scales gain nothing from the compressed path
+        if hit and c.dtype.startswith(("float", "bfloat")) and \
+                c.size > min(quantized[a] for a in hit):
+            out.append(Finding(
+                rule=RULE_WIRE_DTYPE, severity=ERROR, path=c.path,
+                axes=tuple(hit), source=c.source,
+                message=(f"raw {c.prim} of {c.dtype}[{c.size}] over "
+                         f"compressed axis {hit}: this axis is configured "
+                         "for a quantized wire — route the reduction through "
+                         "kungfu_tpu.compression.collectives so codes (not "
+                         "full-precision words) cross the slow link"),
+            ))
+    return out
+
+
+def rule_replication(ctx: RuleContext) -> List[Finding]:
+    out: List[Finding] = []
+    reduced = ctx.extraction.reduced_axes()
+    for leak in ctx.extraction.leaks:
+        never_reduced = tuple(a for a in leak.axes if a not in reduced)
+        severity = ERROR if never_reduced else WARNING
+        if never_reduced:
+            detail = (f"the program never reduces over {never_reduced} — an "
+                      "unreduced gradient (or other per-device value) is "
+                      "flowing into replicated state; add a psum/pmean")
+        else:
+            detail = ("the program does reduce over these axes elsewhere, so "
+                      "this looks like per-device auxiliary state under a "
+                      "replicated out_spec — give it a device-dim spec or "
+                      "reduce it")
+        out.append(Finding(
+            rule=RULE_REPLICATION, severity=severity, path=leak.path,
+            axes=leak.axes, source=leak.source,
+            message=(f"shard_map output #{leak.out_index} is device-varying "
+                     f"over {leak.axes} but its out_spec claims replication; "
+                     + detail),
+        ))
+    return out
+
+
+RULES: Dict[str, Callable[[RuleContext], List[Finding]]] = {
+    RULE_AXIS: rule_axis_validity,
+    RULE_DEADLOCK: rule_deadlock,
+    RULE_PERMUTATION: rule_permutation,
+    RULE_WIRE_DTYPE: rule_wire_dtype,
+    RULE_REPLICATION: rule_replication,
+}
+
+
+def run_rules(
+    extraction: Extraction,
+    known_axes: Sequence[str] = (),
+    compression: AxisCompression = None,
+    suppress: Sequence[str] = (),
+) -> List[Finding]:
+    ctx = RuleContext(
+        extraction=extraction,
+        known_axes=tuple(known_axes),
+        compression=compression,
+    )
+    findings: List[Finding] = []
+    for rule_id, rule in RULES.items():
+        if rule_id in suppress:
+            continue
+        findings.extend(rule(ctx))
+    return findings

@@ -40,6 +40,7 @@ from .config import (
     registered,
     resolve,
     resolve_for_axis,
+    validate_axis_keys,
 )
 from .quant import (
     QTensor,
@@ -65,6 +66,7 @@ __all__ = [
     "AxisCompression", "CompressionConfig",
     "NONE", "BF16", "INT8", "INT8_SR", "FP8", "TOPK_1PCT", "RANDK_1PCT",
     "register", "registered", "resolve", "resolve_for_axis",
+    "validate_axis_keys",
     "QTensor", "quantize", "dequantize", "roundtrip", "pad_to_block",
     "quantization_error", "sparsify",
     "all_reduce", "cross_all_reduce", "hierarchical_all_reduce",

@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from typing import Dict, Mapping, Optional, Union
+from typing import Dict, Mapping, Union
 
 #: fp8 e4m3 finite max (used as the fp8 per-block scale target)
 FP8_E4M3_MAX = 448.0
@@ -134,9 +134,41 @@ def resolve(cfg: Union[None, str, CompressionConfig]) -> CompressionConfig:
     raise TypeError(f"cannot resolve compression config from {type(cfg).__name__}")
 
 
-def resolve_for_axis(cfg: AxisCompression, axis_name) -> CompressionConfig:
-    """Per-axis lookup: dicts map axis name -> config (missing = none)."""
+def validate_axis_keys(
+    cfg: AxisCompression, known_axes, context: str = ""
+) -> None:
+    """Eagerly reject per-axis keys that name no known mesh axis.
+
+    A typo'd key ({"dcn ": "int8"} vs {"dcn": "int8"}) is otherwise
+    *silent*: resolve_for_axis's dict .get() misses and the axis quietly
+    stays full precision — the deployment thinks it is compressing the DCN
+    hop and isn't.  Call this wherever the axis set is known (the optimizer
+    wrappers do, at construction).
+    """
+    if not isinstance(cfg, Mapping):
+        return
+    known = tuple(known_axes)
+    bad = sorted(k for k in cfg if k not in known)
+    if bad:
+        where = f" ({context})" if context else ""
+        raise ValueError(
+            f"compression config keys {bad} name no known axis{where}; "
+            f"known axes: {sorted(known)} — a typo'd axis key silently "
+            "falls back to full precision"
+        )
+
+
+def resolve_for_axis(
+    cfg: AxisCompression, axis_name, known_axes=None
+) -> CompressionConfig:
+    """Per-axis lookup: dicts map axis name -> config (missing = none).
+
+    `known_axes`, when given, validates dict keys eagerly (see
+    validate_axis_keys) before the lookup.
+    """
     if isinstance(cfg, Mapping):
+        if known_axes is not None:
+            validate_axis_keys(cfg, known_axes)
         return resolve(cfg.get(axis_name))
     return resolve(cfg)
 

@@ -26,7 +26,6 @@ DataParallelTrainer API so the two are drop-in interchangeable.
 """
 from __future__ import annotations
 
-import dataclasses
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
@@ -75,6 +74,10 @@ class FSDPTrainer:
              hop while fsdp rides ICI — so this compresses exactly the slow
              leg and leaves the reduce_scatter/all_gather fsdp traffic in
              full precision.  Ignored when the mesh has no dp axis.
+      analyze: arm the kf-lint trace-time hook (kungfu_tpu.analysis): the
+             compiled step is statically checked at its first train_step,
+             raising AnalysisError before dispatch on error-severity
+             findings.  None defers to KUNGFU_ANALYZE=1.
     """
 
     def __init__(
@@ -85,9 +88,20 @@ class FSDPTrainer:
         remat: bool = False,
         donate: bool = True,
         compression=None,
+        analyze: Optional[bool] = None,
     ):
         from . import compression as _compression_mod
+        from .utils.envflag import analyze_enabled
 
+        if isinstance(compression, dict):
+            # eager key validation (compression/config.py): a typo'd axis
+            # key would silently run the dp leg at full precision
+            mesh_axes = (mesh.axis_names if mesh is not None else ("fsdp",))
+            _compression_mod.validate_axis_keys(compression, mesh_axes,
+                                                context="FSDPTrainer")
+            compression = compression.get("dp")
+        self._analyze = analyze_enabled(analyze)
+        self._linted = False
         self.compression = (
             _compression_mod.resolve(compression) if compression is not None else None
         )
@@ -281,7 +295,25 @@ class FSDPTrainer:
         sharding = NamedSharding(self.mesh, P(self.data_axes))
         return jax.tree.map(lambda x: _put_local_shard(x, sharding), batch)
 
+    def _lint_step(self, state: TrainState, batch: Any) -> None:
+        """kf-lint the compiled step before its first dispatch (pure
+        tracing on abstract inputs; runs once per trainer)."""
+        from . import analysis
+
+        comp = None
+        if (self.has_dp and self.compression is not None
+                and self.compression.scheme != "none"):
+            comp = {"dp": self.compression}
+        args = analysis.abstractify((state.params, state.opt_state, batch))
+        analysis.check_and_raise(
+            self._compiled_step, *args, mesh=self.mesh, compression=comp,
+            context="FSDPTrainer.train_step",
+        )
+        self._linted = True
+
     def train_step(self, state: TrainState, batch: Any) -> Tuple[TrainState, Dict]:
+        if self._analyze and not self._linted:
+            self._lint_step(state, batch)
         params, opt_state, metrics = self._compiled_step(
             state.params, state.opt_state, batch
         )

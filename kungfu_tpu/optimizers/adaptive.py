@@ -57,8 +57,16 @@ def adaptive_sgd(
             # identity on pre-vma JAX)
             return compat.tree_pcast((u, s), axis_name)
 
+        # pmax-fold the step counter: every replica increments it in
+        # lockstep, so this is the identity — but it makes the phase-switch
+        # predicates replicated by construction, so all devices provably
+        # take the same cond branch (the branches issue different
+        # collective sequences; a device-varying predicate there would
+        # hang real TPUs — kf-lint's deadlock rule)
+        step = lax.pmax(state.step, axis_name)
+
         u, inner_state = lax.cond(
-            state.step < switch_step, sma_branch, ssgd_branch,
+            step < switch_step, sma_branch, ssgd_branch,
             (updates, state.inner, params),
         )
 
@@ -69,7 +77,7 @@ def adaptive_sgd(
                 lambda ui, p: ui + (C.broadcast(p, axis_name, root=0) - p), u_, params
             )
 
-        u = lax.cond(state.step == switch_step, sync, lambda u_: u_, u)
+        u = lax.cond(step == switch_step, sync, lambda u_: u_, u)
         return u, AdaptiveSGDState(step=state.step + 1, inner=inner_state)
 
     return optax.GradientTransformation(init_fn, update_fn)
@@ -140,8 +148,11 @@ def noise_adaptive_compression(
         n = axis_size if axis_size is not None else C._axis_size(axis_name)
         key, sub = jax.random.split(state.key)
 
-        # ---- choose the wire from LAST step's EMA (replicated scalar) ----
+        # ---- choose the wire from LAST step's EMA (replicated scalar; the
+        # pmin fold makes "all replicas agree to compress" structural, so
+        # the wire-format cond is provably uniform across devices) ----
         use_comp = state.noise_scale >= jnp.float32(gns_threshold)
+        use_comp = lax.pmin(use_comp.astype(jnp.int32), axis_name) > 0
 
         leaves, treedef = jax.tree.flatten(updates)
         keys = jax.random.split(sub, len(leaves))

@@ -23,7 +23,7 @@ replaces the reference's tf.random peer selector (async_sgd.py:73).
 """
 from __future__ import annotations
 
-from typing import NamedTuple, Optional, Sequence, Tuple, Union
+from typing import NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -56,6 +56,7 @@ def pair_averaging(
     selector: str = "random",  # "random" | "roundrobin" (async_sgd peer selectors)
     seed: int = 0,
     compression=None,
+    analyze: Optional[bool] = None,
 ) -> optax.GradientTransformation:
     """PairAveragingOptimizer: directed randomized gossip + local gradients.
 
@@ -69,10 +70,23 @@ def pair_averaging(
     the partial mix the same way it tolerates stale pulls (AD-PSGD's
     convergence argument), so this is the cheapest wire of any optimizer
     family here.
+
+    `analyze` (or KUNGFU_ANALYZE=1) arms the kf-lint trace-time hook
+    (kungfu_tpu.analysis): axis-in-scope checking at every trace.  The
+    shift permutations themselves are always validated (plan.graph
+    bijection check — a non-bijective pull pairing hangs real TPUs), and
+    the selected shift index is pmax-folded across the axis, making the
+    lax.switch branch choice replicated *by construction*: even if PRNG
+    keys ever desynchronized across replicas, every device still takes the
+    same branch, which is the invariant that keeps divergent ppermute
+    sequences deadlock-free.
     """
     from .. import compression as Comp
+    from ..plan.graph import validate_permutation
+    from .sync import _analyze_enabled
 
     cfg = Comp.resolve(compression) if compression is not None else None
+    analyze_on = _analyze_enabled(analyze)
 
     def init_fn(params):
         return GossipState(
@@ -84,6 +98,10 @@ def pair_averaging(
     def update_fn(updates, state, params):
         if params is None:
             raise ValueError("pair_averaging requires params")
+        if analyze_on:
+            from .. import analysis
+
+            analysis.check_axes_in_scope(axis_name, context="pair_averaging")
         n = axis_size if axis_size is not None else compat.axis_size(axis_name)
         ss = tuple(shifts) if shifts is not None else _shift_set(n)
 
@@ -92,6 +110,7 @@ def pair_averaging(
 
         def pull(shift: int):
             perm = [((i + shift) % n, i) for i in range(n)]  # i receives from i+shift
+            validate_permutation(perm, n, what=f"gossip shift {shift}")
 
             def f(p):
                 if cfg is not None and cfg.scheme != "none":
@@ -106,11 +125,17 @@ def pair_averaging(
         branches = [lambda t, s=s: jax.tree.map(pull(s), t) for s in ss]
         if n <= 1 or ss == (0,):
             mixed = params
-        elif selector == "roundrobin":
-            idx = state.step % len(ss)
-            mixed = lax.switch(idx, branches, params)
         else:
-            idx = jax.random.randint(sub, (), 0, len(ss))
+            if selector == "roundrobin":
+                idx = state.step % len(ss)
+            else:
+                idx = jax.random.randint(sub, (), 0, len(ss))
+            # pmax-fold the branch index: all replicas draw from the same
+            # synchronized key, so this is the identity — but it makes the
+            # uniform-branch-selection invariant structural (a device-
+            # varying switch over ppermute branches deadlocks real TPUs;
+            # kf-lint's deadlock rule proves this one can't)
+            idx = lax.pmax(idx, axis_name)
             mixed = lax.switch(idx, branches, params)
 
         # apply local grads on top of the mixed model (async_sgd.py:127-140);

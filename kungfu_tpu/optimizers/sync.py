@@ -25,8 +25,13 @@ import optax
 
 from ..ops import collective as C
 from .. import compression as Comp
+from ..utils.envflag import analyze_enabled as _analyze_enabled
 
 AxisName = Union[str, Tuple[str, ...]]
+
+
+def _axes_tuple(axis_name: AxisName) -> Tuple[str, ...]:
+    return tuple(axis_name) if isinstance(axis_name, (tuple, list)) else (axis_name,)
 
 
 def _tree_pmean(tree, axis_name: AxisName):
@@ -69,6 +74,7 @@ def all_reduce_gradients(
     impl: str = "pmean",
     compression: Comp.AxisCompression = None,
     seed: int = 0,
+    analyze: Optional[bool] = None,
 ) -> optax.GradientTransformation:
     """Gradient-averaging transform: the core of S-SGD (sync_sgd.py:81-112).
 
@@ -84,7 +90,25 @@ def all_reduce_gradients(
     leg.  Quantized configs with error_feedback=True keep an EF residual
     pytree in the transform state (error_feedback.py), so compression error
     re-enters the next step's gradients instead of being lost.
+
+    `analyze` (or KUNGFU_ANALYZE=1) arms the kf-lint trace-time hook: at
+    every trace of the update the declared axes are checked against the
+    surrounding mesh scope and per-axis compression keys against the bound
+    axes, raising analysis.AnalysisError before anything dispatches.
     """
+    # eager per-axis key validation: a typo'd key would otherwise silently
+    # run this reduction at full precision (compression/config.py)
+    Comp.validate_axis_keys(compression, _axes_tuple(axis_name),
+                            context="all_reduce_gradients")
+    analyze_on = _analyze_enabled(analyze)
+
+    def _lint_scope():
+        if analyze_on:
+            from .. import analysis
+
+            analysis.check_axes_in_scope(axis_name, compression=compression,
+                                         context="all_reduce_gradients")
+
     if compression is None:
         reducer = _mean_reducer(axis_name, impl)
 
@@ -94,11 +118,13 @@ def all_reduce_gradients(
 
         def update_fn(updates, state, params=None):
             del params
+            _lint_scope()
             return jax.tree.map(reducer, updates), state
 
         return optax.GradientTransformation(init_fn, update_fn)
 
-    return _compressed_all_reduce_gradients(axis_name, impl, compression, seed)
+    return _compressed_all_reduce_gradients(axis_name, impl, compression,
+                                            seed, _lint_scope)
 
 
 class CompressedGradState(NamedTuple):
@@ -137,7 +163,8 @@ def _compressed_reducer(axis_name: AxisName, impl: str,
 
 
 def _compressed_all_reduce_gradients(
-    axis_name: AxisName, impl: str, compression: Comp.AxisCompression, seed: int
+    axis_name: AxisName, impl: str, compression: Comp.AxisCompression,
+    seed: int, lint_scope=lambda: None
 ) -> optax.GradientTransformation:
     reduce_leaf, local_cfg = _compressed_reducer(axis_name, impl, compression)
     use_ef = local_cfg.error_feedback and local_cfg.scheme != "none"
@@ -150,6 +177,7 @@ def _compressed_all_reduce_gradients(
 
     def update_fn(updates, state, params=None):
         del params
+        lint_scope()
         key, sub = jax.random.split(state.key)
         corrected = (
             Comp.error_feedback.correct(updates, state.ef) if use_ef else updates
@@ -178,6 +206,7 @@ def synchronous_sgd(
     axis_name: AxisName = "dp",
     impl: str = "pmean",
     compression: Comp.AxisCompression = None,
+    analyze: Optional[bool] = None,
 ) -> optax.GradientTransformation:
     """SynchronousSGDOptimizer: average grads across the mesh, then `inner`.
 
@@ -186,9 +215,11 @@ def synchronous_sgd(
     bitwise identical across replicas.  `compression` selects the gradient
     wire format (see all_reduce_gradients) — the reduced result is still
     identical on every replica, so the invariant survives quantization.
+    `analyze` (or KUNGFU_ANALYZE=1) arms the kf-lint trace-time checks.
     """
     return optax.chain(
-        all_reduce_gradients(axis_name, impl=impl, compression=compression),
+        all_reduce_gradients(axis_name, impl=impl, compression=compression,
+                             analyze=analyze),
         inner,
     )
 

@@ -30,7 +30,6 @@ rides along: each dp row runs an independent pipeline on its batch shard.
 """
 from __future__ import annotations
 
-from functools import partial
 from typing import Any, Callable
 
 import jax
@@ -39,6 +38,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..compat import axis_size as _axis_size, pcast as _pcast, shard_map as _shard_map
+from ..plan.graph import validate_permutation
 
 
 def pipeline_spmd(
@@ -68,6 +68,9 @@ def pipeline_spmd(
         )
     stage = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % S) for i in range(S)]
+    # trace-time sanity on the ring wiring (plan.graph's bijection check,
+    # shared with kf-lint): a non-bijective hop pattern hangs real TPUs
+    validate_permutation(perm, S, what=f"pipeline ring over {axis_name!r}")
 
     if remat:
         stage_fn = jax.checkpoint(stage_fn)

@@ -126,6 +126,52 @@ class Graph:
         return len(seen) == len(self)
 
 
+# --- permutation validation (shared with kungfu_tpu.analysis kf-lint) ----------------
+
+
+def permutation_errors(
+    pairs: Sequence[Tuple[int, int]], n: int
+) -> List[str]:
+    """Why `pairs` is not a valid ppermute permutation over `n` ranks.
+
+    Returns [] when every (src, dst) is in range and no rank sends or
+    receives twice — the injectivity XLA's ppermute requires (a duplicate
+    destination double-writes one device's buffer while another starves,
+    which hangs the collective on real TPUs).  Partial permutations (ranks
+    not covered) are legal: uncovered receivers get zeros by ppermute's
+    semantics, so they are not reported here.
+    """
+    problems: List[str] = []
+    srcs: Dict[int, int] = {}
+    dsts: Dict[int, int] = {}
+    for src, dst in pairs:
+        if not (0 <= src < n):
+            problems.append(f"source {src} out of range [0, {n})")
+        if not (0 <= dst < n):
+            problems.append(f"destination {dst} out of range [0, {n})")
+        srcs[src] = srcs.get(src, 0) + 1
+        dsts[dst] = dsts.get(dst, 0) + 1
+    for r, k in sorted(srcs.items()):
+        if k > 1:
+            problems.append(f"rank {r} appears as source {k} times")
+    for r, k in sorted(dsts.items()):
+        if k > 1:
+            problems.append(f"rank {r} appears as destination {k} times")
+    return problems
+
+
+def validate_permutation(
+    pairs: Sequence[Tuple[int, int]], n: int, what: str = "ppermute"
+) -> None:
+    """Raise ValueError unless `pairs` is a valid permutation over n ranks."""
+    problems = permutation_errors(pairs, n)
+    if problems:
+        raise ValueError(
+            f"invalid {what} permutation over {n} ranks: "
+            + "; ".join(problems)
+        )
+
+
 # --- generators (reference srcs/go/plan/topology.go) ---------------------------------
 
 

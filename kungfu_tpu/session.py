@@ -73,6 +73,10 @@ class Session:
       mesh: the device mesh; default = 1-D "dp" mesh over all local devices.
       strategy: initial collective strategy (AUTO resolves by host count).
       host_count: number of hosts backing the mesh (drives AUTO + hierarchical).
+      analyze: arm the kf-lint trace-time hook (kungfu_tpu.analysis): every
+        newly-built collective program is statically checked before its
+        first dispatch, raising AnalysisError on error-severity findings.
+        None defers to KUNGFU_ANALYZE=1.
     """
 
     def __init__(
@@ -80,7 +84,10 @@ class Session:
         mesh: Optional[Mesh] = None,
         strategy: Strategy = Strategy.AUTO,
         host_count: int = 1,
+        analyze: Optional[bool] = None,
     ):
+        from .utils.envflag import analyze_enabled
+
         self.mesh = mesh if mesh is not None else make_mesh(dp=-1)
         self.strategy = strategy
         self.host_count = host_count
@@ -89,6 +96,8 @@ class Session:
 
         self._byte_counters = counters_if_enabled()
         self._fns: Dict[Any, Callable] = {}
+        self._analyze = analyze_enabled(analyze)
+        self._analyzed: set = set()
         names = self.mesh.axis_names
         self._hierarchical_axes = ("ici", "dcn") if ("ici" in names and "dcn" in names) else None
         self._axes: Tuple[str, ...] = tuple(names)
@@ -242,12 +251,41 @@ class Session:
             )
         return x
 
+    def _lint(self, kind: str, op: str, impl: Impl, fn: Callable,
+              x: jax.Array, **kw) -> None:
+        """kf-lint one compiled collective before its first dispatch.
+
+        Pure tracing (make_jaxpr on an abstract input), cached per
+        (program, shape, dtype) — after the first call per program the
+        hook costs one set lookup."""
+        key = (kind, op, impl, tuple(sorted(kw.items())), tuple(x.shape),
+               str(x.dtype))
+        if key in self._analyzed:
+            return
+        from . import analysis
+
+        cfg = kw.get("compression")
+        comp = None
+        if cfg is not None and getattr(cfg, "scheme", "none") != "none":
+            # the compressed leg: DCN on a hierarchical mesh, else the
+            # (single) data axis — mirrors _build's placement
+            leg = "dcn" if self._hierarchical_axes is not None else self._axes[0]
+            comp = {leg: cfg}
+        findings = analysis.check(
+            fn, jax.ShapeDtypeStruct(x.shape, x.dtype),
+            mesh=self.mesh, compression=comp,
+        )
+        analysis.assert_clean(findings, context=f"Session.{kind}")
+        self._analyzed.add(key)
+
     def _dispatch(self, kind: str, x: jax.Array, op: str = "sum",
                   strategy: Optional[Strategy] = None, **kw) -> jax.Array:
         """Enqueue one compiled collective without waiting for it."""
         x = self._check_stacked(x)
         impl = self._impl(strategy)
         fn = self._compiled(kind, op, impl, **kw)
+        if self._analyze:
+            self._lint(kind, op, impl, fn, x, **kw)
         return fn(x)
 
     def _run(self, kind: str, x: jax.Array, op: str = "sum", name: str = "",

@@ -1,0 +1,158 @@
+"""Seeded-bad collective programs — the kf-lint negative corpus.
+
+Five programs, one per rule, each minimal enough that exactly its target
+rule fires (the test suite asserts the findings list is precisely the
+expected one).  `python -m kungfu_tpu.analysis --module
+kungfu_tpu.testing.bad_programs` is the canonical non-zero CLI run.
+
+Every program here is a real bug class we either hit or dodged on TPUs:
+the axis typo and the divergent cond both compile cleanly and then hang a
+multi-minute SPMD launch; the rest silently corrupt results.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from ..analysis.findings import (
+    RULE_AXIS,
+    RULE_DEADLOCK,
+    RULE_PERMUTATION,
+    RULE_REPLICATION,
+    RULE_WIRE_DTYPE,
+)
+from ..analysis.programs import Program, _mesh, _sds
+
+
+def _b_axis_typo():
+    def build():
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        from ..compat import shard_map
+
+        mesh = _mesh({"dp": 8})
+
+        def body(x):
+            return lax.psum(x, "dp ")  # trailing space: the classic typo
+
+        fn = shard_map(body, mesh, in_specs=P("dp"), out_specs=P(),
+                       check_vma=False)
+        return fn, (_sds((8, 128)),), {"mesh": mesh}
+
+    return build
+
+
+def _b_cond_divergent():
+    def build():
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        from ..compat import shard_map
+
+        mesh = _mesh({"dp": 8})
+
+        def body(x):
+            i = lax.axis_index("dp")
+            # devices disagree on the branch; only one branch psums -> hang
+            return lax.cond(i % 2 == 0,
+                            lambda v: lax.psum(v, "dp"),
+                            lambda v: v, x)
+
+        fn = shard_map(body, mesh, in_specs=P("dp"), out_specs=P("dp"),
+                       check_vma=False)
+        return fn, (_sds((8, 128)),), {"mesh": mesh}
+
+    return build
+
+
+def _b_bad_ppermute():
+    def build():
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        from ..compat import shard_map
+
+        mesh = _mesh({"dp": 8})
+        # rank 1 receives twice, rank 0 never: double-write + starvation
+        perm = [(0, 1), (1, 1)] + [(i, i) for i in range(2, 8)]
+
+        def body(x):
+            return lax.ppermute(x, "dp", perm)
+
+        fn = shard_map(body, mesh, in_specs=P("dp"), out_specs=P("dp"),
+                       check_vma=False)
+        return fn, (_sds((8, 128)),), {"mesh": mesh}
+
+    return build
+
+
+def _b_raw_psum_on_int8_axis():
+    def build():
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        from ..compat import shard_map
+
+        mesh = _mesh({"dp": 8})
+
+        def body(x):
+            # full-precision words on an axis deployed with an int8 wire
+            return lax.psum(x, "dp")
+
+        fn = shard_map(body, mesh, in_specs=P("dp"), out_specs=P(),
+                       check_vma=False)
+        return fn, (_sds((8, 4096)),), {"mesh": mesh,
+                                        "compression": {"dp": "int8"}}
+
+    return build
+
+
+def _b_unreduced_gradient():
+    def build():
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from ..compat import shard_map
+
+        mesh = _mesh({"dp": 8})
+
+        def loss(p, b):
+            return jnp.mean((b @ p) ** 2)
+
+        def body(p, b):
+            g = jax.grad(loss)(p, b)  # per-device grads, never psummed
+            return p - 0.01 * g       # ...flowing into replicated params
+
+        fn = shard_map(body, mesh, in_specs=(P(), P("dp")), out_specs=P(),
+                       check_vma=False)
+        return fn, (_sds((16, 4)), _sds((32, 16))), {"mesh": mesh}
+
+    return build
+
+
+#: program name -> the one rule it must trip (the test contract)
+EXPECTED_RULE = {
+    "bad-axis-typo": RULE_AXIS,
+    "bad-cond-divergent-psum": RULE_DEADLOCK,
+    "bad-nonbijective-ppermute": RULE_PERMUTATION,
+    "bad-raw-psum-on-int8-axis": RULE_WIRE_DTYPE,
+    "bad-unreduced-gradient": RULE_REPLICATION,
+}
+
+PROGRAMS: List[Program] = [
+    Program("bad-axis-typo", ("bad", RULE_AXIS), _b_axis_typo(),
+            "psum over 'dp ' (trailing space) — unbound axis"),
+    Program("bad-cond-divergent-psum", ("bad", RULE_DEADLOCK),
+            _b_cond_divergent(),
+            "cond on axis_index parity; one branch psums, one doesn't"),
+    Program("bad-nonbijective-ppermute", ("bad", RULE_PERMUTATION),
+            _b_bad_ppermute(),
+            "ppermute where rank 1 is written twice and rank 0 starves"),
+    Program("bad-raw-psum-on-int8-axis", ("bad", RULE_WIRE_DTYPE),
+            _b_raw_psum_on_int8_axis(),
+            "raw fp32 psum on an axis configured for an int8 wire"),
+    Program("bad-unreduced-gradient", ("bad", RULE_REPLICATION),
+            _b_unreduced_gradient(),
+            "per-device gradient applied to replicated params, no psum"),
+]

@@ -4,3 +4,12 @@ import os
 
 def env_flag(name: str) -> bool:
     return os.environ.get(name, "").lower() in ("1", "true", "yes", "on")
+
+
+def analyze_enabled(analyze=None) -> bool:
+    """Resolve an `analyze=` hook argument: None defers to KUNGFU_ANALYZE.
+
+    The shared opt-in switch for the kf-lint trace-time hooks
+    (kungfu_tpu.analysis) in Session, the optimizer transforms and the
+    trainers — one env var arms every hook at once."""
+    return env_flag("KUNGFU_ANALYZE") if analyze is None else bool(analyze)

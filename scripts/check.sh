@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# Repo gate: lint (ruff), kf-lint static analysis, tier-1 tests.
+#
+#   scripts/check.sh            # run everything
+#   scripts/check.sh --fast     # skip the tier-1 pytest run
+#
+# Exits non-zero on the first failing stage.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+fast=0
+[ "${1:-}" = "--fast" ] && fast=1
+
+echo "== ruff =="
+if command -v ruff >/dev/null 2>&1; then
+    ruff check kungfu_tpu tests examples scripts bench.py
+elif python -c "import ruff" >/dev/null 2>&1; then
+    python -m ruff check kungfu_tpu tests examples scripts bench.py
+else
+    # the container bakes its own toolchain; never pip install here
+    echo "ruff not installed — skipping (config lives in pyproject.toml)"
+fi
+
+echo "== kf-lint: shipped corpus (must be clean) =="
+JAX_PLATFORMS=cpu python -m kungfu_tpu.analysis
+
+echo "== kf-lint: seeded-bad corpus (must fail) =="
+if JAX_PLATFORMS=cpu python -m kungfu_tpu.analysis \
+        --module kungfu_tpu.testing.bad_programs >/dev/null 2>&1; then
+    echo "ERROR: seeded-bad programs analyzed clean — the rules lost teeth" >&2
+    exit 1
+fi
+echo "ok (exit non-zero as expected)"
+
+if [ "$fast" = "1" ]; then
+    echo "== tier-1 pytest skipped (--fast) =="
+    exit 0
+fi
+
+echo "== tier-1 pytest =="
+JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider
