@@ -570,6 +570,32 @@ def _measure_analysis_ms():
         return None
 
 
+def _measure_mttr_s():
+    """Recovery latency of the self-healing loop: one scripted crash+heal
+    drill (kungfu_tpu.chaos) on CPU subprocesses, reporting worker-death ->
+    first completed post-heal step.  Subprocess-only — the bench parent
+    never imports jax.  Opt out with KFT_BENCH_SKIP_MTTR=1."""
+    if os.environ.get("KFT_BENCH_SKIP_MTTR"):
+        return None
+    try:
+        import re
+        import subprocess
+
+        r = subprocess.run(
+            [sys.executable, "-m", "kungfu_tpu.chaos", "--np", "2",
+             "--plan", "crash@step=5:rank=1", "--total-samples", "512",
+             "--timeout", "110"],
+            capture_output=True, text=True, timeout=150,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        m = re.search(r"mttr_s=([\d.]+)", r.stdout)
+        if r.returncode == 0 and m:
+            return float(m.group(1))
+    except Exception:  # never let the chaos probe sink the headline
+        pass
+    return None
+
+
 def main():
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     # honor an explicit KFT_PLATFORM/JAX_PLATFORMS=cpu request (harness
@@ -685,6 +711,7 @@ def main():
         input_pipeline = {"error": f"{type(e).__name__}: {e}"}
 
     analysis_ms = _measure_analysis_ms()
+    mttr_s = _measure_mttr_s()
 
     # comparative context (VERDICT r4 missing #1): the recorded
     # framework-vs-naked-JAX ratio for this model, when the matrix's
@@ -738,6 +765,11 @@ def main():
                 # BENCH trajectory; None when the device pool can't host
                 # that program's mesh
                 "analysis_ms": analysis_ms,
+                # self-healing recovery latency (worker death -> first
+                # post-heal step) from one scripted CPU crash+heal drill —
+                # keeps MTTR visible in the BENCH trajectory; None when the
+                # drill is skipped or fails
+                "mttr_s": mttr_s,
                 "input_pipeline": input_pipeline,
                 "sweep": [
                     {

@@ -102,16 +102,21 @@ def main():
     if out["resize_p50_s"] is not None:
         lat = (f" resize_p50_s={out['resize_p50_s']} "
                f"resize_p95_s={out['resize_p95_s']}")
+    heals = f" heals={out['heals']}" if out["heals"] else ""
     print(
         f"RESULT: loss={out['loss']:.4f} trained={out['trained_samples']} "
         f"resizes={out['resizes']} final_size={out['final_size']} "
-        f"seconds={out['seconds']:.1f}{lat}{gns}",
+        f"seconds={out['seconds']:.1f}{lat}{gns}{heals}",
         flush=True,
     )
     if out["resize_events"]:
         import json
 
         print("RESIZE_EVENTS: " + json.dumps(out["resize_events"]), flush=True)
+    if out["heal_events"]:
+        import json
+
+        print("HEAL_EVENTS: " + json.dumps(out["heal_events"]), flush=True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,39 @@
+"""Chaos harness — deterministic fault injection for the self-healing loop.
+
+The reference repo tests elasticity only with *planned* resizes; unplanned
+failures (worker crash, hang, preemption, config-server outage) were never
+exercisable.  This package injects them from a declarative plan
+(`KFT_FAULT_PLAN`) so multi-process CPU tests can replay every failure mode
+deterministically.  See docs/fault_tolerance.md.
+
+    KFT_FAULT_PLAN="crash@step=7:rank=2" \
+        python -m kungfu_tpu.run -w -heal -np 3 -platform cpu -- \
+        python -m kungfu_tpu.testing.fake_adaptive_trainer --total-samples 2048
+
+`python -m kungfu_tpu.chaos` runs the scripted crash+heal smoke drill.
+"""
+from .plan import (
+    FAULT_PLAN_ENV,
+    Fault,
+    FaultPlan,
+    parse_fault_plan,
+    plan_from_env,
+)
+from .inject import (
+    ChaosInjector,
+    ServerChaos,
+    injector_from_env,
+    server_chaos_from_env,
+)
+
+__all__ = [
+    "FAULT_PLAN_ENV",
+    "Fault",
+    "FaultPlan",
+    "parse_fault_plan",
+    "plan_from_env",
+    "ChaosInjector",
+    "ServerChaos",
+    "injector_from_env",
+    "server_chaos_from_env",
+]

@@ -1,0 +1,125 @@
+"""``python -m kungfu_tpu.chaos`` — scripted crash+heal smoke drill.
+
+Launches a small heal-armed watch-mode job on CPU, injects the given fault
+plan, and asserts the self-healing contract end to end: the killed worker is
+removed from the cluster document, survivors resize to n-1 without restart,
+training reaches --total-samples with finite loss, and the heal event (old
+size, new size, mttr_s) appears in the worker metrics.  Exit 0 on a healthy
+heal, non-zero otherwise — the chaos stage of scripts/check.sh.
+
+    python -m kungfu_tpu.chaos                    # crash@step=7:rank=2, np=3
+    python -m kungfu_tpu.chaos --plan "hang@step=9:rank=1" --heartbeat-timeout 6
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+
+from .plan import FAULT_PLAN_ENV, parse_fault_plan
+
+
+def run_drill(plan: str, np: int, total_samples: int, timeout_s: float,
+              heartbeat_timeout: float = 0.0) -> dict:
+    """Run one heal drill; returns a summary dict (see keys below)."""
+    parse_fault_plan(plan)  # typo'd plans must fail loudly, not run fault-free
+    env = dict(os.environ)
+    env[FAULT_PLAN_ENV] = plan
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    cmd = [
+        sys.executable, "-m", "kungfu_tpu.run", "-w", "-heal",
+        "-np", str(np), "-platform", "cpu", "-port", "0",
+        "-timeout", str(int(timeout_s)),
+    ]
+    if heartbeat_timeout > 0:
+        cmd += ["-heartbeat-timeout", str(heartbeat_timeout)]
+    cmd += [
+        "--", sys.executable, "-m", "kungfu_tpu.testing.fake_adaptive_trainer",
+        "--total-samples", str(total_samples), "--batch-size", "32",
+    ]
+    r = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                       timeout=timeout_s + 60)
+    out = r.stdout + r.stderr
+    results = re.findall(
+        r"RESULT: fake-adaptive trained=(\d+) resizes=\d+ final_size=(\d+) "
+        r"mesh=\S+ loss=([-\d.naninf]+) heals=(\d+)", out)
+    heal_events: list = []
+    for line in out.splitlines():
+        if "HEAL_EVENTS:" in line and "RUNNER_HEAL_EVENTS:" not in line:
+            heal_events = json.loads(line.split("HEAL_EVENTS:", 1)[1])
+            break
+    runner_events: list = []
+    for line in out.splitlines():
+        if "RUNNER_HEAL_EVENTS:" in line:
+            runner_events = json.loads(line.split("RUNNER_HEAL_EVENTS:", 1)[1])
+            break
+    return {
+        "returncode": r.returncode,
+        "output": out,
+        "results": [
+            {"trained": int(t), "final_size": int(f), "loss": float(l),
+             "heals": int(h)}
+            for t, f, l, h in results
+        ],
+        "heal_events": heal_events,
+        "runner_heal_events": runner_events,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="kungfu_tpu.chaos")
+    ap.add_argument("--plan", default="crash@step=7:rank=2")
+    ap.add_argument("--np", type=int, default=3)
+    ap.add_argument("--total-samples", type=int, default=1536)
+    ap.add_argument("--timeout", type=float, default=240.0)
+    ap.add_argument("--heartbeat-timeout", type=float, default=0.0,
+                    help="arm launcher hang detection (needed for hang@ plans)")
+    args = ap.parse_args(argv)
+
+    summary = run_drill(args.plan, args.np, args.total_samples, args.timeout,
+                        heartbeat_timeout=args.heartbeat_timeout)
+
+    def fail(msg: str) -> int:
+        tail = summary["output"][-3000:]
+        print(f"CHAOS DRILL FAILED: {msg}\n--- output tail ---\n{tail}",
+              file=sys.stderr)
+        return 1
+
+    if summary["returncode"] != 0:
+        return fail(f"launcher exited {summary['returncode']}")
+    if not summary["results"]:
+        return fail("no worker RESULT line")
+    import math
+
+    for res in summary["results"]:
+        if res["trained"] < args.total_samples:
+            return fail(f"trained {res['trained']} < {args.total_samples}")
+        if not math.isfinite(res["loss"]):
+            return fail(f"non-finite final loss {res['loss']}")
+    worker_faults = parse_fault_plan(args.plan).worker_faults()
+    if worker_faults:
+        if not summary["runner_heal_events"]:
+            return fail("no RUNNER_HEAL_EVENTS from the healer")
+        ev = summary["heal_events"]
+        if not ev or "mttr_s" not in ev[0]:
+            return fail("no worker heal event with mttr_s")
+        if not all(r["final_size"] == args.np - 1 for r in summary["results"]):
+            return fail(f"survivors not at n-1={args.np - 1}")
+        print("CHAOS DRILL OK: healed "
+              f"{ev[0]['old_size']} -> {ev[0]['new_size']} workers, "
+              f"mttr_s={ev[0]['mttr_s']}, final loss "
+              f"{summary['results'][0]['loss']:.4f}")
+    else:
+        if summary["runner_heal_events"]:
+            return fail("flap-only plan should not trigger heals")
+        print("CHAOS DRILL OK: fault plan ridden out without a heal, "
+              f"final loss {summary['results'][0]['loss']:.4f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

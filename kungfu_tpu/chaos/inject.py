@@ -1,0 +1,114 @@
+"""Fault injectors — where the declarative plan meets the running system.
+
+Two injection points:
+
+  ChaosInjector.on_step   called at the top of every elastic training step
+                          (elastic/trainer.py) — crashes, hangs and slowdowns
+                          fire here, keyed on (step, rank), so multi-process
+                          tests replay each failure mode deterministically.
+  ServerChaos.should_503  called per request by the config server — models a
+                          control-plane outage window (the `flap` fault).
+
+Both are built from the same KFT_FAULT_PLAN env contract; a process with no
+plan pays nothing (injector_from_env returns None).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Optional, Set
+
+from ..utils import get_logger
+from .plan import Fault, FaultPlan, plan_from_env
+
+log = get_logger("kungfu.chaos")
+
+
+class ChaosInjector:
+    """Worker-side fault trigger.  `exit_fn`/`sleep_fn` are injectable for
+    unit tests (the real thing calls os._exit, which pytest can't survive)."""
+
+    def __init__(
+        self,
+        plan: FaultPlan,
+        exit_fn: Callable[[int], None] = os._exit,
+        sleep_fn: Callable[[float], None] = time.sleep,
+    ):
+        self.plan = plan
+        self._exit = exit_fn
+        self._sleep = sleep_fn
+        self._fired: Set[Fault] = set()  # one-shot kinds already triggered
+
+    def on_step(self, step: int, rank: int) -> None:
+        """Fire any fault scheduled for this (step, rank).  Crash and hang
+        are one-shot; slow applies per step across its window."""
+        for f in self.plan.worker_faults():
+            if f in self._fired or not f.matches(step, rank):
+                continue
+            if f.kind == "crash":
+                self._fired.add(f)
+                log.warning("CHAOS: crash at step %d rank %d (exit %d)", step, rank, f.code)
+                self._exit(f.code)
+            elif f.kind == "hang":
+                self._fired.add(f)
+                log.warning(
+                    "CHAOS: hang at step %d rank %d (%s)",
+                    step, rank, f"{f.secs:.1f}s" if f.secs else "forever",
+                )
+                if f.secs:
+                    self._sleep(f.secs)
+                else:
+                    while True:  # heartbeat goes stale; the healer kills us
+                        self._sleep(3600.0)
+            elif f.kind == "slow":
+                self._sleep(f.ms / 1e3)
+
+
+def injector_from_env() -> Optional[ChaosInjector]:
+    """ChaosInjector for this process's KFT_FAULT_PLAN, or None (no plan)."""
+    plan = plan_from_env()
+    if not plan.worker_faults():
+        return None
+    log.info("fault plan armed: %s", ", ".join(f.kind for f in plan.worker_faults()))
+    return ChaosInjector(plan)
+
+
+class ServerChaos:
+    """Config-server outage windows (`flap@config_server=3s[:after=N]`).
+
+    Deterministic trigger: the (after+1)-th request the server receives opens
+    the window; requests inside it are answered 503.  Each flap fault fires
+    once.  Thread-safe — the config server handles requests concurrently.
+    """
+
+    def __init__(self, plan: FaultPlan, clock: Callable[[], float] = time.monotonic):
+        self._flaps = list(plan.flap_faults())
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._requests = 0
+        self._window_end = 0.0
+
+    def should_503(self) -> bool:
+        with self._lock:
+            now = self._clock()
+            if now < self._window_end:
+                return True
+            self._requests += 1
+            for f in list(self._flaps):
+                if self._requests > f.after:
+                    self._flaps.remove(f)
+                    self._window_end = now + f.duration_s
+                    log.warning(
+                        "CHAOS: config server flap for %.1fs (request %d)",
+                        f.duration_s, self._requests,
+                    )
+                    return True
+            return False
+
+
+def server_chaos_from_env() -> Optional[ServerChaos]:
+    plan = plan_from_env()
+    if not plan.flap_faults():
+        return None
+    return ServerChaos(plan)

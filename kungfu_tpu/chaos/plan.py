@@ -1,0 +1,150 @@
+"""Declarative fault-plan grammar for the chaos harness.
+
+A plan is a semicolon-separated list of faults, each `kind@key=value:...`:
+
+    KFT_FAULT_PLAN="crash@step=7:rank=2;hang@step=12:rank=1;flap@config_server=3s"
+
+Kinds (see docs/fault_tolerance.md for the full grammar):
+
+  crash@step=N:rank=R[:code=C]      worker R calls os._exit(C) when its
+                                    monotonic step counter reaches N
+                                    (default code 41)
+  hang@step=N:rank=R[:secs=S]       worker R stops making progress at step N
+                                    for S seconds (default: forever) — the
+                                    heartbeat/stall machinery must notice
+  slow@step=N:rank=R:ms=M[:steps=K] worker R sleeps M ms at the top of each
+                                    step in [N, N+K) (K=0: until the end) —
+                                    an artificially slow collective
+  flap@config_server=D[:after=N]    the config server answers 503 for D
+                                    seconds, starting at its (N+1)-th
+                                    request (default N=5) — a control-plane
+                                    outage window
+
+Durations accept a trailing "s" or "ms" ("3s", "250ms", bare numbers are
+seconds).  Ranks refer to the worker's LAUNCH rank (its rank when the
+process first joined), not its current rank — current ranks shift when the
+cluster heals or resizes, and a drill's scripted victim must stay the same
+process for the replay to be deterministic.  Every fault fires at most once
+except `slow`, which is a window.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import List, Optional, Tuple
+
+FAULT_PLAN_ENV = "KFT_FAULT_PLAN"
+
+_KINDS = ("crash", "hang", "slow", "flap")
+DEFAULT_CRASH_CODE = 41
+DEFAULT_FLAP_AFTER = 5
+
+
+def _duration_s(value: str, what: str) -> float:
+    v = value.strip()
+    try:
+        if v.endswith("ms"):
+            return float(v[:-2]) / 1e3
+        if v.endswith("s"):
+            return float(v[:-1])
+        return float(v)
+    except ValueError:
+        raise ValueError(f"invalid duration {value!r} for {what}") from None
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    kind: str                       # crash | hang | slow | flap
+    step: int = -1                  # trigger step (crash/hang/slow)
+    rank: int = -1                  # target rank (crash/hang/slow)
+    code: int = DEFAULT_CRASH_CODE  # crash exit code
+    secs: float = 0.0               # hang duration; 0 = forever
+    ms: float = 0.0                 # slow: per-step delay
+    steps: int = 0                  # slow: window length; 0 = until end
+    duration_s: float = 0.0         # flap: outage window
+    after: int = DEFAULT_FLAP_AFTER  # flap: requests served before outage
+
+    def matches(self, step: int, rank: int) -> bool:
+        """True when a worker-side fault fires at (step, rank)."""
+        if self.kind == "slow":
+            hi = self.step + self.steps if self.steps else None
+            in_window = step >= self.step and (hi is None or step < hi)
+            return in_window and rank == self.rank
+        return step == self.step and rank == self.rank
+
+
+def _parse_one(spec: str) -> Fault:
+    kind, sep, rest = spec.partition("@")
+    kind = kind.strip()
+    if not sep or kind not in _KINDS:
+        raise ValueError(
+            f"invalid fault {spec!r}: expected kind@key=value with kind in {_KINDS}"
+        )
+    kv = {}
+    for part in rest.split(":"):
+        key, eq, value = part.partition("=")
+        if not eq:
+            raise ValueError(f"invalid fault arg {part!r} in {spec!r}")
+        kv[key.strip()] = value.strip()
+
+    if kind == "flap":
+        if "config_server" not in kv:
+            raise ValueError(f"flap fault needs config_server=<duration>: {spec!r}")
+        return Fault(
+            kind="flap",
+            duration_s=_duration_s(kv.pop("config_server"), spec),
+            after=int(kv.pop("after", DEFAULT_FLAP_AFTER)),
+            **_reject_leftovers(kv, spec),
+        )
+
+    if "step" not in kv or "rank" not in kv:
+        raise ValueError(f"{kind} fault needs step= and rank=: {spec!r}")
+    f = dict(kind=kind, step=int(kv.pop("step")), rank=int(kv.pop("rank")))
+    if kind == "crash":
+        f["code"] = int(kv.pop("code", DEFAULT_CRASH_CODE))
+        if f["code"] == 0:
+            raise ValueError(f"crash code must be non-zero: {spec!r}")
+    elif kind == "hang":
+        f["secs"] = _duration_s(kv.pop("secs", "0"), spec)
+    elif kind == "slow":
+        if "ms" not in kv:
+            raise ValueError(f"slow fault needs ms=: {spec!r}")
+        f["ms"] = _duration_s(kv.pop("ms") + "ms", spec) * 1e3
+        f["steps"] = int(kv.pop("steps", 0))
+    return Fault(**f, **_reject_leftovers(kv, spec))
+
+
+def _reject_leftovers(kv: dict, spec: str) -> dict:
+    if kv:
+        raise ValueError(f"unknown fault args {sorted(kv)} in {spec!r}")
+    return {}
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    faults: Tuple[Fault, ...]
+
+    def worker_faults(self) -> Tuple[Fault, ...]:
+        return tuple(f for f in self.faults if f.kind in ("crash", "hang", "slow"))
+
+    def flap_faults(self) -> Tuple[Fault, ...]:
+        return tuple(f for f in self.faults if f.kind == "flap")
+
+    def __bool__(self) -> bool:
+        return bool(self.faults)
+
+
+def parse_fault_plan(spec: str) -> FaultPlan:
+    """Parse a KFT_FAULT_PLAN string; raises ValueError on malformed plans
+    (a chaos drill with a typo'd plan must fail loudly, not run fault-free)."""
+    faults: List[Fault] = []
+    for part in (spec or "").split(";"):
+        part = part.strip()
+        if part:
+            faults.append(_parse_one(part))
+    return FaultPlan(faults=tuple(faults))
+
+
+def plan_from_env(env: Optional[dict] = None) -> FaultPlan:
+    e = os.environ if env is None else env
+    return parse_fault_plan(e.get(FAULT_PLAN_ENV, ""))

@@ -1,0 +1,186 @@
+"""jax.distributed runtime bootstrap with failure-tolerant teardown.
+
+`jax.distributed.initialize` hard-codes the coordination-service defaults
+that make *unplanned* failures lethal to survivors:
+
+  - the client's missed-heartbeat callback terminates the process
+    (LOG(QFATAL) in the XLA client), so a dead peer eventually kills every
+    survivor that still holds a client;
+  - `shutdown()` runs an all-tasks barrier with a multi-minute timeout, so
+    a survivor tearing down after a peer death blocks until the heartbeat
+    timeout and then aborts (measured: SIGABRT ~100s after the death).
+
+This module builds the same runtime (service on rank 0 + client everywhere,
+installed into `jax._src.distributed.global_state` so every JAX consumer —
+gloo KV store, run_barrier, preemption sync — sees it) but with a benign
+missed-heartbeat callback, bounded shutdown timeouts, and a **dirty
+teardown** path that drops the runtime without the all-tasks barrier.  The
+self-healing elastic path (elastic/trainer.py) uses dirty teardown when it
+suspects a dead peer and then re-rendezvouses at the next cluster version's
+fenced port; the planned-resize path keeps the graceful barrier.
+
+Tuning (env):
+  KFT_HEARTBEAT_INTERVAL_S    coordination heartbeat period   (default 10)
+  KFT_MAX_MISSING_HEARTBEATS  misses before a task is dead    (default 10)
+  KFT_INIT_TIMEOUT_S          rendezvous timeout              (default 300)
+  KFT_SHUTDOWN_TIMEOUT_S      graceful-shutdown barrier cap   (default 15)
+
+Multi-process CPU testing: the CPU backend only supports cross-process
+collectives through an explicit collectives implementation; JAX defaults it
+to "none", which makes every multi-process CPU program die with
+"Multiprocess computations aren't implemented".  `ensure_cpu_collectives`
+flips the default to gloo exactly when the process is about to run a
+multi-process CPU cluster.
+"""
+from __future__ import annotations
+
+import os
+import time
+
+import jax
+
+from .utils import get_logger
+
+log = get_logger("kungfu.distributed")
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def ensure_cpu_collectives(multiprocess: bool = True) -> None:
+    """Match the CPU collectives flag to the cluster shape.
+
+    Must run before the CPU client is instantiated (first jax.devices()).
+    multiprocess=True enables gloo (JAX defaults to "none", which makes
+    every cross-process CPU program die with "Multiprocess computations
+    aren't implemented").  multiprocess=False flips gloo back OFF: a
+    cluster that healed down to one process has no distributed client, and
+    rebuilding the CPU backend with gloo still configured fails inside
+    make_gloo_tcp_collectives.  No-op on JAX versions without the flag.
+    """
+    plat = str(getattr(jax.config, "jax_platforms", "") or "")
+    if "cpu" not in plat or "tpu" in plat or "axon" in plat:
+        return
+    try:
+        # the flag is an enum_flag (no jax.config attribute): read it where
+        # it lives; jax.config.update still accepts the flag name
+        import jax._src.xla_bridge as xb
+
+        current = xb.CPU_COLLECTIVES_IMPLEMENTATION.value
+        if multiprocess and current in (None, "none"):
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+            log.info("multi-process CPU backend: enabled gloo collectives")
+        elif not multiprocess and current == "gloo":
+            jax.config.update("jax_cpu_collectives_implementation", "none")
+            log.info("single-process CPU backend: disabled gloo collectives")
+    except (AttributeError, ValueError):  # pragma: no cover - flag drift
+        pass
+
+
+def _global_state():
+    from jax._src import distributed
+
+    return distributed.global_state
+
+
+def init_distributed_runtime(coordinator_address: str, num_processes: int,
+                             process_id: int) -> None:
+    """Join (and on rank 0, host) the coordination service at `address`.
+
+    Equivalent to jax.distributed.initialize(address, num_processes,
+    process_id) but with survivable failure semantics (module docstring).
+    Falls back to jax.distributed.initialize on jaxlib generations without
+    the client/service constructors.
+    """
+    hb = int(_env_float("KFT_HEARTBEAT_INTERVAL_S", 10))
+    misses = int(_env_float("KFT_MAX_MISSING_HEARTBEATS", 10))
+    init_to = int(_env_float("KFT_INIT_TIMEOUT_S", 300))
+    shutdown_to = int(_env_float("KFT_SHUTDOWN_TIMEOUT_S", 15))
+
+    try:
+        from jax._src.lib import xla_extension as xe
+
+        get_client = xe.get_distributed_runtime_client
+        get_service = xe.get_distributed_runtime_service
+    except (ImportError, AttributeError):  # pragma: no cover - new jaxlib layout
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        return
+
+    state = _global_state()
+    if state.client is not None:
+        raise RuntimeError("distributed runtime already initialized")
+    port = coordinator_address.rsplit(":", 1)[1]
+    if process_id == 0:
+        state.service = get_service(
+            f"[::]:{port}", num_processes,
+            heartbeat_interval=hb, max_missing_heartbeats=misses,
+            shutdown_timeout=shutdown_to,
+        )
+
+    def _missed_heartbeat(status) -> None:
+        # never QFATL the process: a vanished coordinator means a dead rank
+        # 0, and the self-healing path (or the stall deadline) must get the
+        # chance to act on it
+        log.warning("coordination service heartbeat missed: %s", status)
+
+    state.client = get_client(
+        coordinator_address, process_id,
+        init_timeout=init_to, shutdown_timeout=shutdown_to,
+        heartbeat_interval=hb, max_missing_heartbeats=misses,
+        missed_heartbeat_callback=_missed_heartbeat,
+        shutdown_on_destruction=False, use_compression=True,
+    )
+    state.client.connect()
+    state.coordinator_address = coordinator_address
+    state.num_processes = num_processes
+    state.process_id = process_id
+    # orbax's should_save calls reached_preemption, which requires this
+    # manager in multi-process runs.  Initializing it registers XLA's own
+    # SIGTERM notifier, which silently replaces any Python-level SIGTERM
+    # handler — the elastic loop re-installs its checkpoint-and-detach
+    # handler after every re-init (elastic/trainer.py)
+    state.initialize_preemption_sync_manager()
+
+
+def teardown_distributed_runtime(graceful: bool = True) -> None:
+    """Drop the distributed runtime.
+
+    graceful=True runs the normal all-tasks shutdown barrier (planned
+    resize: every peer reaches it together).  graceful=False is the
+    suspected-dead-peer path: barrier attempts are bounded by the client's
+    shutdown timeout and failures are swallowed — the runtime references are
+    dropped regardless so a fresh `init_distributed_runtime` can follow.
+    """
+    state = _global_state()
+    if graceful:
+        jax.distributed.shutdown()  # no-op when already torn down
+        return
+    t0 = time.perf_counter()
+    try:
+        if state.client is not None:
+            state.client.shutdown()
+    except Exception as e:  # noqa: BLE001 - barrier with a dead task
+        log.warning("dirty teardown: client shutdown: %s", str(e)[:200])
+    state.client = None
+    try:
+        if state.service is not None:
+            state.service.shutdown()
+    except Exception as e:  # noqa: BLE001
+        log.warning("dirty teardown: service shutdown: %s", str(e)[:200])
+    state.service = None
+    state.preemption_sync_manager = None
+    state.coordinator_address = None
+    # back to the single-process defaults: the CPU backend factory and
+    # orbax's barrier policy consult these, and stale values make a
+    # healed-to-smaller rebuild believe it is still the old world size
+    state.process_id = 0
+    state.num_processes = 1
+    log.info("dirty distributed teardown in %.2fs", time.perf_counter() - t0)

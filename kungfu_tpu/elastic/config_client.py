@@ -4,10 +4,19 @@ Reference: workers GET/PUT the versioned Cluster JSON from the config server
 (srcs/go/kungfu/peer/peer.go:265 getClusterConfig, legacy.go:18-37
 ProposeNewSize -> HTTP PUT of the resized Cluster).  Pure stdlib HTTP — the
 control plane stays outside XLA.
+
+Every request runs under bounded retry with exponential backoff + full
+jitter, capped by a wall-clock deadline: transient config-server flaps
+(restart, chaos `flap@config_server=...` window, overloaded 5xx) are ridden
+out inside the client instead of surfacing as `OSError` at every call site.
+Semantic responses (404 no-config, 409 rejected PUT) are never retried.
+`poll_cluster` is the fire-and-forget variant the poll loops use: an outage
+that outlives the retry budget collapses to None ("no new config visible").
 """
 from __future__ import annotations
 
 import json
+import random
 import time
 import urllib.error
 import urllib.request
@@ -20,50 +29,106 @@ log = get_logger("kungfu.elastic")
 
 
 class ConfigClient:
-    def __init__(self, url: str, timeout_s: float = 5.0):
+    def __init__(self, url: str, timeout_s: float = 5.0, retries: int = 5,
+                 backoff_s: float = 0.1, backoff_max_s: float = 2.0,
+                 retry_deadline_s: float = 10.0):
         if not url:
             raise ValueError("config server URL is empty")
         self.url = url.rstrip("/")
         self.timeout_s = timeout_s
+        self.retries = retries
+        self.backoff_s = backoff_s
+        self.backoff_max_s = backoff_max_s
+        self.retry_deadline_s = retry_deadline_s
+
+    def _with_retry(self, fn, what: str):
+        """Run `fn` with bounded retry on transport errors and 5xx.
+
+        Exponential backoff with full jitter (delay uniform in (0, cap]);
+        total retrying is capped by both the attempt count and the
+        wall-clock deadline, so a dead server fails in bounded time.
+        """
+        t0 = time.monotonic()
+        cap = self.backoff_s
+        for attempt in range(self.retries + 1):
+            try:
+                return fn()
+            except urllib.error.HTTPError as e:
+                if e.code < 500:  # semantic answer (404/409/...): caller's problem
+                    raise
+                err: OSError = e
+            except (TimeoutError, OSError) as e:  # URLError, refused, reset, timeout
+                err = e
+            delay = cap * (0.5 + 0.5 * random.random())
+            if (attempt == self.retries
+                    or time.monotonic() - t0 + delay > self.retry_deadline_s):
+                raise err
+            log.debug("%s failed (%s); retry %d in %.2fs", what, err, attempt + 1, delay)
+            time.sleep(delay)
+            cap = min(cap * 2, self.backoff_max_s)
 
     def get_cluster(self) -> Optional[Tuple[Cluster, int]]:
         """GET current (cluster, version); None if cleared/404."""
-        try:
+
+        def _get():
             with urllib.request.urlopen(self.url, timeout=self.timeout_s) as r:
-                doc = json.loads(r.read().decode())
+                return json.loads(r.read().decode())
+
+        try:
+            doc = self._with_retry(_get, "config GET")
         except urllib.error.HTTPError as e:
             if e.code == 404:
                 return None
             raise
         return Cluster.from_json(doc["cluster"]), int(doc.get("version", 0))
 
+    def poll_cluster(self) -> Optional[Tuple[Cluster, int]]:
+        """get_cluster for poll loops: an outage that outlives the retry
+        budget returns None (logged) instead of raising — "no new config
+        visible; keep doing what you were doing"."""
+        try:
+            return self.get_cluster()
+        except OSError as e:
+            log.warning("config server unreachable: %s", e)
+            return None
+
     def put_cluster(self, cluster: Cluster, version: Optional[int] = None) -> bool:
         """PUT a new cluster config; server validates + bumps version.
 
-        Returns False if the server rejected it (e.g. cleared config,
+        With `version`, the PUT is conditional (optimistic concurrency): the
+        server rejects it when the stored version has moved — two runners
+        healing concurrently cannot overwrite each other's shrink.  Returns
+        False if the server rejected it (cleared config or version conflict,
         reference configserver.go:60-88).
         """
         body = json.dumps({"cluster": cluster.to_json(), "version": version}).encode()
-        req = urllib.request.Request(
-            self.url, data=body, method="PUT",
-            headers={"Content-Type": "application/json"},
-        )
-        try:
+
+        def _put():
+            req = urllib.request.Request(
+                self.url, data=body, method="PUT",
+                headers={"Content-Type": "application/json"},
+            )
             with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
                 return 200 <= r.status < 300
+
+        try:
+            return self._with_retry(_put, "config PUT")
         except urllib.error.HTTPError as e:
             log.warning("config PUT rejected: %s", e)
             return False
 
     def clear(self) -> None:
-        req = urllib.request.Request(self.url, method="DELETE")
-        with urllib.request.urlopen(req, timeout=self.timeout_s):
-            pass
+        def _delete():
+            req = urllib.request.Request(self.url, method="DELETE")
+            with urllib.request.urlopen(req, timeout=self.timeout_s):
+                pass
+
+        self._with_retry(_delete, "config DELETE")
 
     def wait_for_config(self, poll_s: float = 0.05, timeout_s: float = 120.0) -> Tuple[Cluster, int]:
         t0 = time.monotonic()
         while True:
-            got = self.get_cluster()
+            got = self.poll_cluster()
             if got is not None:
                 return got
             if time.monotonic() - t0 > timeout_s:
@@ -91,7 +156,7 @@ def propose_new_size(peer, new_size: int) -> bool:
             return False  # already proposed (or applied): no spurious bump
         resized = cluster.resize(new_size)
         ok = client.put_cluster(resized)
-    except OSError as e:  # outage: drop the proposal, retry at next boundary
+    except OSError as e:  # outage past the retry budget: drop the proposal
         log.warning("propose_new_size: config server unreachable: %s", e)
         return False
     log.info("proposed resize %d -> %d: %s", cluster.size(), new_size, "ok" if ok else "rejected")

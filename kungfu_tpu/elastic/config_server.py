@@ -8,6 +8,14 @@ shuts the server down.  Embeddable in the launcher (the reference's
 builtin-config-server) or standalone:
 
     python -m kungfu_tpu.elastic.config_server -port 9100 [-init hostfile-json]
+
+Two healing-era extensions over the reference:
+  - a PUT body carrying `"version": N` is *conditional* — rejected (409)
+    unless N matches the stored version, so concurrent healers on different
+    hosts cannot overwrite each other's shrink (optimistic concurrency;
+    `"version": null` keeps the reference's unconditional semantics);
+  - a `flap@config_server=...` fault in KFT_FAULT_PLAN makes the server
+    answer 503 for the scripted window (chaos harness outage drills).
 """
 from __future__ import annotations
 
@@ -36,7 +44,7 @@ class _State:
                 return None
             return self.cluster, self.version
 
-    def put(self, c: Cluster) -> Tuple[bool, str]:
+    def put(self, c: Cluster, expect_version: Optional[int] = None) -> Tuple[bool, str]:
         try:
             c.validate()
         except ValueError as e:
@@ -45,6 +53,10 @@ class _State:
             if self.cleared:
                 # reference rejects PUT after clear until POST re-inits
                 return False, "config was cleared"
+            if expect_version is not None and expect_version != self.version:
+                # conditional PUT lost the race: the writer must re-read the
+                # document and re-derive its change (healer CAS loop)
+                return False, f"version conflict: expected {expect_version}, at {self.version}"
             if self.cluster is not None and c.bytes() == self.cluster.bytes():
                 return True, "unchanged"
             self.cluster = c
@@ -73,10 +85,14 @@ class ConfigServer:
     """Threaded config server; use .start()/.stop() embedded, or serve_forever."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 9100,
-                 init: Optional[Cluster] = None):
+                 init: Optional[Cluster] = None, chaos=None):
+        from ..chaos import server_chaos_from_env
+
         self.state = _State(init)
         state = self.state
         stop_cb = self.stop
+        # scripted outage windows (KFT_FAULT_PLAN flap@config_server=...)
+        chaos = chaos if chaos is not None else server_chaos_from_env()
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):  # quiet
@@ -89,10 +105,18 @@ class ConfigServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _flapped(self) -> bool:
+                if chaos is not None and chaos.should_503():
+                    self._send(503, b'{"error": "chaos flap"}')
+                    return True
+                return False
+
             def do_GET(self):
                 if self.path.startswith("/stop"):
                     self._send(200, b"{}")
                     threading.Thread(target=stop_cb, daemon=True).start()
+                    return
+                if self._flapped():
                     return
                 got = state.get()
                 if got is None:
@@ -102,28 +126,36 @@ class ConfigServer:
                 body = json.dumps({"cluster": cluster.to_json(), "version": version}).encode()
                 self._send(200, body)
 
-            def _read_cluster(self) -> Optional[Cluster]:
+            def _read_cluster(self) -> Optional[Tuple[Cluster, Optional[int]]]:
                 try:
                     n = int(self.headers.get("Content-Length", "0"))
                     doc = json.loads(self.rfile.read(n).decode())
                     payload = doc.get("cluster", doc)
-                    return Cluster.from_json(payload)
+                    version = doc.get("version") if isinstance(doc, dict) else None
+                    return Cluster.from_json(payload), (
+                        int(version) if version is not None else None
+                    )
                 except Exception as e:
                     self._send(400, json.dumps({"error": str(e)}).encode())
                     return None
 
             def do_PUT(self):
-                c = self._read_cluster()
-                if c is None:
+                if self._flapped():
                     return
-                ok, msg = state.put(c)
+                got = self._read_cluster()
+                if got is None:
+                    return
+                c, expect_version = got
+                ok, msg = state.put(c, expect_version)
                 self._send(200 if ok else 409, json.dumps({"msg": msg}).encode())
 
             def do_POST(self):
-                c = self._read_cluster()
-                if c is None:
+                if self._flapped():
                     return
-                ok, msg = state.post(c)
+                got = self._read_cluster()
+                if got is None:
+                    return
+                ok, msg = state.post(got[0])
                 self._send(200 if ok else 409, json.dumps({"msg": msg}).encode())
 
             def do_DELETE(self):

@@ -23,11 +23,23 @@ Survivors keep their state; joiners enter with fresh init and receive rank
 0's state in the sync program.  Worker 0 survives any shrink (Cluster.resize
 keeps a prefix — the reference's "new root must be old worker" guard,
 peer.go:211-222, holds by construction).
+
+Self-healing (docs/fault_tolerance.md): under a `-heal` launcher the loop
+also survives *unplanned* failures.  A collective that dies because a peer
+vanished (or a consensus that times out) escalates to the suspected-dead-
+peer path: checkpoint what we have, tear the backend down WITHOUT the
+all-tasks barrier, wait for the healer's shrunk cluster document, and
+re-rendezvous at the new version's fenced port — training continues at the
+smaller size with at most one step of repeated work (the progress counters
+are pmax-synced).  SIGTERM is treated as a preemption notice: final
+checkpoint, self-removal from the cluster document, DETACHED announce,
+clean exit.  Failures are injectable via KFT_FAULT_PLAN (kungfu_tpu.chaos).
 """
 from __future__ import annotations
 
 import os
 import dataclasses
+import signal
 import sys
 import time
 from typing import Any, Callable, Dict, Iterator, Optional, Tuple
@@ -39,6 +51,10 @@ from .config_client import ConfigClient
 from .schedule import StepBasedSchedule
 
 log = get_logger("kungfu.elastic")
+
+# exit code when the suspected-dead-peer path finds no healed document in
+# time: distinct from crash codes so the healer's logs show *why* we died
+HEAL_WAIT_EXIT_CODE = 86
 
 
 @dataclasses.dataclass
@@ -55,6 +71,15 @@ class ElasticConfig:
     # even the disjoint-membership resize the reference only warns about.
     checkpoint_dir: str = ""
     checkpoint_every: int = 50
+    # how long the suspected-dead-peer path waits for the healer to publish
+    # a shrunk cluster document before giving up (exit 86, healer's move)
+    heal_timeout_s: float = 120.0
+    # heal-armed jobs keep a rolling host copy of the train state every this
+    # many steps: the step whose collective dies poisons its output buffers
+    # (their definition event is the failed allreduce), so recovery restarts
+    # from the last good snapshot — losing at most this many steps.
+    # 0 = auto (check_every).
+    snapshot_every: int = 0
 
 
 class _MeshPrograms:
@@ -74,6 +99,11 @@ class _MeshPrograms:
         from ..ops import collective as C
 
         self.trainer = trainer
+        # heal-armed jobs run every consensus/sync collective under a forced
+        # stall watchdog: its ticks refresh the launcher-facing heartbeat
+        # (blocked-on-a-hung-peer must read as alive, not as a second hang)
+        # and the hard deadline bounds a wedge inside the op itself
+        self._stall_force = bool(os.environ.get("KFT_HEAL"))
         mesh = trainer.mesh
         axes = trainer.axis_name if isinstance(trainer.axis_name, tuple) else (trainer.axis_name,)
         axis = axes if len(axes) > 1 else axes[0]
@@ -143,17 +173,18 @@ class _MeshPrograms:
         """
         t0 = time.monotonic()
         v = tuple(values)
-        while True:
-            arr = self._stack_local(np.asarray(v, np.int32))
-            out = np.asarray(self._minmax(arr).addressable_shards[0].data)
-            lo, hi = out[0, 0], out[0, 1]
-            if (lo == hi).all():
-                return tuple(int(x) for x in lo)
-            if time.monotonic() - t0 > timeout_s:
-                raise TimeoutError(f"no consensus: min={lo} max={hi}")
-            time.sleep(0.05)
-            if refresh is not None:
-                v = tuple(refresh())
+        with stall_detector("elastic_consensus", force=self._stall_force):
+            while True:
+                arr = self._stack_local(np.asarray(v, np.int32))
+                out = np.asarray(self._minmax(arr).addressable_shards[0].data)
+                lo, hi = out[0, 0], out[0, 1]
+                if (lo == hi).all():
+                    return tuple(int(x) for x in lo)
+                if time.monotonic() - t0 > timeout_s:
+                    raise TimeoutError(f"no consensus: min={lo} max={hi}")
+                time.sleep(0.05)
+                if refresh is not None:
+                    v = tuple(refresh())
 
     def agree_int(self, value: int, timeout_s: float = 60.0,
                   refresh: Optional[Callable[[], int]] = None) -> int:
@@ -174,9 +205,10 @@ class _MeshPrograms:
         if os.environ.get("KFT_DEBUG_SYNC"):
             sig = [(str(l.dtype), tuple(l.shape)) for l in jax.tree.leaves(stacked)]
             log.info("sync_state sig: off=%s %s tree=%s", off.dtype, off.shape, sig)
-        off_out, tree_out = self._sync(off, stacked)
-        # rows are identical post-pmax; read this process's local shard
-        row = np.asarray(off_out.addressable_shards[0].data).reshape(-1)
+        with stall_detector("elastic_state_sync", force=self._stall_force):
+            off_out, tree_out = self._sync(off, stacked)
+            # rows are identical post-pmax; read this process's local shard
+            row = np.asarray(off_out.addressable_shards[0].data).reshape(-1)
         counters_new = tuple(int(x) for x in row)
         if self.trainer.per_replica:
             return counters_new, tree_out
@@ -216,18 +248,34 @@ def _maybe_enable_compile_cache() -> None:
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 
-def _teardown_backend() -> None:
+def _teardown_backend(graceful: bool = True) -> None:
+    """Tear down jax.distributed + the XLA backend for a rebuild.
+
+    graceful=False is the suspected-dead-peer path: the all-tasks shutdown
+    barrier would block on (and then be killed by) the very peer whose death
+    we are recovering from, so the runtime references are dropped with
+    bounded, error-swallowing shutdowns instead (kungfu_tpu.distributed).
+    """
     import jax
     import jax._src.xla_bridge as xb
 
+    from ..distributed import teardown_distributed_runtime
+
     t0 = time.perf_counter()
     try:
-        jax.distributed.shutdown()
+        teardown_distributed_runtime(graceful=graceful)
     except Exception as e:  # pragma: no cover
         log.warning("distributed shutdown: %s", e)
     t1 = time.perf_counter()
     jax.clear_caches()
     xb._clear_backends()
+    # _clear_backends misses the lru-cached topology queries: a stale
+    # process_count makes the rebuilt (smaller) world look like the old one
+    # — orbax then demands a distributed client that a healed-to-one
+    # process no longer has, and _stack_local miscounts contributors
+    for fn in (jax.process_count, jax.local_devices):
+        if hasattr(fn, "cache_clear"):
+            fn.cache_clear()
     t2 = time.perf_counter()
     from ..checkpoint import reset_orbax_runtime_caches
 
@@ -235,6 +283,41 @@ def _teardown_backend() -> None:
     if os.environ.get("KFT_DEBUG_TEARDOWN"):
         log.info("teardown: shutdown=%.3fs clear=%.3fs orbax=%.3fs",
                  t1 - t0, t2 - t1, time.perf_counter() - t2)
+
+
+def _suspected_peer_failure(e: BaseException) -> bool:
+    """Does this exception look like a peer/runtime death rather than a bug?
+
+    Gloo surfaces dead peers as ValueError("... Gloo allreduce failed ...
+    Connection closed by peer"), the coordination service as RuntimeError/
+    XlaRuntimeError with UNAVAILABLE/heartbeat text, and a consensus that
+    never converges (a peer died holding a stale document) as TimeoutError.
+    """
+    if isinstance(e, TimeoutError):
+        return True
+    if isinstance(e, OSError):
+        return True
+    text = f"{type(e).__name__}: {e}"
+    markers = (
+        "Gloo", "gloo", "Connection", "connection closed", "closed by peer",
+        "UNAVAILABLE", "DEADLINE_EXCEEDED", "heartbeat", "Heartbeat",
+        "coordination", "Coordination", "Socket", "socket", "distributed_runtime",
+        "preempted",
+    )
+    return isinstance(e, (RuntimeError, ValueError)) and any(m in text for m in markers)
+
+
+def _touch(path: str) -> None:
+    try:
+        os.utime(path, None)
+    except FileNotFoundError:
+        try:
+            with open(path, "w"):
+                pass
+        except OSError:  # pragma: no cover - unwritable heartbeat dir
+            pass
+    except OSError:  # pragma: no cover
+        pass
 
 
 def run_elastic(
@@ -260,6 +343,8 @@ def run_elastic(
     Returns final metrics dict (on workers that survive to the end).
     """
     import kungfu_tpu
+    from ..chaos import injector_from_env
+    from ..monitor.counters import global_counters
     from ..train import DataParallelTrainer, TrainState
 
     _maybe_enable_compile_cache()
@@ -281,6 +366,39 @@ def run_elastic(
     # 0's propose and the resize starting.  Rank 0 stamps each propose;
     # the matching resize event carries propose_to_done_s.
     _last_propose: Dict[str, Any] = {}
+
+    # -- self-healing state ----------------------------------------------------------
+    # armed by the -heal launcher (job.py sets KFT_HEAL in the worker env):
+    # without a healer publishing shrunk documents, waiting for one would
+    # only delay the crash the supervisor needs to see.
+    heal_armed = bool(os.environ.get("KFT_HEAL")) and client is not None
+    heal_events: list = []
+    _pending_heal: Optional[Dict[str, Any]] = None
+    chaos = injector_from_env()
+    # faults key on the LAUNCH rank: current ranks shift when the cluster
+    # heals/resizes, and a drill's scripted victim must stay the same
+    # process for the replay to be deterministic
+    chaos_rank = peer.rank
+    hb_file = os.environ.get("KFT_HEARTBEAT_FILE", "")
+    # SIGTERM = preemption notice (TPU maintenance, spot reclaim, planned
+    # kill): finish the current step, then checkpoint + detach cleanly.
+    # One-shot flag keeps the handler async-signal-trivial.
+    _preempted = {"flag": False}
+
+    def _on_sigterm(signum, frame):  # noqa: ARG001
+        _preempted["flag"] = True
+        log.warning("SIGTERM received: will checkpoint and detach at the step boundary")
+
+    def _install_sigterm():
+        """(Re-)take the SIGTERM handler.  Must run after EVERY distributed
+        re-init: XLA's preemption notifier registers its own handler there,
+        silently replacing this one."""
+        try:
+            return signal.signal(signal.SIGTERM, _on_sigterm)
+        except ValueError:  # pragma: no cover - not the main thread (tests)
+            return None
+
+    _prev_sigterm = _install_sigterm()
 
     import inspect
 
@@ -387,7 +505,176 @@ def run_elastic(
 
     t_start = time.time()
     metrics: Dict[str, Any] = {"loss": np.float32(np.nan)}
-    while offset < cfg.total_samples:
+
+    # last-known-good host state for the recovery path: the step whose
+    # collective died poisons its output buffers AND donated its inputs, so
+    # a live snapshot at failure time can be impossible — heal-armed jobs
+    # refresh this rolling copy every snapshot_every steps instead
+    _snapshot_every = cfg.snapshot_every or max(1, cfg.check_every)
+    _last_good: Dict[str, Any] = {}
+
+    def _update_last_good() -> None:
+        if not heal_armed:
+            return
+        sp_g, so_g = snap(state)
+        _last_good.update(step=step, offset=offset, params=sp_g, opt=so_g)
+
+    _update_last_good()  # seed: recovery must never find it empty
+
+    def save_ckpt(force: bool = False) -> None:
+        if ckpt is None or not ckpt.writes:
+            return
+        sp_c, so_c = snap(state)
+        ckpt.save(step, {"params": sp_c, "opt": so_c},
+                  meta={"trained_samples": offset, "step": step,
+                        "cluster_size": peer.size}, force=force)
+
+    def _detach_preempted() -> None:
+        """SIGTERM path: durable checkpoint, self-removal from the cluster
+        document (so survivors/healer see a *planned* detach, not a death),
+        DETACHED announce, clean exit."""
+        log.warning("preemption: final checkpoint + detach at step %d", step)
+        if ckpt is not None:
+            try:
+                save_ckpt(force=True)
+                ckpt.wait()
+                ckpt.close()
+            except Exception as e:  # noqa: BLE001 - exit path must not throw
+                log.warning("preemption checkpoint failed: %s", e)
+        if client is not None:
+            from ..plan import Cluster as _Cluster, PeerList as _PeerList
+
+            try:
+                got = client.get_cluster()
+                if got is not None and got[0].workers.rank(peer.self_id) is not None:
+                    cl, v = got
+                    rest = _PeerList(p for p in cl.workers if p != peer.self_id)
+                    client.put_cluster(
+                        _Cluster(runners=cl.runners, workers=rest), version=v
+                    )
+            except OSError as e:
+                log.warning("preemption self-removal failed: %s", e)
+        global_counters().inc_event("preemptions")
+        print(f"DETACHED: preempted at step {step} ({offset} samples trained)",
+              flush=True)
+        sys.exit(0)
+
+    def _recover(cause: BaseException) -> None:
+        """Suspected-dead-peer path: checkpoint -> dirty teardown -> wait for
+        the healer's shrunk document -> re-rendezvous -> re-sync state."""
+        nonlocal trainer, programs, state, data, offset, step, skip_check_at
+        nonlocal _pending_heal, metrics
+        import gc
+
+        t_detect = time.perf_counter()
+        old_size = peer.size
+        log.warning("suspected peer failure (%s: %s); entering recovery",
+                    type(cause).__name__, str(cause)[:200])
+        try:
+            # the live state is usually poisoned (its buffers' definition
+            # event is the failed collective, and the step donated its
+            # inputs) — but a consensus-side failure leaves it intact
+            snap_params, snap_opt = snap(state)
+        except Exception:  # noqa: BLE001 - poisoned buffers
+            log.warning(
+                "live state unreadable after the failure; rolling back to the "
+                "step-%d snapshot (%d samples)", _last_good["step"], _last_good["offset"],
+            )
+            snap_params, snap_opt = _last_good["params"], _last_good["opt"]
+            step, offset = _last_good["step"], _last_good["offset"]
+        if ckpt is not None:
+            try:
+                # best-effort durable point for the chosen snapshot:
+                # primary-only, single-member barriers — safe to run with
+                # dead peers in the cluster
+                if ckpt.writes:
+                    ckpt.save(step, {"params": snap_params, "opt": snap_opt},
+                              meta={"trained_samples": offset, "step": step,
+                                    "cluster_size": peer.size}, force=True)
+                ckpt.release()
+            except Exception as e:  # noqa: BLE001
+                log.warning("recovery checkpoint failed: %s", e)
+        # drop every reference into the wounded backend BEFORE teardown:
+        # live arrays keep the old XLA client (and its gloo sockets) alive
+        # past _clear_backends, and a still-open socket means the peers
+        # blocked opposite us never see a connection reset — they hang in
+        # their collective instead of entering their own recovery
+        state = data = trainer = programs = None
+        metrics = {"loss": np.float32(np.nan)}
+        gc.collect()
+        _teardown_backend(graceful=False)
+        while True:
+            deadline = time.monotonic() + cfg.heal_timeout_s
+            got = None
+            while time.monotonic() < deadline:
+                if _preempted["flag"]:
+                    _detach_preempted()
+                if hb_file:
+                    _touch(hb_file)  # waiting on the healer is liveness too
+                g = client.poll_cluster()
+                if g is not None and g[1] > peer.cluster_version:
+                    got = g
+                    break
+                time.sleep(0.25)
+            if got is None:
+                log.critical("no healed cluster document within %.0fs; exiting so "
+                             "the supervisor can act", cfg.heal_timeout_s)
+                sys.exit(HEAL_WAIT_EXIT_CODE)
+            cluster, version = got
+            try:
+                if not peer.update_cluster(cluster, version):
+                    # the healer decided WE were the dead one (e.g. a hang
+                    # that un-wedged after the heartbeat timeout): bow out
+                    print(f"DETACHED: rank left cluster at version {version}",
+                          flush=True)
+                    sys.exit(0)
+                _install_sigterm()
+                trainer, programs = build()
+                if ckpt is not None:
+                    ckpt.set_primary(peer.rank == 0)
+                (offset, step), synced = programs.sync_state(
+                    (offset, step), {"params": snap_params, "opt": snap_opt}
+                )
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:  # noqa: BLE001 - vetted below
+                if not _suspected_peer_failure(e):
+                    raise
+                # another peer died between the healer's PUT and our
+                # rendezvous/sync (update_cluster already advanced
+                # peer.cluster_version, so the wait above only accepts a
+                # strictly newer document)
+                log.warning(
+                    "recovery attempt at v%d failed (%s: %s); waiting for a "
+                    "newer cluster document", version, type(e).__name__,
+                    str(e)[:200],
+                )
+                trainer = programs = None
+                gc.collect()
+                _teardown_backend(graceful=False)
+                continue
+            break
+        state = TrainState(synced["params"], synced["opt"], step)
+        data = make_data(peer.rank, peer.size, offset)
+        skip_check_at = step
+        _pending_heal = {
+            "version": version, "old_size": old_size, "new_size": peer.size,
+            "reason": type(cause).__name__, "t_detect": t_detect,
+        }
+        log.info("recovered onto %d-worker cluster at v%d; resuming at step %d",
+                 peer.size, version, step)
+
+    def step_once() -> None:
+        nonlocal trainer, programs, state, data, offset, step, skip_check_at
+        nonlocal resizes, metrics, _first_step_after_resize, _last_propose, _pending_heal
+
+        if _preempted["flag"]:
+            _detach_preempted()
+        if hb_file:
+            _touch(hb_file)  # liveness signal for the healer's hang detection
+        if chaos is not None:
+            chaos.on_step(step, chaos_rank)
+
         # -- schedule-driven proposal (rank 0, reference hooks/elastic.py:14-88)
         if client is not None and schedule and peer.rank == 0:
             want = schedule.size_at(step)
@@ -406,10 +693,7 @@ def run_elastic(
                 reference's consensus-on-cluster-bytes semantics: all workers
                 are guaranteed to hold the *same document*, not just the same
                 version number, before anyone acts."""
-                try:
-                    got = client.get_cluster()
-                except OSError:  # config-server outage/restart mid-poll:
-                    got = None   # no new config visible; keep training
+                got = client.poll_cluster()  # outage -> None: keep training
                 if got is None:
                     return peer.cluster_version, 0
                 last_got["cluster"], last_got["version"] = got
@@ -458,6 +742,7 @@ def run_elastic(
                     _phase("teardown")
                     if not peer.update_cluster(cluster, version):
                         sys.exit(0)
+                    _install_sigterm()
                     _phase("reinit")
                     trainer, programs = build()
                     _phase("rebuild")
@@ -479,40 +764,62 @@ def run_elastic(
                     log.warning("agreed version %d but no matching doc cached", version)
 
         batch = trainer.shard_batch(next(data))
-        if _first_step_after_resize:
+        if _first_step_after_resize or _pending_heal is not None:
             import jax
 
             t_fs = time.perf_counter()
-            state, metrics = trainer.train_step(state, batch)
-            jax.block_until_ready(metrics)  # force the recompile into the timing
-            ev = resize_events[-1]
-            ev["phases"]["first_step"] = round(time.perf_counter() - t_fs, 4)
-            ev["total_s"] = round(sum(ev["phases"].values()), 4)
-            if "propose_to_start_s" in ev:
-                # the full watch-mode story: schedule propose -> config
-                # server -> poll -> consensus -> resize -> first new step
-                ev["propose_to_done_s"] = round(
-                    ev["propose_to_start_s"] + ev["total_s"], 4
-                )
-            _first_step_after_resize = False
+            with stall_detector("elastic_train_step", force=heal_armed):
+                state, metrics = trainer.train_step(state, batch)
+                jax.block_until_ready(metrics)  # force the recompile into the timing
+            if _first_step_after_resize:
+                ev = resize_events[-1]
+                ev["phases"]["first_step"] = round(time.perf_counter() - t_fs, 4)
+                ev["total_s"] = round(sum(ev["phases"].values()), 4)
+                if "propose_to_start_s" in ev:
+                    # the full watch-mode story: schedule propose -> config
+                    # server -> poll -> consensus -> resize -> first new step
+                    ev["propose_to_done_s"] = round(
+                        ev["propose_to_start_s"] + ev["total_s"], 4
+                    )
+                _first_step_after_resize = False
+            if _pending_heal is not None:
+                # MTTR: failure detection -> first completed post-heal step
+                hev = dict(_pending_heal)
+                hev["mttr_s"] = round(time.perf_counter() - hev.pop("t_detect"), 4)
+                heal_events.append(hev)
+                global_counters().inc_event("heals")
+                global_counters().set_gauge("heal_mttr_s", hev["mttr_s"])
+                log.info("healed %d -> %d workers: mttr %.2fs",
+                         hev["old_size"], hev["new_size"], hev["mttr_s"])
+                _pending_heal = None
         else:
-            state, metrics = trainer.train_step(state, batch)
+            with stall_detector("elastic_train_step", force=heal_armed):
+                state, metrics = trainer.train_step(state, batch)
         offset += cfg.batch_size * trainer.world
         step += 1
 
+        if heal_armed and step % _snapshot_every == 0:
+            _update_last_good()
         if ckpt is not None and ckpt.writes and step % max(1, cfg.checkpoint_every) == 0:
-            sp_c, so_c = snap(state)
-            ckpt.save(step, {"params": sp_c, "opt": so_c},
-                      meta={"trained_samples": offset, "step": step,
-                            "cluster_size": peer.size})
+            save_ckpt()
+
+    while offset < cfg.total_samples:
+        try:
+            step_once()
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:  # noqa: BLE001 - vetted below
+            if not (heal_armed and _suspected_peer_failure(e)):
+                raise
+            _recover(e)
+
+    if _prev_sigterm is not None:
+        signal.signal(signal.SIGTERM, _prev_sigterm)
 
     if ckpt is not None:
         ckpt.wait()  # settle queued async saves; latest_step lists only finalized
         if ckpt.writes and ckpt.latest_step() != step:  # avoid double-save when the loop just did
-            sp_c, so_c = snap(state)
-            ckpt.save(step, {"params": sp_c, "opt": so_c},
-                      meta={"trained_samples": offset, "step": step,
-                            "cluster_size": peer.size}, force=True)
+            save_ckpt(force=True)
         ckpt.close()
 
     loss = float(np.asarray(metrics["loss"]))
@@ -538,6 +845,9 @@ def run_elastic(
         "resize_events": resize_events,
         "resize_p50_s": _pct(0.50),
         "resize_p95_s": _pct(0.95),
+        "heals": len(heal_events),
+        "heal_events": heal_events,
+        "mttr_s": heal_events[-1]["mttr_s"] if heal_events else None,
         "state": state,
         "trainer": trainer,
     }

@@ -70,6 +70,10 @@ class Counters:
         self._logical: Dict[str, RateWindow] = {}
         self._wire: Dict[str, RateWindow] = {}
         self._quant_err: Dict[str, float] = {}
+        # self-healing accounting: named lifecycle events (worker_failures,
+        # heals, worker_restarts, preemptions) + gauges (heal_mttr_s)
+        self._events: Dict[str, int] = {}
+        self._gauges: Dict[str, float] = {}
 
     def _get(self, table: Dict[str, RateWindow], key: str) -> RateWindow:
         w = table.get(key)
@@ -119,6 +123,24 @@ class Counters:
         with self._lock:
             return dict(self._quant_err)
 
+    def inc_event(self, key: str, n: int = 1) -> None:
+        """Count one lifecycle event (worker failure, heal, restart, ...)."""
+        with self._lock:
+            self._events[key] = self._events.get(key, 0) + n
+
+    def set_gauge(self, key: str, value: float) -> None:
+        """Record the last observed value of a named gauge (e.g. heal MTTR)."""
+        with self._lock:
+            self._gauges[key] = float(value)
+
+    def events(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._events)
+
+    def gauges(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._gauges)
+
     def egress_rates(self) -> Dict[str, float]:
         with self._lock:
             return {k: w.rate() for k, w in self._egress.items()}
@@ -161,6 +183,15 @@ class Counters:
             lines.append(f"# TYPE {metric} {kind}")
             for key in sorted(table):
                 lines.append(f'{metric}{{op="{key}"}} {table[key]}')
+        ev, ga = self.events(), self.gauges()
+        if ev:
+            lines.append("# TYPE kungfu_events_total counter")
+            for key in sorted(ev):
+                lines.append(f'kungfu_events_total{{event="{key}"}} {ev[key]}')
+        if ga:
+            lines.append("# TYPE kungfu_gauge gauge")
+            for key in sorted(ga):
+                lines.append(f'kungfu_gauge{{name="{key}"}} {ga[key]}')
         return "\n".join(lines) + "\n"
 
 

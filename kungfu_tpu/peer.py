@@ -112,6 +112,12 @@ class Peer:
             jax.config.update("jax_platforms", plat)
         if self.size > 1 and not self.config.single_machine:
             self._init_distributed()
+        else:
+            # a cluster that healed down to one process must flip gloo CPU
+            # collectives back off before the backend is rebuilt
+            from .distributed import ensure_cpu_collectives
+
+            ensure_cpu_collectives(multiprocess=False)
         self._session = self._build_session()
         if self.size > 1:
             # eager store start: a faster peer must find our server listening
@@ -152,10 +158,16 @@ class Peer:
 
         One JAX process per worker; the coordinator is worker rank 0.  The
         port encodes the cluster version (fencing, see module docstring).
+        The runtime is built by kungfu_tpu.distributed so survivors of an
+        unplanned peer death can tear it down without the all-tasks barrier
+        (and multi-process CPU clusters get gloo collectives).
         """
+        from .distributed import ensure_cpu_collectives, init_distributed_runtime
+
+        ensure_cpu_collectives()
         addr = self._coordinator_address()
         with stall_detector(f"jax.distributed.initialize({addr})", force=True):
-            jax.distributed.initialize(
+            init_distributed_runtime(
                 coordinator_address=addr,
                 num_processes=self.size,
                 process_id=self.rank,

@@ -48,6 +48,20 @@ def main(argv=None):
     ap.add_argument("-strategy", default="AUTO", help="allreduce strategy")
     ap.add_argument("-w", dest="watch", action="store_true", help="watch (elastic) mode")
     ap.add_argument("-k", dest="keep", action="store_true", help="keep job on worker failure")
+    ap.add_argument(
+        "-heal", dest="heal", action="store_true",
+        help="self-heal in watch mode: shrink the cluster around dead workers "
+             "instead of stopping the job (implies -w)",
+    )
+    ap.add_argument(
+        "-restart-budget", dest="restart_budget", type=int, default=0,
+        help="automatic restarts per worker after a heal (exponential backoff)",
+    )
+    ap.add_argument(
+        "-heartbeat-timeout", dest="heartbeat_timeout", type=float, default=0.0,
+        help="seconds without worker heartbeat before the healer kills it "
+             "(0 = disabled; catches hung-not-crashed workers)",
+    )
     ap.add_argument("-config-server", dest="config_server", default="")
     ap.add_argument(
         "-builtin-config-server", dest="builtin_cs", action="store_true",
@@ -75,6 +89,9 @@ def main(argv=None):
     if prog and prog[0] == "--":
         prog = prog[1:]
 
+    if args.heal:
+        args.watch = True  # healing is a watch-mode capability
+
     hosts = HostList.parse(args.hosts) if args.hosts else HostList.parse(f"127.0.0.1:{args.np}")
     cluster = Cluster.from_hostlist(hosts, args.np)
     self_host = args.self_host or infer_self_ip(hosts)
@@ -85,6 +102,12 @@ def main(argv=None):
         cs = ConfigServer(port=args.port, init=cluster).start()
         config_url = cs.url
 
+    heartbeat_dir = ""
+    if args.heal and args.heartbeat_timeout > 0:
+        import tempfile
+
+        heartbeat_dir = tempfile.mkdtemp(prefix="kft-hb-")
+
     job = Job(
         prog=prog[0],
         args=prog[1:],
@@ -93,6 +116,8 @@ def main(argv=None):
         platform=args.platform,
         devices_per_worker=args.devices_per_worker,
         chips_per_host=args.chips_per_host,
+        heal=args.heal,
+        heartbeat_dir=heartbeat_dir,
     )
 
     from .launcher import install_signal_trap
@@ -102,9 +127,16 @@ def main(argv=None):
         if args.watch:
             client = ConfigClient(config_url)
             runner = WatchRunner(
-                job, self_host, client, logdir=args.logdir, quiet=args.quiet, keep=args.keep
+                job, self_host, client, logdir=args.logdir, quiet=args.quiet,
+                keep=args.keep, heal=args.heal, restart_budget=args.restart_budget,
+                heartbeat_timeout_s=args.heartbeat_timeout,
             )
             rc = runner.run(initial=cluster, timeout_s=args.timeout)
+            if runner.heal_events:
+                import json as _json
+
+                print("RUNNER_HEAL_EVENTS: " + _json.dumps(runner.heal_events),
+                      flush=True)
         else:
             rc = simple_run(
                 job, cluster, self_host, logdir=args.logdir, quiet=args.quiet, keep=args.keep

@@ -49,6 +49,8 @@ class Job:
     platform: str = ""  # "" = inherit; "cpu" forces CPU backend in workers
     devices_per_worker: int = 1
     chips_per_host: int = 0  # 0 = don't manage chip visibility
+    heal: bool = False  # arm the workers' suspected-dead-peer recovery path
+    heartbeat_dir: str = ""  # workers touch a per-peer file every step
 
     def new_proc(self, peer: PeerID, chip: int, cluster: Cluster, version: int,
                  parent: Optional[PeerID] = None) -> Proc:
@@ -63,6 +65,21 @@ class Job:
                 config_server=self.config_server,
             )
         )
+        if self.heal:
+            env["KFT_HEAL"] = "1"
+            # recovery re-rendezvous must fail fast enough for the retry
+            # loop to chase newer cluster documents (default init timeout is
+            # 300s — longer than most heal budgets); user env wins
+            env.setdefault("KFT_INIT_TIMEOUT_S", "45")
+        if self.heartbeat_dir:
+            # keyed on peer identity, not rank: ranks shift across resizes
+            env["KFT_HEARTBEAT_FILE"] = os.path.join(
+                self.heartbeat_dir, f"hb-{peer.host}-{peer.port}"
+            )
+            # a wedge INSIDE a monitored op keeps the heartbeat fresh (the
+            # stall watchdog touches it), so hang detection needs the hard
+            # deadline armed as its complement; user env wins
+            env.setdefault("KFT_STALL_DEADLINE_S", "120")
         if self.platform:
             env["KFT_PLATFORM"] = self.platform
             if self.platform == "cpu":

@@ -10,6 +10,7 @@ is the deliberate HTTP-only re-design — workers PUT, runners GET).
 from __future__ import annotations
 
 import os
+import random
 import signal
 import subprocess
 import sys
@@ -18,7 +19,8 @@ import time
 from typing import Dict, List, Optional
 
 from ..elastic.config_client import ConfigClient
-from ..plan import Cluster, PeerID
+from ..monitor.counters import global_counters
+from ..plan import Cluster, PeerID, PeerList
 from ..utils import get_logger
 from .job import ChipPool, Job, Proc
 
@@ -151,11 +153,23 @@ def simple_run(job: Job, cluster: Cluster, self_host: str, version: int = 0,
 
 class WatchRunner:
     """Watch mode (runner/watch.go:42-135): reconcile local procs against the
-    config service's cluster document as its version advances."""
+    config service's cluster document as its version advances.
+
+    With heal=True the runner is a *self-healing supervisor*: an unplanned
+    local worker death (non-zero exit, or a heartbeat gone stale past
+    `heartbeat_timeout_s`) no longer stops the job — the dead peer is
+    removed from the cluster document (conditional PUT, prefix-preserving so
+    the surviving head keeps rank 0) and the survivors pick the shrunk
+    cluster up through the normal run_elastic resize path.  Each worker
+    additionally gets `restart_budget` automatic restarts: after an
+    exponentially backed-off delay the healer re-grows the document with the
+    peer, and the ordinary watch reconcile re-spawns it as a joiner.
+    """
 
     def __init__(self, job: Job, self_host: str, client: ConfigClient,
                  logdir: str = "", quiet: bool = False, keep: bool = False,
-                 poll_s: float = 0.5):
+                 poll_s: float = 0.5, heal: bool = False, restart_budget: int = 0,
+                 heartbeat_timeout_s: float = 0.0, restart_backoff_s: float = 2.0):
         self.job = job
         self.self_host = self_host
         self.client = client
@@ -163,18 +177,36 @@ class WatchRunner:
         self.quiet = quiet
         self.keep = keep
         self.poll_s = poll_s
+        self.heal = heal
+        self.restart_budget = restart_budget
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        self.restart_backoff_s = restart_backoff_s
         self.current: Dict[PeerID, ProcRunner] = {}
         self.pool: Optional[ChipPool] = (
             ChipPool(job.chips_per_host) if job.chips_per_host else None
         )
         self.version = -1
+        self.heal_events: List[dict] = []
         self._chip_of: Dict[PeerID, int] = {}
         self._last_want = -1  # local workers wanted at last reconcile
-        self._idle_misses = 0
+        self._last_cluster_size = -1
+        self._idle_since: Optional[float] = None
+        self._restarts: Dict[PeerID, int] = {}  # restarts consumed per peer
+        self._regrow_at: Dict[PeerID, float] = {}  # scheduled re-grow times
+        self._last_rc = 0
+        self._healed_to_zero = False
+        self._hb_amnesty_until = 0.0  # no staleness kills before this time
 
     def _spawn(self, peer: PeerID, cluster: Cluster, version: int) -> None:
         chip = self.pool.get() if self.pool else -1
         proc = self.job.new_proc(peer, chip if chip is not None else -1, cluster, version)
+        hb = proc.env.get("KFT_HEARTBEAT_FILE")
+        if hb:
+            # pre-touch: a worker that wedges before its first step still
+            # gets the full heartbeat timeout measured from spawn
+            os.makedirs(os.path.dirname(hb), exist_ok=True)
+            with open(hb, "w"):
+                pass
         r = ProcRunner(proc, logdir=self.logdir, quiet=self.quiet)
         r.start()
         self.current[peer] = r
@@ -199,6 +231,129 @@ class WatchRunner:
             self._spawn(peer, cluster, version)
         self.version = version
         self._last_want = len(want)
+        self._last_cluster_size = cluster.size()
+        if cluster.size() > 0:
+            self._healed_to_zero = False  # an operator/regrow PUT revived the job
+
+    def _stalest_worker(self):
+        """(age, peer, runner) for the most-stale running worker past the
+        heartbeat timeout, or None.
+
+        A hung rank wedges its peers too (they block in the collective
+        waiting for it), but THEIR stall watchdogs keep their heartbeat
+        files fresh — only the truly wedged worker (no monitored op running,
+        chaos `hang@...`) goes stale.  The healer still kills only ONE
+        worker per sweep, stalest first, and then grants an amnesty window:
+        killing the hung rank frees the others into recovery, and they must
+        get a full timeout to rendezvous before staleness is re-judged.
+        """
+        if not (self.heal and self.heartbeat_timeout_s > 0):
+            return None
+        if time.monotonic() < self._hb_amnesty_until:
+            return None
+        worst = None
+        for peer, r in self.current.items():
+            if r.popen is None or r.popen.poll() is not None:
+                continue  # finished procs are the exit-code path's business
+            hb = r.proc.env.get("KFT_HEARTBEAT_FILE")
+            if not hb:
+                continue
+            try:
+                age = time.time() - os.path.getmtime(hb)
+            except OSError:
+                continue  # pre-touched at spawn; missing means already healed
+            if age > self.heartbeat_timeout_s and (worst is None or age > worst[0]):
+                worst = (age, peer, r)
+        return worst
+
+    def _heal_dead(self, peer: PeerID, rc: int) -> None:
+        """Remove a dead local worker from the cluster document (shrink to
+        survive), then schedule a budgeted restart.
+
+        The removal keeps the survivors' relative order (a pure deletion),
+        so the surviving head stays rank 0 — the reference's "new root must
+        be an old worker" guard (peer.go:211-222) holds by construction.
+        Conditional PUTs make concurrent heals from other hosts safe: a
+        version conflict re-reads the document and re-derives the shrink.
+        """
+        counters = global_counters()
+        counters.inc_event("worker_failures")
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            got = self.client.poll_cluster()
+            if got is None:
+                time.sleep(self.poll_s)
+                continue
+            cluster, version = got
+            if cluster.workers.rank(peer) is None:
+                # planned detach (preemption self-removal or an operator
+                # shrink) that raced our exit collection: nothing to heal
+                log.info("worker %s already absent from v%d; no heal needed", peer, version)
+                return
+            shrunk = Cluster(
+                runners=cluster.runners,
+                workers=PeerList(p for p in cluster.workers if p != peer),
+            )
+            if not self.client.put_cluster(shrunk, version=version):
+                continue  # lost the CAS race or flap: re-read and retry
+            log.warning(
+                "HEAL: worker %s died (rc=%d); cluster %d -> %d workers (v%d -> v%d)",
+                peer, rc, cluster.size(), shrunk.size(), version, version + 1,
+            )
+            self.heal_events.append({
+                "peer": str(peer), "rc": rc,
+                "old_size": cluster.size(), "new_size": shrunk.size(),
+                "version": version + 1,
+            })
+            counters.inc_event("heals")
+            self._healed_to_zero = shrunk.size() == 0
+            self._schedule_restart(peer)
+            return
+        log.error("heal of %s gave up: config server unreachable for 30s", peer)
+
+    def _schedule_restart(self, peer: PeerID) -> None:
+        used = self._restarts.get(peer, 0)
+        if used >= self.restart_budget:
+            if self.restart_budget:
+                log.warning("restart budget exhausted for %s (%d used)", peer, used)
+            return
+        self._restarts[peer] = used + 1
+        # exponential backoff + jitter: transient crashes (OOM burst, flaky
+        # host) get a quick retry, crash-loops back off and burn the budget
+        delay = min(self.restart_backoff_s * (2 ** used), 60.0)
+        delay *= 0.8 + 0.4 * random.random()
+        self._regrow_at[peer] = time.monotonic() + delay
+        log.info("restart %d/%d of %s scheduled in %.1fs",
+                 used + 1, self.restart_budget, peer, delay)
+
+    def _process_regrows(self) -> None:
+        now = time.monotonic()
+        for peer, due in list(self._regrow_at.items()):
+            if now < due:
+                continue
+            got = self.client.poll_cluster()
+            if got is None:
+                return  # outage: retry on a later tick
+            cluster, version = got
+            if cluster.workers.rank(peer) is not None:
+                del self._regrow_at[peer]  # someone already re-added it
+                continue
+            regrown = Cluster(
+                runners=cluster.runners,
+                workers=PeerList(tuple(cluster.workers) + (peer,)),
+            )
+            try:
+                regrown.validate()
+            except ValueError as e:  # host no longer in the runner set
+                log.warning("cannot restart %s: %s", peer, e)
+                del self._regrow_at[peer]
+                continue
+            if self.client.put_cluster(regrown, version=version):
+                del self._regrow_at[peer]
+                global_counters().inc_event("worker_restarts")
+                log.info("RESTART: re-grew %s into the cluster (%d workers at v%d)",
+                         peer, regrown.size(), version + 1)
+            # CAS conflict: leave it scheduled; next tick re-reads
 
     def run(self, initial: Optional[Cluster] = None, timeout_s: float = 0.0) -> int:
         t0 = time.monotonic()
@@ -208,29 +363,57 @@ class WatchRunner:
             if initial is not None:
                 self.reconcile(initial, 0)
             while True:
-                try:
-                    got = self.client.get_cluster()
-                except OSError as e:  # transient config-server outage
-                    log.warning("config server unreachable: %s", e)
-                    got = None
+                got = self.client.poll_cluster()
                 if got is not None:
                     cluster, version = got
                     if version > self.version:
                         self.reconcile(cluster, version)
+                if self.heal and self._regrow_at:
+                    self._process_regrows()
+                # hang detection: kill (at most) the stalest wedged worker so
+                # its exit joins the ordinary dead-proc collection below
+                stale = self._stalest_worker()
+                if stale is not None:
+                    age, speer, r = stale
+                    log.error(
+                        "worker %s heartbeat stale %.1fs > %.1fs; killing it",
+                        speer, age, self.heartbeat_timeout_s,
+                    )
+                    r.terminate(grace_s=0.5)
+                    self._hb_amnesty_until = (
+                        time.monotonic() + self.heartbeat_timeout_s
+                    )
                 # collect finished procs
                 for peer, r in list(self.current.items()):
                     rc = r.popen.poll() if r.popen else None
-                    if rc is not None:
-                        r.wait()  # joins the output pump: don't lose tail lines
-                        del self.current[peer]
-                        if self.pool:
-                            self.pool.put(self._chip_of.pop(peer, -1))
-                        if rc != 0 and not self.keep:
+                    if rc is None:
+                        continue
+                    r.wait()  # joins the output pump: don't lose tail lines
+                    del self.current[peer]
+                    if self.pool:
+                        self.pool.put(self._chip_of.pop(peer, -1))
+                    if rc != 0:
+                        self._last_rc = rc
+                        if self.heal:
+                            self._heal_dead(peer, rc)
+                            # survivors now recover + re-rendezvous: their
+                            # heartbeats may pause at phase edges, so restart
+                            # the staleness clock for everyone
+                            self._hb_amnesty_until = (
+                                time.monotonic() + self.heartbeat_timeout_s
+                            )
+                        elif not self.keep:
                             log.error("worker %s failed (%d); stopping job", peer, rc)
                             self.shutdown()
                             return rc
+                if (self.heal and self._healed_to_zero
+                        and not self.current and not self._regrow_at):
+                    # healed the whole job away with no restarts pending:
+                    # surface the last failure instead of idling forever
+                    log.error("cluster healed to zero workers; job failed")
+                    return self._last_rc or 1
                 if not self.current and self.version >= 0:
-                    if getattr(self, "_last_want", 1) > 0:
+                    if self._last_want > 0:
                         log.info("all workers exited")
                         return 0
                     # this host was shrunk to zero workers: the job continues
@@ -238,15 +421,18 @@ class WatchRunner:
                     # reference watcher keeps waiting for Stage updates,
                     # watch.go:106-135).  The job's end is signalled by the
                     # config server going away (the runner embedding it stops
-                    # it on exit); a long miss threshold rides out transient
-                    # restarts (which must not permanently remove this host).
+                    # it on exit); a long wall-clock threshold rides out
+                    # transient restarts (which must not permanently remove
+                    # this host) and is immune to how long each poll takes
+                    # now that the client retries internally.
                     if got is None:
-                        self._idle_misses += 1
-                        if self._idle_misses * self.poll_s >= 60.0:
+                        if self._idle_since is None:
+                            self._idle_since = time.monotonic()
+                        elif time.monotonic() - self._idle_since >= 60.0:
                             log.info("idle host: config server gone; exiting")
                             return 0
                     else:
-                        self._idle_misses = 0
+                        self._idle_since = None
                 if timeout_s and time.monotonic() - t0 > timeout_s:
                     log.error("watch timeout after %.0fs", timeout_s)
                     self.shutdown()

@@ -22,6 +22,8 @@ def main(argv=None) -> int:
     ap.add_argument("--batch-size", type=int, default=32)
     ap.add_argument("--dim", type=int, default=64, help="fake parameter size")
     ap.add_argument("--check-every", type=int, default=2)
+    ap.add_argument("--checkpoint-dir", default="", help="durable checkpoint dir")
+    ap.add_argument("--checkpoint-every", type=int, default=10)
     args = ap.parse_args(argv)
 
     from ..elastic.trainer import ElasticConfig, run_elastic
@@ -66,6 +68,8 @@ def main(argv=None) -> int:
             batch_size=args.batch_size,
             schedule=args.schedule,
             check_every=args.check_every,
+            checkpoint_dir=args.checkpoint_dir,
+            checkpoint_every=args.checkpoint_every,
         ),
     )
     mesh = out["trainer"].mesh
@@ -73,9 +77,13 @@ def main(argv=None) -> int:
     print(
         f"RESULT: fake-adaptive trained={out['trained_samples']} "
         f"resizes={out['resizes']} final_size={out['final_size']} "
-        f"mesh={mesh_desc} loss={out['loss']:.4f}",
+        f"mesh={mesh_desc} loss={out['loss']:.4f} heals={out['heals']}",
         flush=True,
     )
+    if out["heal_events"]:
+        import json
+
+        print("HEAL_EVENTS: " + json.dumps(out["heal_events"]), flush=True)
     return 0
 
 

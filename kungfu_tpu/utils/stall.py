@@ -5,11 +5,18 @@ DETECTION wrapping every cgo op (libkungfu-comm/main.go:163-179).  A ticker
 warns every `period` seconds until the wrapped operation completes; on TPU
 this catches hung collectives (e.g. one process missing from a multi-host
 program) which otherwise block silently inside XLA.
+
+Hard deadline (self-healing tier): warnings alone leave a hung worker
+wedged forever — no supervisor can distinguish "slow" from "dead".  With
+`KFT_STALL_DEADLINE_S` set (or deadline_s= passed), a stall that outlives
+the deadline aborts the process (exit 87) so the watch-mode healer sees a
+dead worker and can shrink the cluster around it (docs/fault_tolerance.md).
 """
 from __future__ import annotations
 
 import contextlib
 import os
+import sys
 import threading
 import time
 
@@ -18,7 +25,32 @@ from .log import get_logger
 log = get_logger("kungfu.stall")
 
 ENABLED_ENV = "KFT_CONFIG_ENABLE_STALL_DETECTION"
+DEADLINE_ENV = "KFT_STALL_DEADLINE_S"
+HEARTBEAT_FILE_ENV = "KFT_HEARTBEAT_FILE"
 DEFAULT_PERIOD_S = 3.0
+STALL_ABORT_EXIT_CODE = 87
+
+
+def _touch_heartbeat() -> None:
+    """Refresh the healer-facing liveness file (if this worker has one).
+
+    The watchdog ticks while the main thread is blocked in a native op, so a
+    worker stuck in a monitored collective stays "alive" to the launcher's
+    hang detection — the peers blocked on a hung rank must not be killed
+    along with it.  The hard deadline (KFT_STALL_DEADLINE_S) is what bounds
+    a monitored op; the heartbeat timeout catches wedges OUTSIDE them.
+    """
+    path = os.environ.get(HEARTBEAT_FILE_ENV)
+    if not path:
+        return
+    try:
+        os.utime(path, None)
+    except OSError:
+        try:
+            with open(path, "w"):
+                pass
+        except OSError:  # pragma: no cover - unwritable heartbeat dir
+            pass
 
 
 def enabled() -> bool:
@@ -27,20 +59,51 @@ def enabled() -> bool:
     return env_flag(ENABLED_ENV)
 
 
+def deadline_from_env() -> float:
+    """Configured hard deadline in seconds; 0 = no deadline."""
+    try:
+        return float(os.environ.get(DEADLINE_ENV, "") or 0.0)
+    except ValueError:
+        return 0.0
+
+
+def _abort(name: str, waited_s: float, deadline_s: float) -> None:  # pragma: no cover
+    log.critical(
+        "%s stalled for %.0f s, past the %.0f s deadline (%s); aborting so "
+        "the supervisor can heal the cluster",
+        name, waited_s, deadline_s, DEADLINE_ENV,
+    )
+    sys.stderr.flush()
+    sys.stdout.flush()
+    os._exit(STALL_ABORT_EXIT_CODE)
+
+
 @contextlib.contextmanager
-def stall_detector(name: str, period_s: float = DEFAULT_PERIOD_S, force: bool = False):
-    """Warn '<name> stalled for N s' every period until the block exits."""
-    if not (force or enabled()):
+def stall_detector(name: str, period_s: float = DEFAULT_PERIOD_S, force: bool = False,
+                   deadline_s: float = None, abort=None):
+    """Warn '<name> stalled for N s' every period until the block exits.
+
+    deadline_s=None reads KFT_STALL_DEADLINE_S; a positive deadline arms the
+    watchdog even when periodic warnings are off, and fires `abort` (default:
+    exit 87) if the block is still running when it expires.
+    """
+    if deadline_s is None:
+        deadline_s = deadline_from_env()
+    if not (force or enabled() or deadline_s > 0):
         yield
         return
     done = threading.Event()
     t0 = time.monotonic()
+    abort_fn = abort if abort is not None else _abort
 
     def watch():
-        k = 1
-        while not done.wait(period_s):
-            log.warning("%s stalled for %.0f s", name, time.monotonic() - t0)
-            k += 1
+        while not done.wait(min(period_s, deadline_s) if deadline_s > 0 else period_s):
+            waited = time.monotonic() - t0
+            _touch_heartbeat()
+            if deadline_s > 0 and waited >= deadline_s:
+                abort_fn(name, waited, deadline_s)
+                return  # a test abort_fn returns instead of exiting
+            log.warning("%s stalled for %.0f s", name, waited)
 
     th = threading.Thread(target=watch, daemon=True, name=f"stall-{name}")
     th.start()

@@ -1,8 +1,8 @@
 #!/usr/bin/env bash
-# Repo gate: lint (ruff), kf-lint static analysis, tier-1 tests.
+# Repo gate: lint (ruff), kf-lint static analysis, chaos smoke, tier-1 tests.
 #
 #   scripts/check.sh            # run everything
-#   scripts/check.sh --fast     # skip the tier-1 pytest run
+#   scripts/check.sh --fast     # skip the chaos smoke + tier-1 pytest run
 #
 # Exits non-zero on the first failing stage.
 set -euo pipefail
@@ -33,9 +33,13 @@ fi
 echo "ok (exit non-zero as expected)"
 
 if [ "$fast" = "1" ]; then
-    echo "== tier-1 pytest skipped (--fast) =="
+    echo "== chaos smoke + tier-1 pytest skipped (--fast) =="
     exit 0
 fi
+
+echo "== chaos smoke: scripted crash+heal drill (CPU) =="
+JAX_PLATFORMS=cpu python -m kungfu_tpu.chaos \
+    --np 2 --plan "crash@step=5:rank=1" --total-samples 512 --timeout 180
 
 echo "== tier-1 pytest =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \

@@ -1,0 +1,514 @@
+"""Chaos harness + self-healing loop tests.
+
+Fast tier: fault-plan grammar, injectors, config-server outage windows,
+ConfigClient retry, conditional PUT, stall deadline, healer shrink/restart
+bookkeeping.  Slow tier (`faults` + `slow` markers): multi-process drills —
+crash-at-step heals to n-1, hang detection via heartbeats, config-server
+flap ridden out, SIGTERM preemption + checkpoint resume.
+"""
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+import types
+
+import pytest
+
+from kungfu_tpu.chaos import (
+    ChaosInjector,
+    Fault,
+    ServerChaos,
+    parse_fault_plan,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+# -- fault-plan grammar ----------------------------------------------------------------
+
+
+class TestFaultPlan:
+    def test_parse_full_plan(self):
+        plan = parse_fault_plan(
+            "crash@step=7:rank=2;hang@step=12:rank=1;flap@config_server=3s"
+        )
+        kinds = [f.kind for f in plan.faults]
+        assert kinds == ["crash", "hang", "flap"]
+        crash = plan.faults[0]
+        assert (crash.step, crash.rank, crash.code) == (7, 2, 41)
+        assert plan.flap_faults()[0].duration_s == 3.0
+        assert len(plan.worker_faults()) == 2
+
+    def test_empty_plan_is_falsy(self):
+        assert not parse_fault_plan("")
+        assert not parse_fault_plan("  ;  ")
+
+    def test_crash_custom_code(self):
+        f = parse_fault_plan("crash@step=1:rank=0:code=77").faults[0]
+        assert f.code == 77
+
+    def test_durations(self):
+        assert parse_fault_plan("flap@config_server=250ms").faults[0].duration_s == 0.25
+        assert parse_fault_plan("flap@config_server=2").faults[0].duration_s == 2.0
+        assert parse_fault_plan("hang@step=1:rank=0:secs=1.5s").faults[0].secs == 1.5
+
+    def test_slow_window(self):
+        f = parse_fault_plan("slow@step=5:rank=1:ms=20:steps=3").faults[0]
+        assert [f.matches(s, 1) for s in (4, 5, 6, 7, 8)] == [
+            False, True, True, True, False,
+        ]
+        assert not f.matches(6, 0)  # wrong rank
+        open_ended = parse_fault_plan("slow@step=5:rank=1:ms=20").faults[0]
+        assert open_ended.matches(10_000, 1)
+
+    @pytest.mark.parametrize("bad", [
+        "boom@step=1:rank=0",           # unknown kind
+        "crash@step=1",                 # missing rank
+        "crash@rank=0",                 # missing step
+        "crash@step=1:rank=0:code=0",   # crash must be observable
+        "crash@step=1:rank=0:zork=3",   # unknown arg
+        "slow@step=1:rank=0",           # slow needs ms
+        "flap@after=3",                 # flap needs config_server=
+        "crash",                        # no @
+        "flap@config_server=xyz",       # bad duration
+    ])
+    def test_malformed_plans_raise(self, bad):
+        with pytest.raises(ValueError):
+            parse_fault_plan(bad)
+
+
+# -- worker-side injector --------------------------------------------------------------
+
+
+class TestChaosInjector:
+    def _injector(self, spec):
+        exits, sleeps = [], []
+        inj = ChaosInjector(
+            parse_fault_plan(spec),
+            exit_fn=lambda code: exits.append(code),
+            sleep_fn=lambda s: sleeps.append(s),
+        )
+        return inj, exits, sleeps
+
+    def test_crash_fires_once_at_step_and_rank(self):
+        inj, exits, _ = self._injector("crash@step=3:rank=1:code=55")
+        for step in range(3):
+            inj.on_step(step, 1)
+        assert exits == []
+        inj.on_step(3, 0)  # wrong rank
+        assert exits == []
+        inj.on_step(3, 1)
+        assert exits == [55]
+        inj.on_step(3, 1)  # one-shot
+        assert exits == [55]
+
+    def test_bounded_hang_sleeps(self):
+        inj, _, sleeps = self._injector("hang@step=2:rank=0:secs=4")
+        inj.on_step(2, 0)
+        assert sleeps == [4.0]
+        inj.on_step(2, 0)
+        assert sleeps == [4.0]  # one-shot
+
+    def test_slow_applies_across_window(self):
+        inj, _, sleeps = self._injector("slow@step=1:rank=0:ms=30:steps=2")
+        for step in range(4):
+            inj.on_step(step, 0)
+        assert sleeps == [0.03, 0.03]
+
+
+class TestServerChaos:
+    def test_deterministic_outage_window(self):
+        now = [100.0]
+        chaos = ServerChaos(
+            parse_fault_plan("flap@config_server=3s:after=2"), clock=lambda: now[0]
+        )
+        assert not chaos.should_503()  # request 1
+        assert not chaos.should_503()  # request 2
+        assert chaos.should_503()      # request 3 opens the window
+        now[0] += 2.9
+        assert chaos.should_503()      # still inside
+        now[0] += 0.2
+        assert not chaos.should_503()  # window over; flap consumed
+        now[0] += 100.0
+        assert not chaos.should_503()  # fires once
+
+
+# -- config server: conditional PUT + flap wiring --------------------------------------
+
+
+def _cluster(n=2):
+    from kungfu_tpu.plan import Cluster, HostList
+
+    return Cluster.from_hostlist(HostList.parse(f"127.0.0.1:{n}"), n)
+
+
+class TestConditionalPut:
+    def test_version_conflict_rejected(self):
+        from kungfu_tpu.elastic.config_server import _State
+
+        st = _State(_cluster(3))
+        ok, _ = st.put(_cluster(2), expect_version=0)
+        assert ok and st.version == 1
+        ok, msg = st.put(_cluster(3), expect_version=0)  # stale writer
+        assert not ok and "conflict" in msg
+        ok, _ = st.put(_cluster(3), expect_version=1)
+        assert ok and st.version == 2
+
+    def test_unconditional_put_still_works(self):
+        from kungfu_tpu.elastic.config_server import _State
+
+        st = _State(_cluster(3))
+        ok, _ = st.put(_cluster(2), expect_version=None)
+        assert ok and st.version == 1
+
+    def test_http_roundtrip_conditional(self):
+        from kungfu_tpu.elastic.config_client import ConfigClient
+        from kungfu_tpu.elastic.config_server import ConfigServer
+
+        srv = ConfigServer(port=0, init=_cluster(3)).start()
+        try:
+            client = ConfigClient(srv.url, retries=1, retry_deadline_s=2.0)
+            cluster, version = client.get_cluster()
+            assert cluster.size() == 3 and version == 0
+            assert client.put_cluster(_cluster(2), version=0)
+            assert not client.put_cluster(_cluster(3), version=0)  # conflict
+            cluster, version = client.get_cluster()
+            assert cluster.size() == 2 and version == 1
+        finally:
+            srv.stop()
+
+    def test_flap_window_rides_out_with_retry(self):
+        """A flap shorter than the client's retry budget is invisible to
+        callers; one longer than it collapses to None in poll loops."""
+        from kungfu_tpu.elastic.config_client import ConfigClient
+        from kungfu_tpu.elastic.config_server import ConfigServer
+
+        chaos = ServerChaos(parse_fault_plan("flap@config_server=1s:after=1"))
+        srv = ConfigServer(port=0, init=_cluster(2), chaos=chaos).start()
+        try:
+            client = ConfigClient(srv.url, retries=6, backoff_s=0.2,
+                                  retry_deadline_s=5.0)
+            assert client.get_cluster()[1] == 0  # request 1: served
+            got = client.get_cluster()  # request 2 opens the 1s window: retried
+            assert got is not None and got[0].size() == 2
+        finally:
+            srv.stop()
+
+    def test_outage_past_budget_collapses_to_none(self):
+        from kungfu_tpu.elastic.config_client import ConfigClient
+
+        client = ConfigClient("http://127.0.0.1:9", timeout_s=0.2, retries=1,
+                              backoff_s=0.01, retry_deadline_s=0.5)
+        t0 = time.monotonic()
+        assert client.poll_cluster() is None
+        assert time.monotonic() - t0 < 5.0  # bounded, not hanging
+
+
+# -- stall deadline --------------------------------------------------------------------
+
+
+class TestStallDeadline:
+    def test_deadline_fires_abort(self):
+        from kungfu_tpu.utils.stall import stall_detector
+
+        fired = []
+        with stall_detector("t", period_s=0.05, deadline_s=0.1,
+                            abort=lambda *a: fired.append(a)):
+            time.sleep(0.4)
+        assert fired and fired[0][2] == 0.1
+
+    def test_no_abort_before_deadline(self):
+        from kungfu_tpu.utils.stall import stall_detector
+
+        fired = []
+        with stall_detector("t", period_s=0.05, deadline_s=5.0,
+                            abort=lambda *a: fired.append(a)):
+            time.sleep(0.1)
+        assert not fired
+
+    def test_zero_deadline_means_no_watchdog(self):
+        from kungfu_tpu.utils.stall import stall_detector
+
+        with stall_detector("t", deadline_s=0.0):
+            pass  # must not arm anything (enabled() is off in tests)
+
+    def test_watchdog_refreshes_heartbeat_file(self, tmp_path, monkeypatch):
+        from kungfu_tpu.utils.stall import stall_detector
+
+        hb = tmp_path / "hb"
+        hb.write_text("")
+        old = time.time() - 1000
+        os.utime(hb, (old, old))
+        monkeypatch.setenv("KFT_HEARTBEAT_FILE", str(hb))
+        with stall_detector("t", period_s=0.05, deadline_s=30.0,
+                            abort=lambda *a: None):
+            time.sleep(0.3)
+        assert time.time() - os.path.getmtime(hb) < 100
+
+
+# -- suspected-failure classification --------------------------------------------------
+
+
+class TestSuspectedPeerFailure:
+    def test_classification(self):
+        from kungfu_tpu.elastic.trainer import _suspected_peer_failure as sus
+
+        assert sus(TimeoutError("no consensus"))
+        assert sus(ConnectionResetError(104, "reset"))
+        assert sus(ValueError("Gloo all-reduce failed: Connection closed by peer"))
+        assert sus(RuntimeError("UNAVAILABLE: heartbeat timeout"))
+        assert not sus(ValueError("shapes do not match"))
+        assert not sus(KeyError("params"))
+
+
+# -- healer: shrink document, restart budget, stalest-victim selection -----------------
+
+
+class _FakePopen:
+    def __init__(self):
+        self.returncode = None
+
+    def poll(self):
+        return self.returncode
+
+
+def _fake_runner(hb_path):
+    return types.SimpleNamespace(
+        popen=_FakePopen(),
+        proc=types.SimpleNamespace(env={"KFT_HEARTBEAT_FILE": hb_path}),
+    )
+
+
+def _watch_runner(client, heal=True, **kw):
+    from kungfu_tpu.plan import Strategy
+    from kungfu_tpu.run.job import Job
+    from kungfu_tpu.run.launcher import WatchRunner
+
+    job = Job(prog=sys.executable, args=[], strategy=Strategy.AUTO)
+    return WatchRunner(job, "127.0.0.1", client, heal=heal, **kw)
+
+
+class TestHealer:
+    def _server(self, n=3):
+        from kungfu_tpu.elastic.config_client import ConfigClient
+        from kungfu_tpu.elastic.config_server import ConfigServer
+
+        srv = ConfigServer(port=0, init=_cluster(n)).start()
+        return srv, ConfigClient(srv.url)
+
+    def test_heal_dead_shrinks_prefix_preserving(self):
+        srv, client = self._server(3)
+        try:
+            runner = _watch_runner(client)
+            victim = _cluster(3).workers[1]
+            runner._heal_dead(victim, rc=41)
+            cluster, version = client.get_cluster()
+            assert version == 1 and cluster.size() == 2
+            # pure deletion: surviving head keeps rank 0
+            assert cluster.workers[0] == _cluster(3).workers[0]
+            assert victim not in tuple(cluster.workers)
+            assert runner.heal_events[0]["old_size"] == 3
+            assert runner.heal_events[0]["new_size"] == 2
+        finally:
+            srv.stop()
+
+    def test_heal_skips_already_absent_peer(self):
+        """A planned detach (preemption self-removal) that raced the exit
+        collection must not shrink the cluster again."""
+        srv, client = self._server(3)
+        try:
+            victim = _cluster(3).workers[2]
+            got = client.get_cluster()
+            from kungfu_tpu.plan import Cluster, PeerList
+
+            cl, v = got
+            client.put_cluster(
+                Cluster(runners=cl.runners,
+                        workers=PeerList(p for p in cl.workers if p != victim)),
+                version=v,
+            )
+            runner = _watch_runner(client)
+            runner._heal_dead(victim, rc=0)
+            assert client.get_cluster()[1] == 1  # no extra version bump
+            assert not runner.heal_events
+        finally:
+            srv.stop()
+
+    def test_restart_budget_and_backoff(self):
+        srv, client = self._server(3)
+        try:
+            runner = _watch_runner(client, restart_budget=2, restart_backoff_s=0.5)
+            peer = _cluster(3).workers[1]
+            runner._schedule_restart(peer)
+            assert runner._restarts[peer] == 1
+            d1 = runner._regrow_at[peer] - time.monotonic()
+            assert 0.2 <= d1 <= 1.0  # 0.5 * 2^0 with +-20% jitter
+            del runner._regrow_at[peer]
+            runner._schedule_restart(peer)
+            d2 = runner._regrow_at[peer] - time.monotonic()
+            assert d2 > d1 * 1.2  # exponential
+            del runner._regrow_at[peer]
+            runner._schedule_restart(peer)  # budget (2) exhausted
+            assert peer not in runner._regrow_at
+        finally:
+            srv.stop()
+
+    def test_regrow_re_adds_peer(self):
+        srv, client = self._server(3)
+        try:
+            runner = _watch_runner(client, restart_budget=1)
+            victim = _cluster(3).workers[1]
+            runner._heal_dead(victim, rc=41)
+            assert client.get_cluster()[0].size() == 2
+            assert victim in runner._regrow_at
+            runner._regrow_at[victim] = time.monotonic() - 1  # due now
+            runner._process_regrows()
+            cluster, version = client.get_cluster()
+            assert cluster.size() == 3 and version == 2
+            assert victim in tuple(cluster.workers)
+        finally:
+            srv.stop()
+
+    def test_stalest_worker_selection_and_amnesty(self, tmp_path):
+        srv, client = self._server(2)
+        try:
+            runner = _watch_runner(client, heartbeat_timeout_s=5.0)
+            fresh, stale = str(tmp_path / "a"), str(tmp_path / "b")
+            for p in (fresh, stale):
+                with open(p, "w"):
+                    pass
+            old = time.time() - 60
+            os.utime(stale, (old, old))
+            peers = tuple(_cluster(2).workers)
+            runner.current = {
+                peers[0]: _fake_runner(fresh), peers[1]: _fake_runner(stale)
+            }
+            got = runner._stalest_worker()
+            assert got is not None and got[1] == peers[1]
+            # amnesty suppresses staleness judgements entirely
+            runner._hb_amnesty_until = time.monotonic() + 60
+            assert runner._stalest_worker() is None
+        finally:
+            srv.stop()
+
+    def test_no_heartbeat_config_means_no_staleness(self):
+        srv, client = self._server(2)
+        try:
+            runner = _watch_runner(client)  # heartbeat_timeout_s=0
+            assert runner._stalest_worker() is None
+        finally:
+            srv.stop()
+
+
+# -- monitor counters ------------------------------------------------------------------
+
+
+class TestHealCounters:
+    def test_events_and_gauges_roundtrip(self):
+        from kungfu_tpu.monitor.counters import Counters
+
+        c = Counters()
+        c.inc_event("worker_failures")
+        c.inc_event("heals", 2)
+        c.set_gauge("heal_mttr_s", 1.25)
+        assert c.events() == {"worker_failures": 1, "heals": 2}
+        assert c.gauges() == {"heal_mttr_s": 1.25}
+        prom = c.prometheus_text()
+        assert 'kungfu_events_total{event="heals"} 2' in prom
+        assert 'kungfu_gauge{name="heal_mttr_s"} 1.25' in prom
+
+
+# -- multi-process drills (slow tier) --------------------------------------------------
+
+
+def _drill_env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+@pytest.mark.faults
+@pytest.mark.slow
+class TestChaosE2E:
+    def test_crash_heals_to_n_minus_one(self):
+        from kungfu_tpu.chaos.__main__ import run_drill
+
+        s = run_drill("crash@step=7:rank=2", np=3, total_samples=1536,
+                      timeout_s=240)
+        assert s["returncode"] == 0, s["output"][-3000:]
+        assert s["runner_heal_events"], s["output"][-3000:]
+        assert s["runner_heal_events"][0]["old_size"] == 3
+        assert s["runner_heal_events"][0]["new_size"] == 2
+        assert s["heal_events"] and s["heal_events"][0]["mttr_s"] > 0
+        for res in s["results"]:
+            assert res["trained"] >= 1536 and res["final_size"] == 2
+            assert res["loss"] == res["loss"]  # finite (not NaN)
+
+    def test_hang_detected_via_heartbeat(self):
+        from kungfu_tpu.chaos.__main__ import run_drill
+
+        s = run_drill("hang@step=9:rank=1", np=3, total_samples=1536,
+                      timeout_s=240, heartbeat_timeout=6.0)
+        assert s["returncode"] == 0, s["output"][-3000:]
+        assert s["runner_heal_events"], s["output"][-3000:]
+        assert s["runner_heal_events"][0]["new_size"] == 2
+        assert all(r["trained"] >= 1536 for r in s["results"])
+
+    def test_flap_ridden_out_without_resize(self):
+        from kungfu_tpu.chaos.__main__ import run_drill
+
+        s = run_drill("flap@config_server=3s:after=8", np=2,
+                      total_samples=1024, timeout_s=240)
+        assert s["returncode"] == 0, s["output"][-3000:]
+        assert not s["runner_heal_events"], s["output"][-3000:]
+        for res in s["results"]:
+            assert res["trained"] >= 1024 and res["final_size"] == 2
+            assert res["heals"] == 0
+
+
+@pytest.mark.faults
+@pytest.mark.slow
+class TestPreemptionE2E:
+    def test_sigterm_checkpoints_then_resume(self, tmp_path):
+        """SIGTERM mid-run -> final checkpoint + DETACHED; a fresh launch
+        resumes losing at most checkpoint_every steps."""
+        ckpt = str(tmp_path / "ckpt")
+        env = _drill_env()
+        env["KFT_FAULT_PLAN"] = "slow@step=0:rank=0:ms=150"
+        cmd = [sys.executable, "-m", "kungfu_tpu.testing.fake_adaptive_trainer",
+               "--total-samples", "65536", "--batch-size", "32",
+               "--checkpoint-dir", ckpt, "--checkpoint-every", "5"]
+        p = subprocess.Popen(cmd, env=env, cwd=REPO, stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT, text=True)
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if os.path.isdir(ckpt) and os.listdir(ckpt):
+                break
+            time.sleep(0.5)
+        time.sleep(2.0)
+        p.send_signal(signal.SIGTERM)
+        out, _ = p.communicate(timeout=120)
+        assert p.returncode == 0, out[-3000:]
+        m = re.search(r"DETACHED: preempted at step (\d+)", out)
+        assert m, out[-3000:]
+        preempt_step = int(m.group(1))
+        # fresh launch resumes from the preemption checkpoint
+        env2 = _drill_env()
+        env2.pop("KFT_FAULT_PLAN", None)
+        r = subprocess.run(
+            [sys.executable, "-m", "kungfu_tpu.testing.fake_adaptive_trainer",
+             "--total-samples", str((preempt_step + 10) * 32),
+             "--batch-size", "32", "--checkpoint-dir", ckpt],
+            env=env2, cwd=REPO, capture_output=True, text=True, timeout=240,
+        )
+        out2 = r.stdout + r.stderr
+        assert r.returncode == 0, out2[-3000:]
+        m2 = re.search(r"resumed from checkpoint: step (\d+)", out2)
+        assert m2, out2[-3000:]
+        assert int(m2.group(1)) >= preempt_step - 5, (preempt_step, out2[-2000:])
+        assert "RESULT:" in out2
