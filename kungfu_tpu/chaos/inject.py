@@ -49,9 +49,11 @@ class ChaosInjector:
             if f.kind == "crash":
                 self._fired.add(f)
                 log.warning("CHAOS: crash at step %d rank %d (exit %d)", step, rank, f.code)
+                self._journal("chaos_crash", step, rank, code=f.code)
                 self._exit(f.code)
             elif f.kind == "hang":
                 self._fired.add(f)
+                self._journal("chaos_hang", step, rank, secs=f.secs)
                 log.warning(
                     "CHAOS: hang at step %d rank %d (%s)",
                     step, rank, f"{f.secs:.1f}s" if f.secs else "forever",
@@ -63,6 +65,14 @@ class ChaosInjector:
                         self._sleep(3600.0)
             elif f.kind == "slow":
                 self._sleep(f.ms / 1e3)
+
+    @staticmethod
+    def _journal(event: str, step: int, rank: int, **fields) -> None:
+        """Scripted faults stamp the journal (flushed per emit) so a drill's
+        timeline shows the injection next to the heal it provoked."""
+        from ..monitor.journal import journal_event
+
+        journal_event(event, step=step, launch_rank=rank, **fields)
 
 
 def injector_from_env() -> Optional[ChaosInjector]:

@@ -46,7 +46,9 @@ from typing import Any, Callable, Dict, Iterator, Optional, Tuple
 
 import numpy as np
 
+from ..monitor.journal import journal_event
 from ..utils import get_logger, stall_detector
+from ..utils import trace as tracing
 from .config_client import ConfigClient
 from .schedule import StepBasedSchedule
 
@@ -248,19 +250,28 @@ def _maybe_enable_compile_cache() -> None:
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 
-def _teardown_backend(graceful: bool = True) -> None:
+def _teardown_backend(graceful: bool = True, peer=None) -> None:
     """Tear down jax.distributed + the XLA backend for a rebuild.
 
     graceful=False is the suspected-dead-peer path: the all-tasks shutdown
     barrier would block on (and then be killed by) the very peer whose death
     we are recovering from, so the runtime references are dropped with
     bounded, error-swallowing shutdowns instead (kungfu_tpu.distributed).
+
+    `peer` (when given) gets its monitor endpoint fully closed FIRST —
+    MonitorServer.close now joins the server thread, and a healed worker
+    re-binding the same monitor port must not race a still-draining one.
     """
     import jax
     import jax._src.xla_bridge as xb
 
     from ..distributed import teardown_distributed_runtime
 
+    if peer is not None:
+        try:
+            peer.close_monitor()
+        except Exception as e:  # noqa: BLE001 - teardown must not throw
+            log.warning("monitor close during teardown: %s", e)
     t0 = time.perf_counter()
     try:
         teardown_distributed_runtime(graceful=graceful)
@@ -555,6 +566,7 @@ def run_elastic(
             except OSError as e:
                 log.warning("preemption self-removal failed: %s", e)
         global_counters().inc_event("preemptions")
+        journal_event("preemption", step=step, trained_samples=offset)
         print(f"DETACHED: preempted at step {step} ({offset} samples trained)",
               flush=True)
         sys.exit(0)
@@ -567,9 +579,13 @@ def run_elastic(
         import gc
 
         t_detect = time.perf_counter()
+        m_detect = time.monotonic()  # span/phase stamps stay NTP-immune
         old_size = peer.size
         log.warning("suspected peer failure (%s: %s); entering recovery",
                     type(cause).__name__, str(cause)[:200])
+        journal_event("peer_failure_suspected", reason=type(cause).__name__,
+                      detail=str(cause)[:200], step=step, old_size=old_size)
+        phases: Dict[str, float] = {}
         try:
             # the live state is usually poisoned (its buffers' definition
             # event is the failed collective, and the step donated its
@@ -602,7 +618,14 @@ def run_elastic(
         state = data = trainer = programs = None
         metrics = {"loss": np.float32(np.nan)}
         gc.collect()
-        _teardown_backend(graceful=False)
+        m_td0 = time.monotonic()
+        tracing.record_span("heal:detect", m_detect, m_td0, cat="heal",
+                            args={"reason": type(cause).__name__})
+        phases["detect_s"] = round(m_td0 - m_detect, 4)
+        _teardown_backend(graceful=False, peer=peer)
+        m_rdv0 = time.monotonic()
+        tracing.record_span("heal:teardown", m_td0, m_rdv0, cat="heal")
+        phases["teardown_s"] = round(m_rdv0 - m_td0, 4)
         while True:
             deadline = time.monotonic() + cfg.heal_timeout_s
             got = None
@@ -632,9 +655,18 @@ def run_elastic(
                 trainer, programs = build()
                 if ckpt is not None:
                     ckpt.set_primary(peer.rank == 0)
+                m_sync0 = time.monotonic()
+                # the re-rendezvous phase spans teardown end -> new-mesh
+                # rebuild, INCLUDING failed attempts chasing newer documents
+                tracing.record_span("heal:re_rendezvous", m_rdv0, m_sync0,
+                                    cat="heal", args={"version": version})
+                phases["re_rendezvous_s"] = round(m_sync0 - m_rdv0, 4)
                 (offset, step), synced = programs.sync_state(
                     (offset, step), {"params": snap_params, "opt": snap_opt}
                 )
+                m_sync1 = time.monotonic()
+                tracing.record_span("heal:resync", m_sync0, m_sync1, cat="heal")
+                phases["resync_s"] = round(m_sync1 - m_sync0, 4)
             except (KeyboardInterrupt, SystemExit):
                 raise
             except Exception as e:  # noqa: BLE001 - vetted below
@@ -651,15 +683,30 @@ def run_elastic(
                 )
                 trainer = programs = None
                 gc.collect()
-                _teardown_backend(graceful=False)
+                m_rt0 = time.monotonic()
+                _teardown_backend(graceful=False, peer=peer)
+                tracing.record_span("heal:teardown", m_rt0, cat="heal",
+                                    args={"retry": True})
                 continue
             break
+        from ..monitor.counters import counters_if_enabled
+
+        c = counters_if_enabled()
+        if c is not None:
+            # latency/rate distributions measured against the dead world
+            # would pollute the healed one's throughput + interference vote
+            c.reset_for_reinit()
+        tracing.record_span("heal", m_detect, cat="heal", args={
+            "version": version, "old_size": old_size, "new_size": peer.size,
+            "reason": type(cause).__name__,
+        })
         state = TrainState(synced["params"], synced["opt"], step)
         data = make_data(peer.rank, peer.size, offset)
         skip_check_at = step
         _pending_heal = {
             "version": version, "old_size": old_size, "new_size": peer.size,
             "reason": type(cause).__name__, "t_detect": t_detect,
+            "phases": dict(phases),
         }
         log.info("recovered onto %d-worker cluster at v%d; resuming at step %d",
                  peer.size, version, step)
@@ -730,6 +777,7 @@ def run_elastic(
                         ev["phases"][name] = round(now - _t[0], 4)
                         _t[0] = now
 
+                    m_resize0 = time.monotonic()
                     snap_params, snap_opt = snap(state)
                     _phase("snapshot")
                     if ckpt is not None:
@@ -738,7 +786,7 @@ def run_elastic(
                         # detaching primary must not abandon queued saves)
                         ckpt.release()
                         _phase("ckpt_release")
-                    _teardown_backend()
+                    _teardown_backend(peer=peer)
                     _phase("teardown")
                     if not peer.update_cluster(cluster, version):
                         sys.exit(0)
@@ -759,18 +807,25 @@ def run_elastic(
                     skip_check_at = step
                     resizes += 1
                     resize_events.append(ev)
+                    tracing.record_span("resize", m_resize0, cat="elastic",
+                                        args={"version": version,
+                                              "old_size": ev["old_size"],
+                                              "new_size": ev["new_size"]})
                     _first_step_after_resize = True
                 else:  # unreachable given digest consensus; log if it ever is
                     log.warning("agreed version %d but no matching doc cached", version)
 
-        batch = trainer.shard_batch(next(data))
+        with tracing.trace_scope("step:data", cat="train"):
+            batch = trainer.shard_batch(next(data))
         if _first_step_after_resize or _pending_heal is not None:
             import jax
 
             t_fs = time.perf_counter()
             with stall_detector("elastic_train_step", force=heal_armed):
-                state, metrics = trainer.train_step(state, batch)
-                jax.block_until_ready(metrics)  # force the recompile into the timing
+                with tracing.trace_scope("step:train", cat="train",
+                                         args={"step": step, "recompile": True}):
+                    state, metrics = trainer.train_step(state, batch)
+                    jax.block_until_ready(metrics)  # force the recompile into the timing
             if _first_step_after_resize:
                 ev = resize_events[-1]
                 ev["phases"]["first_step"] = round(time.perf_counter() - t_fs, 4)
@@ -781,29 +836,45 @@ def run_elastic(
                     ev["propose_to_done_s"] = round(
                         ev["propose_to_start_s"] + ev["total_s"], 4
                     )
+                journal_event("resize", version=ev["version"],
+                              old_size=ev["old_size"], new_size=ev["new_size"],
+                              phases=ev["phases"], total_s=ev["total_s"])
                 _first_step_after_resize = False
             if _pending_heal is not None:
                 # MTTR: failure detection -> first completed post-heal step
                 hev = dict(_pending_heal)
                 hev["mttr_s"] = round(time.perf_counter() - hev.pop("t_detect"), 4)
+                hev.setdefault("phases", {})["first_step_s"] = round(
+                    time.perf_counter() - t_fs, 4
+                )
                 heal_events.append(hev)
                 global_counters().inc_event("heals")
                 global_counters().set_gauge("heal_mttr_s", hev["mttr_s"])
+                journal_event("heal", **hev)
                 log.info("healed %d -> %d workers: mttr %.2fs",
                          hev["old_size"], hev["new_size"], hev["mttr_s"])
                 _pending_heal = None
         else:
             with stall_detector("elastic_train_step", force=heal_armed):
-                state, metrics = trainer.train_step(state, batch)
+                with tracing.trace_scope("step:train", cat="train",
+                                         args={"step": step}):
+                    state, metrics = trainer.train_step(state, batch)
         offset += cfg.batch_size * trainer.world
         step += 1
 
         if heal_armed and step % _snapshot_every == 0:
             _update_last_good()
         if ckpt is not None and ckpt.writes and step % max(1, cfg.checkpoint_every) == 0:
-            save_ckpt()
+            with tracing.trace_scope("step:checkpoint", cat="train",
+                                     args={"step": step}):
+                save_ckpt()
 
+    from ..monitor.counters import counters_if_enabled
+
+    step_counters = counters_if_enabled()
     while offset < cfg.total_samples:
+        m_step0 = time.monotonic()
+        step_before = step
         try:
             step_once()
         except (KeyboardInterrupt, SystemExit):
@@ -812,6 +883,15 @@ def run_elastic(
             if not (heal_armed and _suspected_peer_failure(e)):
                 raise
             _recover(e)
+        else:
+            # the honest per-step number: a step that absorbed a resize or
+            # poll is reported as-is — the histogram tail IS that story
+            tracing.record_span("step", m_step0, cat="train",
+                                args={"step": step_before})
+            if step_counters is not None:
+                step_counters.observe_hist(
+                    "step_latency_ms", (time.monotonic() - m_step0) * 1e3
+                )
 
     if _prev_sigterm is not None:
         signal.signal(signal.SIGTERM, _prev_sigterm)

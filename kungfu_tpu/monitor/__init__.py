@@ -1,10 +1,31 @@
-"""Observability: byte counters, Prometheus endpoint, interference detection.
+"""Observability: byte counters + histograms, Prometheus endpoint, span
+tracing feed, structured event journal, fleet aggregation, interference
+detection.
 
 Reference: srcs/go/monitor/{monitor,counters.go} (windowed egress/ingress
 rates, Prometheus-text exposition), peer.go:92-99 (HTTP server on
 self.Port+10000 behind KUNGFU_CONFIG_ENABLE_MONITORING), and
 session/adaptiveStrategies.go (throughput-reference interference vote).
+Beyond the reference: per-op latency histograms (counters.Histogram), the
+append-only lifecycle journal (journal.py), and the launcher-side fleet
+aggregator (fleet.py) serving merged /metrics + /timeline — see
+docs/observability.md.
 """
-from .counters import Counters, RateWindow, global_counters  # noqa: F401
+from .counters import Counters, Histogram, RateWindow, global_counters  # noqa: F401
 from .server import MonitorServer, monitor_port, maybe_start_monitor  # noqa: F401
 from .interference import InterferenceDetector  # noqa: F401
+from .journal import (  # noqa: F401
+    Journal,
+    global_journal,
+    journal_event,
+    merge_journals,
+    read_journal,
+    set_journal_context,
+)
+from .fleet import (  # noqa: F401
+    FleetAggregator,
+    merge_chrome_traces,
+    merge_prometheus,
+    parse_prometheus,
+    targets_from_workers,
+)

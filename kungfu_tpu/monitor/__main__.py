@@ -1,0 +1,284 @@
+"""``python -m kungfu_tpu.monitor`` — fleet telemetry tooling.
+
+Two modes:
+
+  --merge DIR     offline merge of a (possibly dead) job's telemetry
+                  artifacts: every `journal-*.jsonl` in DIR is merged into
+                  `merged-journal.jsonl` (wall-clock ordered) and every
+                  `trace-*.json` (the workers' exit dumps, KFT_TRACE_DUMP_DIR)
+                  into `merged-trace.json` with one Perfetto lane per file.
+
+  --smoke         end-to-end telemetry smoke (the scripts/check.sh stage):
+                  launches a 2-process CPU job under `kungfu-run -telemetry`
+                  (with an optional chaos plan), polls the fleet endpoint
+                  mid-run, and asserts (1) /metrics merges every rank with a
+                  self-consistent counter sum, (2) /timeline parses as valid
+                  Chrome trace JSON with per-rank lanes, and (3) with a
+                  crash plan: the journal holds the failure/heal events with
+                  cluster versions and the merged trace holds the decomposed
+                  heal span.  Exit 0 healthy, non-zero otherwise.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+from typing import Dict, List, Optional
+
+
+def run_merge(dirpath: str, trace_out: str = "", journal_out: str = "") -> int:
+    from .fleet import merge_chrome_traces
+    from .journal import merge_journals
+
+    journals = sorted(glob.glob(os.path.join(dirpath, "journal-*.jsonl")))
+    traces = sorted(glob.glob(os.path.join(dirpath, "trace-*.json")))
+    if not journals and not traces:
+        print(f"no journal-*.jsonl or trace-*.json under {dirpath}", file=sys.stderr)
+        return 1
+
+    if journals:
+        events = merge_journals(journals)
+        journal_out = journal_out or os.path.join(dirpath, "merged-journal.jsonl")
+        with open(journal_out, "w") as f:
+            for e in events:
+                f.write(json.dumps(e) + "\n")
+        by_kind: Dict[str, int] = {}
+        for e in events:
+            by_kind[e.get("event", "?")] = by_kind.get(e.get("event", "?"), 0) + 1
+        print(f"journal: {len(events)} events from {len(journals)} files "
+              f"-> {journal_out}")
+        for k in sorted(by_kind):
+            print(f"  {k}: {by_kind[k]}")
+
+    if traces:
+        loaded = []
+        for i, p in enumerate(traces):
+            try:
+                with open(p) as f:
+                    t = json.load(f)
+            except (OSError, ValueError) as e:
+                print(f"  skipping {p}: {e}", file=sys.stderr)
+                continue
+            lane = os.path.splitext(os.path.basename(p))[0].replace("trace-", "")
+            loaded.append((i, lane, t))
+        merged = merge_chrome_traces(loaded)
+        trace_out = trace_out or os.path.join(dirpath, "merged-trace.json")
+        with open(trace_out, "w") as f:
+            json.dump(merged, f)
+        print(f"trace: {len(merged['traceEvents'])} events from {len(loaded)} "
+              f"lanes -> {trace_out} (open in https://ui.perfetto.dev)")
+    return 0
+
+
+# -- smoke -----------------------------------------------------------------------------
+
+
+def _http_get(url: str, timeout: float = 5.0) -> str:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode()
+
+
+def _validate_chrome_trace(obj) -> Optional[str]:
+    """None if `obj` is a structurally valid Chrome trace, else the reason."""
+    if not isinstance(obj, dict) or not isinstance(obj.get("traceEvents"), list):
+        return "no traceEvents list"
+    for ev in obj["traceEvents"]:
+        if not isinstance(ev, dict) or "name" not in ev or "ph" not in ev or "pid" not in ev:
+            return f"malformed event: {ev!r:.120}"
+        if ev["ph"] == "X" and ("ts" not in ev or "dur" not in ev):
+            return f"complete event without ts/dur: {ev!r:.120}"
+    return None
+
+
+def _check_counter_sums(fleet_text: str) -> Optional[str]:
+    """Every summed counter series must equal the sum of its per-rank
+    breakdown within the SAME scrape — the merge-correctness invariant."""
+    from .fleet import parse_prometheus, _series_kind
+
+    types, series = parse_prometheus(fleet_text)
+    sums: Dict = {}
+    per_rank: Dict = {}
+    for (name, labels), v in series.items():
+        if name.startswith("kungfu_fleet_"):
+            continue
+        base_labels = tuple(kv for kv in labels if kv[0] not in ("rank", "agg"))
+        if any(k == "rank" for k, _ in labels):
+            per_rank.setdefault((name, base_labels), []).append(v)
+        elif not any(k == "agg" for k, _ in labels):
+            sums[(name, base_labels)] = v
+    checked = 0
+    for key, v in sums.items():
+        name = key[0]
+        if _series_kind(name, types) not in ("counter", "histogram"):
+            continue
+        ranks = per_rank.get(key)
+        if not ranks:
+            continue
+        if abs(sum(ranks) - v) > 1e-6 * max(1.0, abs(v)):
+            return f"{name}{dict(key[1])}: fleet {v} != sum(per-rank) {sum(ranks)}"
+        checked += 1
+    if checked == 0:
+        return "no counter series with per-rank breakdown to check"
+    return None
+
+
+def run_smoke(np_: int, plan: str, total_samples: int, timeout_s: float) -> int:
+    telem = tempfile.mkdtemp(prefix="kft-telemetry-smoke-")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["KFT_JOURNAL_DIR"] = telem
+    env["KFT_TRACE_DUMP_DIR"] = telem
+    if plan:
+        env["KFT_FAULT_PLAN"] = plan
+    cmd = [
+        sys.executable, "-m", "kungfu_tpu.run", "-w", "-heal", "-telemetry",
+        "-np", str(np_), "-platform", "cpu", "-port", "0",
+        "-timeout", str(int(timeout_s)),
+        "--", sys.executable, "-m", "kungfu_tpu.testing.fake_adaptive_trainer",
+        "--total-samples", str(total_samples), "--batch-size", "32",
+    ]
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True, bufsize=1)
+    lines: List[str] = []
+    url_box: Dict[str, str] = {}
+
+    def pump():
+        assert proc.stdout is not None
+        for line in proc.stdout:
+            lines.append(line)
+            if line.startswith("TELEMETRY_URL:"):
+                url_box["url"] = line.split(":", 1)[1].strip()
+
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
+
+    failures: List[str] = []
+    deadline = time.monotonic() + timeout_s
+
+    def fail(msg: str) -> None:
+        failures.append(msg)
+        print(f"SMOKE FAIL: {msg}", file=sys.stderr)
+
+    # 1) wait for the fleet endpoint URL
+    while "url" not in url_box and time.monotonic() < deadline and proc.poll() is None:
+        time.sleep(0.2)
+    if "url" not in url_box:
+        fail("launcher never printed TELEMETRY_URL")
+    else:
+        url = url_box["url"]
+        # 2) poll /metrics until every rank is merged (workers boot staggered)
+        merged_ok = timeline_ok = False
+        want = {f'kungfu_fleet_ranks_scraped{{rank="{r}"}} 1' for r in range(np_)}
+        while time.monotonic() < deadline and proc.poll() is None:
+            try:
+                text = _http_get(f"{url}/metrics")
+            except OSError:
+                time.sleep(0.3)
+                continue
+            if not merged_ok and all(w in text for w in want):
+                err = _check_counter_sums(text)
+                if err is None:
+                    merged_ok = True
+                    print(f"smoke: fleet /metrics merges all {np_} ranks, "
+                          "counter sums consistent")
+            if merged_ok and not timeline_ok:
+                try:
+                    tl = json.loads(_http_get(f"{url}/timeline", timeout=10))
+                except (OSError, ValueError):
+                    time.sleep(0.3)
+                    continue
+                err = _validate_chrome_trace(tl)
+                pids = {ev["pid"] for ev in tl["traceEvents"]} if err is None else set()
+                if err is None and len(pids) >= np_:
+                    timeline_ok = True
+                    print(f"smoke: fleet /timeline is valid Chrome trace JSON "
+                          f"({len(tl['traceEvents'])} events, lanes {sorted(pids)})")
+            if merged_ok and timeline_ok:
+                break
+            time.sleep(0.3)
+        if not merged_ok:
+            fail("fleet /metrics never merged every rank with consistent sums")
+        if not timeline_ok:
+            fail("fleet /timeline never became a valid multi-lane Chrome trace")
+
+    rc = proc.wait(timeout=max(10.0, deadline - time.monotonic() + 60))
+    t.join(timeout=5)
+    if rc != 0:
+        fail(f"launcher exited {rc}")
+
+    # 3) post-mortem artifacts: journal + dumped traces (crash plans only)
+    if plan and "crash" in plan and not failures:
+        from .journal import merge_journals
+
+        journals = glob.glob(os.path.join(telem, "journal-*.jsonl"))
+        events = merge_journals(journals)
+        kinds = {e.get("event") for e in events}
+        if "worker_failure" not in kinds or "heal" not in kinds:
+            fail(f"journal missing failure/heal events (saw {sorted(kinds)})")
+        elif any(e.get("event") == "heal" and e.get("cluster_version") is None
+                 and e.get("version") is None for e in events):
+            fail("heal journal event carries no cluster version")
+        else:
+            print(f"smoke: journal has {len(events)} events incl. "
+                  "worker_failure + heal with cluster versions")
+        dumps = glob.glob(os.path.join(telem, "trace-*.json"))
+        heal_spans = set()
+        for p in dumps:
+            try:
+                with open(p) as f:
+                    for ev in json.load(f).get("traceEvents", []):
+                        if str(ev.get("name", "")).startswith("heal"):
+                            heal_spans.add(ev["name"])
+            except (OSError, ValueError):
+                continue
+        if not {"heal:teardown", "heal:re_rendezvous", "heal:resync"} <= heal_spans:
+            fail(f"dumped traces lack the decomposed heal span (saw {sorted(heal_spans)})")
+        else:
+            print(f"smoke: decomposed heal span present ({sorted(heal_spans)})")
+
+    if failures:
+        tail = "".join(lines[-60:])
+        print(f"--- launcher output tail ---\n{tail}", file=sys.stderr)
+        return 1
+    print(f"TELEMETRY SMOKE OK (artifacts in {telem})")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="kungfu_tpu.monitor")
+    ap.add_argument("--merge", metavar="DIR", default="",
+                    help="offline-merge journal-*.jsonl + trace-*.json in DIR")
+    ap.add_argument("--trace-out", default="", help="merged trace path")
+    ap.add_argument("--journal-out", default="", help="merged journal path")
+    ap.add_argument("--smoke", action="store_true",
+                    help="run the end-to-end telemetry smoke (CPU, subprocesses)")
+    ap.add_argument("--np", type=int, default=2)
+    # the slow window holds BOTH ranks alive for seconds of real training
+    # (fake steps run sub-ms on CPU) so the mid-run fleet scrape provably
+    # merges every rank before the scripted crash shrinks the job
+    ap.add_argument("--plan",
+                    default="slow@step=1:rank=0:ms=20:steps=600;"
+                            "crash@step=650:rank=1",
+                    help="chaos plan for the smoke ('' = fault-free)")
+    ap.add_argument("--total-samples", type=int, default=65536)
+    ap.add_argument("--timeout", type=float, default=180.0)
+    args = ap.parse_args(argv)
+
+    if args.merge:
+        return run_merge(args.merge, args.trace_out, args.journal_out)
+    if args.smoke:
+        return run_smoke(args.np, args.plan, args.total_samples, args.timeout)
+    ap.error("pick a mode: --merge DIR or --smoke")
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,6 +9,8 @@ traffic per peer.  Rates use the same windowed-delta scheme.
 """
 from __future__ import annotations
 
+import bisect
+import math
 import threading
 import time
 from collections import deque
@@ -57,6 +59,68 @@ class RateWindow:
         return (b1 - b0) / (t1 - t0)
 
 
+# latency-oriented exponential-ish bucket bounds, milliseconds
+DEFAULT_BUCKETS_MS = (
+    0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+    1000.0, 2500.0, 5000.0, 10000.0, 30000.0,
+)
+
+
+class Histogram:
+    """Fixed-bucket Prometheus-style histogram with percentile estimation.
+
+    NOT internally locked — Counters serializes every write/read under its
+    single lock (the same discipline the RateWindow tables use), so the
+    histogram itself stays a plain counting structure.
+    """
+
+    def __init__(self, bounds: Tuple[float, ...] = DEFAULT_BUCKETS_MS):
+        self.bounds = tuple(float(b) for b in bounds)
+        self.counts = [0] * (len(self.bounds) + 1)  # +1: the +Inf bucket
+        self.sum = 0.0
+        self.count = 0
+        self.max = 0.0
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        self.counts[bisect.bisect_left(self.bounds, value)] += 1
+        self.sum += value
+        self.count += 1
+        if value > self.max:
+            self.max = value
+
+    def cumulative(self) -> List[Tuple[str, int]]:
+        """[(le, cumulative_count)] including the "+Inf" row."""
+        out: List[Tuple[str, int]] = []
+        cum = 0
+        for b, c in zip(self.bounds, self.counts):
+            cum += c
+            out.append((f"{b:g}", cum))
+        out.append(("+Inf", cum + self.counts[-1]))
+        return out
+
+    def percentile(self, p: float) -> Optional[float]:
+        """Nearest-rank percentile, linearly interpolated inside the
+        containing bucket; the open +Inf bucket is bounded by the observed
+        max.  None with no observations."""
+        if self.count == 0:
+            return None
+        rank = max(1, math.ceil(min(max(p, 0.0), 1.0) * self.count))
+        cum = 0
+        for i, c in enumerate(self.counts):
+            if c == 0:
+                continue
+            if cum + c >= rank:
+                lo = self.bounds[i - 1] if i > 0 else 0.0
+                hi = self.bounds[i] if i < len(self.bounds) else self.max
+                hi = min(hi, self.max) if self.max > 0 else hi
+                if hi <= lo:
+                    return lo
+                return lo + (hi - lo) * (rank - cum) / c
+            cum += c
+        return self.max  # pragma: no cover - unreachable (counts sum to count)
+
+
 class Counters:
     """Named egress/ingress accumulators with Prometheus-text exposition."""
 
@@ -74,6 +138,10 @@ class Counters:
         # heals, worker_restarts, preemptions) + gauges (heal_mttr_s)
         self._events: Dict[str, int] = {}
         self._gauges: Dict[str, float] = {}
+        # latency histograms keyed (metric, label): ("step_latency_ms", "")
+        # or ("collective_latency_ms", "grad-allreduce").  All writes/reads
+        # go through the single Counters lock.
+        self._hists: Dict[Tuple[str, str], Histogram] = {}
 
     def _get(self, table: Dict[str, RateWindow], key: str) -> RateWindow:
         w = table.get(key)
@@ -132,6 +200,43 @@ class Counters:
         """Record the last observed value of a named gauge (e.g. heal MTTR)."""
         with self._lock:
             self._gauges[key] = float(value)
+
+    def observe_hist(self, metric: str, value: float, label: str = "") -> None:
+        """One histogram observation (e.g. a step/collective latency, ms)."""
+        with self._lock:
+            h = self._hists.get((metric, label))
+            if h is None:
+                h = self._hists[(metric, label)] = Histogram()
+            h.observe(value)
+
+    def hist_percentile(self, metric: str, p: float, label: str = "") -> Optional[float]:
+        with self._lock:
+            h = self._hists.get((metric, label))
+            return None if h is None else h.percentile(p)
+
+    def hist_summaries(self) -> Dict[str, Dict[str, Dict[str, float]]]:
+        """{metric: {label: {count, sum, p50, p99}}} snapshot."""
+        with self._lock:
+            out: Dict[str, Dict[str, Dict[str, float]]] = {}
+            for (metric, label), h in self._hists.items():
+                out.setdefault(metric, {})[label] = {
+                    "count": h.count,
+                    "sum": round(h.sum, 3),
+                    "p50": h.percentile(0.50),
+                    "p99": h.percentile(0.99),
+                }
+            return out
+
+    def reset_for_reinit(self) -> None:
+        """Drop per-incarnation distributions after a heal re-rendezvous:
+        rate windows and latency histograms measured against the old cluster
+        would pollute the new world's throughput/interference signals.
+        Lifecycle event counts and gauges (heals, mttr) survive — they
+        describe the job, not one incarnation."""
+        with self._lock:
+            for table in (self._egress, self._ingress, self._logical, self._wire):
+                table.clear()
+            self._hists.clear()
 
     def events(self) -> Dict[str, int]:
         with self._lock:
@@ -192,6 +297,23 @@ class Counters:
             lines.append("# TYPE kungfu_gauge gauge")
             for key in sorted(ga):
                 lines.append(f'kungfu_gauge{{name="{key}"}} {ga[key]}')
+        with self._lock:
+            # snapshot under the lock, render outside it
+            hists = [
+                (metric, label, h.cumulative(), h.sum, h.count)
+                for (metric, label), h in sorted(self._hists.items())
+            ]
+        seen_types = set()
+        for metric, label, cum, hsum, hcount in hists:
+            if metric not in seen_types:
+                seen_types.add(metric)
+                lines.append(f"# TYPE {metric} histogram")
+            lab = f'op="{label}",' if label else ""
+            for le, c in cum:
+                lines.append(f'{metric}_bucket{{{lab}le="{le}"}} {c}')
+            sl = f'{{op="{label}"}}' if label else ""
+            lines.append(f"{metric}_sum{sl} {round(hsum, 3)}")
+            lines.append(f"{metric}_count{sl} {hcount}")
         return "\n".join(lines) + "\n"
 
 

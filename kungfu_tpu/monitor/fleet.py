@@ -1,0 +1,305 @@
+"""Launcher-side fleet telemetry aggregator.
+
+Per-worker endpoints (monitor.server) answer for one rank; pod-scale
+debugging needs the merged view — "Scale MLPerf-0.6 models on Google TPU-v3
+Pods" calls the merged cross-host timeline the difference between debugging
+and guessing.  This module gives the launcher (`kungfu-run -telemetry`) a
+single endpoint over the whole job:
+
+  /metrics   every worker's Prometheus text merged: counters (and histogram
+             components) SUMMED across ranks, gauges aggregated as
+             min/max/avg — each series also broken out per rank with a
+             `rank="N"` label.  The summed series carry exactly the
+             per-worker names/labels, so a fleet counter always equals the
+             sum of the worker endpoints it scraped.
+  /timeline  every worker's /trace buffer merged into ONE Chrome trace,
+             each rank in its own process lane (pid = rank).
+  /ranks     JSON scrape status per rank (reachable, error, url).
+
+Scrapes happen on demand per request — the aggregator holds no state
+between requests beyond the scrape-error counter, so a healed/resized
+cluster is picked up by the next request via `targets_fn`.
+"""
+from __future__ import annotations
+
+import json
+import re
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Sequence, Tuple
+
+from ..utils import get_logger
+from .server import monitor_port
+
+log = get_logger("kungfu.fleet")
+
+# rank -> base URL of that worker's monitor endpoint
+Targets = List[Tuple[int, str]]
+
+_SERIES_RE = re.compile(r"^([A-Za-z_:][\w:]*)(?:\{([^}]*)\})?\s+(\S+)$")
+_LABEL_RE = re.compile(r'(\w+)="([^"]*)"')
+
+
+def parse_prometheus(text: str) -> Tuple[Dict[str, str], Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float]]:
+    """(types, series) from one exposition body.
+
+    types: metric name -> kind from `# TYPE` lines.
+    series: (name, sorted-label-tuple) -> value.
+    """
+    types: Dict[str, str] = {}
+    series: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                types[parts[2]] = parts[3]
+            continue
+        m = _SERIES_RE.match(line)
+        if not m:
+            continue
+        name, rawlabels, value = m.groups()
+        try:
+            v = float(value)
+        except ValueError:
+            continue
+        labels = tuple(sorted(_LABEL_RE.findall(rawlabels or "")))
+        series[(name, labels)] = v
+    return types, series
+
+
+def _series_kind(name: str, types: Dict[str, str]) -> str:
+    """counter | gauge | histogram-component for one series name."""
+    if name in types:
+        return types[name]
+    for suffix in ("_bucket", "_sum", "_count"):
+        if name.endswith(suffix) and types.get(name[: -len(suffix)]) == "histogram":
+            return "counter"  # histogram components merge by summation
+    return "gauge"
+
+
+def _fmt(v: float) -> str:
+    return str(int(v)) if float(v).is_integer() else repr(round(v, 6))
+
+
+def _series_sort_key(key):
+    """Stable output order; histogram `le` labels sort numerically so
+    bucket series stay ascending (what downstream scrapers expect)."""
+    name, labels = key
+
+    def lab_key(kv):
+        k, v = kv
+        if k == "le":
+            try:
+                return (k, float("inf") if v == "+Inf" else float(v), "")
+            except ValueError:
+                return (k, float("inf"), v)
+        return (k, 0.0, v)
+
+    return (name, tuple(lab_key(kv) for kv in labels))
+
+
+def merge_prometheus(texts: Dict[int, str]) -> str:
+    """Merge per-rank exposition bodies into the fleet body.
+
+    Counters keep their exact per-worker name+labels with the SUM across
+    ranks as the value (the fleet counter == sum of worker counters), plus
+    a per-rank breakdown with an added rank label.  Gauges get agg="min/
+    max/avg" series plus the per-rank breakdown.
+    """
+    types: Dict[str, str] = {}
+    # (name, labels) -> {rank: value}
+    merged: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], Dict[int, float]] = {}
+    for rank, text in texts.items():
+        t, series = parse_prometheus(text)
+        types.update(t)
+        for key, v in series.items():
+            merged.setdefault(key, {})[rank] = v
+
+    lines: List[str] = []
+    lines.append("# TYPE kungfu_fleet_ranks_scraped gauge")
+    for rank in sorted(texts):
+        lines.append(f'kungfu_fleet_ranks_scraped{{rank="{rank}"}} 1')
+
+    emitted_types = set()
+    for (name, labels) in sorted(merged, key=_series_sort_key):
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and types.get(name[: -len(suffix)]) == "histogram":
+                base = name[: -len(suffix)]
+        if base not in emitted_types:
+            emitted_types.add(base)
+            lines.append(f"# TYPE {base} {types.get(base, 'gauge')}")
+        per_rank = merged[(name, labels)]
+        lab = ",".join(f'{k}="{v}"' for k, v in labels)
+        kind = _series_kind(name, types)
+        if kind in ("counter", "histogram"):
+            total = sum(per_rank.values())
+            lines.append(f"{name}{{{lab}}} {_fmt(total)}" if lab
+                         else f"{name} {_fmt(total)}")
+        else:
+            vals = list(per_rank.values())
+            for agg, v in (("min", min(vals)), ("max", max(vals)),
+                           ("avg", sum(vals) / len(vals))):
+                al = f'{lab},agg="{agg}"' if lab else f'agg="{agg}"'
+                lines.append(f"{name}{{{al}}} {_fmt(v)}")
+        for rank in sorted(per_rank):
+            rl = f'{lab},rank="{rank}"' if lab else f'rank="{rank}"'
+            lines.append(f"{name}{{{rl}}} {_fmt(per_rank[rank])}")
+    return "\n".join(lines) + "\n"
+
+
+def merge_chrome_traces(traces: Sequence[Tuple[Any, str, Dict[str, Any]]]) -> Dict[str, Any]:
+    """One merged Chrome trace from per-process exports.
+
+    traces: (pid, lane_name, chrome_trace_dict) triples — each source's
+    events are re-homed onto its pid so every rank gets its own process
+    lane in Perfetto; the sources' own process_name metadata is replaced.
+    """
+    events: List[Dict[str, Any]] = []
+    other: Dict[str, Any] = {}
+    for pid, lane, trace in traces:
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "tid": 0, "args": {"name": lane}})
+        sort = pid if isinstance(pid, int) else len(other)
+        events.append({"name": "process_sort_index", "ph": "M", "pid": pid,
+                       "tid": 0, "args": {"sort_index": sort}})
+        for ev in trace.get("traceEvents", []):
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                continue
+            ev = dict(ev)
+            ev["pid"] = pid
+            events.append(ev)
+        if trace.get("otherData"):
+            other[str(pid)] = trace["otherData"]
+    return {"traceEvents": events, "displayTimeUnit": "ms", "otherData": other}
+
+
+def targets_from_workers(workers) -> Targets:
+    """PeerList -> [(rank, monitor base URL)] via the +16000 port contract."""
+    out: Targets = []
+    for rank, p in enumerate(workers):
+        out.append((rank, f"http://{p.host}:{monitor_port(p.port)}"))
+    return out
+
+
+class FleetAggregator:
+    """HTTP server merging every worker's /metrics and /trace on demand.
+
+    targets_fn is consulted per scrape, so elastic resizes/heals are
+    reflected without restarting the aggregator.
+    """
+
+    def __init__(self, targets_fn: Callable[[], Targets],
+                 host: str = "0.0.0.0", port: int = 0, timeout_s: float = 3.0):
+        self.targets_fn = targets_fn
+        self.timeout_s = timeout_s
+        self._scrape_errors = 0
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                path = self.path.rstrip("/")
+                try:
+                    if path in ("", "/metrics"):
+                        body = outer.merged_metrics().encode()
+                        ctype = "text/plain; version=0.0.4"
+                    elif path == "/timeline":
+                        body = json.dumps(outer.merged_timeline()).encode()
+                        ctype = "application/json"
+                    elif path == "/ranks":
+                        body = json.dumps(outer.rank_status()).encode()
+                        ctype = "application/json"
+                    else:
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                except Exception as e:  # noqa: BLE001 - a scrape must not kill the server
+                    body = f"fleet aggregation error: {e}".encode()
+                    self.send_response(500)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self._srv = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._srv.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True, name="kft-fleet"
+        )
+        self._closed = False
+
+    # -- scraping ---------------------------------------------------------------------
+
+    def _fetch(self, url: str) -> str:
+        with urllib.request.urlopen(url, timeout=self.timeout_s) as r:
+            return r.read().decode()
+
+    def scrape(self, path: str = "/metrics") -> Tuple[Dict[int, str], Dict[int, str]]:
+        """({rank: body}, {rank: error}) for one fan-out scrape."""
+        bodies: Dict[int, str] = {}
+        errors: Dict[int, str] = {}
+        for rank, base in self.targets_fn():
+            try:
+                bodies[rank] = self._fetch(base + path)
+            except OSError as e:
+                self._scrape_errors += 1
+                errors[rank] = str(e)
+        return bodies, errors
+
+    def merged_metrics(self) -> str:
+        bodies, errors = self.scrape("/metrics")
+        text = merge_prometheus(bodies)
+        text += "# TYPE kungfu_fleet_scrape_errors_total counter\n"
+        text += f"kungfu_fleet_scrape_errors_total {self._scrape_errors}\n"
+        for rank in sorted(errors):
+            text += f'kungfu_fleet_ranks_scraped{{rank="{rank}"}} 0\n'
+        return text
+
+    def merged_timeline(self) -> Dict[str, Any]:
+        bodies, _ = self.scrape("/trace")
+        traces = []
+        for rank in sorted(bodies):
+            try:
+                traces.append((rank, f"rank {rank}", json.loads(bodies[rank])))
+            except ValueError:
+                continue
+        return merge_chrome_traces(traces)
+
+    def rank_status(self) -> Dict[str, Any]:
+        targets = self.targets_fn()
+        bodies, errors = self.scrape("/metrics")
+        return {
+            "targets": {str(r): url for r, url in targets},
+            "reachable": sorted(bodies),
+            "errors": {str(r): e for r, e in errors.items()},
+        }
+
+    # -- lifecycle --------------------------------------------------------------------
+
+    def start(self) -> "FleetAggregator":
+        self._thread.start()
+        log.info("fleet telemetry on http://%s:%d/metrics (+ /timeline)",
+                 self.host, self.port)
+        return self
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self._thread.is_alive():
+            self._srv.shutdown()
+        self._srv.server_close()
+        if self._thread.is_alive():
+            self._thread.join(timeout=5)

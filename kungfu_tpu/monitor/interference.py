@@ -87,6 +87,10 @@ class InterferenceDetector:
         nxt = self._next_strategy()
         log.info("interference majority (%d/%d votes): switching to %s",
                  int(total), n, nxt.name)
+        from .journal import journal_event
+
+        journal_event("interference_vote", votes=int(total), size=n,
+                      old=self.session.strategy.name, new=nxt.name)
         self.session.set_strategy(nxt)
         self.session.stats.reset()
         return True

@@ -1,0 +1,186 @@
+"""Structured event journal — append-only JSONL of lifecycle events.
+
+The paper's adaptation story (heals, resizes, strategy switches, compression
+bit-width changes) used to vanish into per-worker stdout; this journal makes
+it a durable, mergeable record.  Every line is one event:
+
+    {"event": "heal", "t_wall": 1722770000.123, "t_job": 41.52,
+     "rank": 0, "cluster_version": 3, "old_size": 3, "new_size": 2,
+     "mttr_s": 1.8, "phases": {...}}
+
+Common stamps on every record:
+
+  t_wall          wall-clock seconds (epoch) — cross-host merge key ONLY
+  t_job           seconds since job start on the monotonic clock
+                  (utils.trace.job_now — NTP-step immune)
+  rank            emitting worker's rank at emission time ("launcher" for
+                  runner-side events), from the journal context
+  cluster_version cluster document version at emission time
+
+Enablement: KFT_JOURNAL_FILE names one file, or KFT_JOURNAL_DIR names a
+directory in which each process appends to its own `journal-<identity>.jsonl`
+(identity = KFT_SELF_SPEC for workers — stable across rank shifts — else a
+label set via set_journal_context, else the pid).  `kungfu-run -telemetry`
+sets the dir for the launcher and every worker.  With neither env set,
+journal_event is a no-op costing one dict lookup.
+
+Offline: read_journal / merge_journals, and `python -m kungfu_tpu.monitor
+--merge <dir>` for a dead job's files.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from ..utils import get_logger
+
+log = get_logger("kungfu.journal")
+
+JOURNAL_FILE_ENV = "KFT_JOURNAL_FILE"
+JOURNAL_DIR_ENV = "KFT_JOURNAL_DIR"
+
+# late-bound identity stamps: Peer.start()/update_cluster refresh rank and
+# cluster_version; the launcher labels itself "launcher"
+_context: Dict[str, Any] = {"rank": None, "cluster_version": None, "identity": ""}
+
+
+def set_journal_context(rank: Optional[Union[int, str]] = None,
+                        cluster_version: Optional[int] = None,
+                        identity: Optional[str] = None) -> None:
+    """Update the stamps merged into every subsequent record."""
+    if rank is not None:
+        _context["rank"] = rank
+    if cluster_version is not None:
+        _context["cluster_version"] = cluster_version
+    if identity is not None:
+        _context["identity"] = identity
+
+
+class Journal:
+    """One append-only JSONL file; every emit is flushed (events must
+    survive an os._exit two lines later)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._f = open(path, "a", encoding="utf-8")
+
+    def emit(self, event: str, **fields: Any) -> None:
+        from ..utils.trace import job_now
+
+        rec: Dict[str, Any] = {
+            "event": event,
+            "t_wall": round(time.time(), 6),
+            "t_job": round(job_now(), 4),
+            "rank": _context["rank"],
+            "cluster_version": _context["cluster_version"],
+        }
+        rec.update(fields)  # explicit fields win over context stamps
+        line = json.dumps(rec, default=str)
+        with self._lock:
+            self._f.write(line + "\n")
+            self._f.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._f.close()
+            except OSError:  # pragma: no cover
+                pass
+
+
+_global: Optional[Journal] = None
+_resolved = False
+_global_lock = threading.Lock()
+
+
+def _identity() -> str:
+    spec = os.environ.get("KFT_SELF_SPEC", "")
+    if spec:
+        return spec.replace(":", "-").replace("/", "-")
+    if _context["identity"]:
+        return str(_context["identity"])
+    return f"pid{os.getpid()}"
+
+
+def global_journal() -> Optional[Journal]:
+    """The process journal, or None when journaling is not configured."""
+    global _global, _resolved
+    if _resolved:
+        return _global
+    with _global_lock:
+        if _resolved:
+            return _global
+        path = os.environ.get(JOURNAL_FILE_ENV, "")
+        if not path:
+            d = os.environ.get(JOURNAL_DIR_ENV, "")
+            if d:
+                path = os.path.join(d, f"journal-{_identity()}.jsonl")
+        if path:
+            try:
+                _global = Journal(path)
+            except OSError as e:
+                log.warning("journal disabled (cannot open %s): %s", path, e)
+                _global = None
+        _resolved = True
+        return _global
+
+
+def journal_event(event: str, **fields: Any) -> None:
+    """Emit one lifecycle event; never raises, no-op when unconfigured."""
+    j = global_journal()
+    if j is None:
+        return
+    try:
+        j.emit(event, **fields)
+    except (OSError, ValueError) as e:  # journaling must never kill training
+        log.warning("journal emit failed: %s", e)
+
+
+def _reset_for_tests() -> None:
+    """Drop the cached journal so tests can re-resolve a fresh env."""
+    global _global, _resolved
+    with _global_lock:
+        if _global is not None:
+            _global.close()
+        _global = None
+        _resolved = False
+
+
+# -- readers ---------------------------------------------------------------------------
+
+
+def read_journal(path: str) -> List[Dict[str, Any]]:
+    """Parse one JSONL journal; malformed lines (torn writes from a killed
+    process) are skipped, not fatal."""
+    out: List[Dict[str, Any]] = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                continue
+    return out
+
+
+def merge_journals(paths: Sequence[str]) -> List[Dict[str, Any]]:
+    """Merge several processes' journals into one wall-clock-ordered list
+    (wall time is the only cross-host merge key; per-host ordering is
+    already correct within each file)."""
+    events: List[Dict[str, Any]] = []
+    for p in paths:
+        try:
+            events.extend(read_journal(p))
+        except OSError as e:
+            log.warning("skipping unreadable journal %s: %s", p, e)
+    events.sort(key=lambda e: e.get("t_wall", 0.0))
+    return events

@@ -5,10 +5,14 @@ KUNGFU_CONFIG_ENABLE_MONITORING=true.  Same contract here with KFT_* names;
 the port offset differs (16000) to stay clear of the store (+15000) and the
 jax.distributed coordinator (+20000) while remaining below the Linux
 ephemeral range.
+
+Besides /metrics the endpoint serves /trace: this worker's span ring buffer
+(utils.trace) as Chrome-trace JSON — the per-rank feed the launcher-side
+fleet aggregator (monitor.fleet) merges into one timeline.
 """
 from __future__ import annotations
 
-import os
+import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -35,25 +39,38 @@ def enabled() -> bool:
 
 
 class MonitorServer:
-    """Serves GET /metrics with the counters' Prometheus text."""
+    """Serves GET /metrics (Prometheus text) and GET /trace (Chrome-trace
+    JSON of this worker's span buffer)."""
 
     def __init__(self, counters: Optional[Counters] = None,
-                 host: str = "0.0.0.0", port: int = 0):
+                 host: str = "0.0.0.0", port: int = 0, trace_buffer=None):
         self.counters = counters if counters is not None else global_counters()
+        self.trace_buffer = trace_buffer  # None = the global span buffer
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):
-                if self.path.rstrip("/") in ("", "/metrics"):
+                path = self.path.rstrip("/")
+                if path in ("", "/metrics"):
                     body = outer.counters.prometheus_text().encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type", "text/plain; version=0.0.4")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                    ctype = "text/plain; version=0.0.4"
+                elif path == "/trace":
+                    from ..utils import trace as T
+
+                    buf = outer.trace_buffer
+                    if buf is None:
+                        buf = T.global_trace_buffer()
+                    body = json.dumps(T.export_chrome_trace(buf)).encode()
+                    ctype = "application/json"
                 else:
                     self.send_response(404)
                     self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
 
             def log_message(self, *a):  # silence default stderr spam
                 pass
@@ -61,6 +78,7 @@ class MonitorServer:
         self._srv = ThreadingHTTPServer((host, port), Handler)
         self.host, self.port = self._srv.server_address[:2]
         self._thread = threading.Thread(target=self._srv.serve_forever, daemon=True)
+        self._closed = False
 
     def start(self) -> "MonitorServer":
         self._thread.start()
@@ -68,8 +86,18 @@ class MonitorServer:
         return self
 
     def close(self) -> None:
-        self._srv.shutdown()
+        """Idempotent full shutdown: stop serving, release the socket, JOIN
+        the server thread.  The join matters on heal paths — a healed worker
+        re-binds the same monitor port, and a still-draining thread holding
+        the old socket makes the re-bind a coin flip."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._thread.is_alive():
+            self._srv.shutdown()  # only safe once serve_forever is running
         self._srv.server_close()
+        if self._thread.is_alive():
+            self._thread.join(timeout=5)
 
 
 def maybe_start_monitor(worker_port: int, host: str = "0.0.0.0") -> Optional[MonitorServer]:

@@ -125,8 +125,12 @@ class Peer:
             # never a connection error)
             self._ensure_store()
         from .monitor import maybe_start_monitor
+        from .monitor.journal import set_journal_context
 
         self._monitor = maybe_start_monitor(self.self_id.port, host=self._bind_host())
+        # journal stamps follow the CURRENT incarnation: ranks shift across
+        # resizes/heals and every event must say who emitted it *then*
+        set_journal_context(rank=self.rank, cluster_version=self.cluster_version)
         self._started = True
         log.info(
             "peer up: rank %d/%d local %d/%d hosts %d version %d",
@@ -251,10 +255,15 @@ class Peer:
             for r, p in enumerate(self.config.peers)
         ]
 
-    def close(self) -> None:
+    def close_monitor(self) -> None:
+        """Fully stop this peer's monitor endpoint (thread joined) so a
+        rebuilt/healed worker can re-bind the port without racing it."""
         if getattr(self, "_monitor", None) is not None:
             self._monitor.close()
             self._monitor = None
+
+    def close(self) -> None:
+        self.close_monitor()
         if self._store_server is not None:
             self._store_server.close()
             self._store_server = None

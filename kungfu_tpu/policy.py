@@ -91,6 +91,13 @@ class CompressionPolicy(BasePolicy):
         elif ns < self.threshold * self.hysteresis:
             target = self.uncompressed
         if target is not self.active:
+            from .monitor.journal import journal_event
+
+            journal_event(
+                "compression_switch",
+                old=self.active.scheme, new=target.scheme,
+                noise_scale=round(ns, 4), switches=self.switches + 1,
+            )
             self.active = target
             self.switches += 1
             self.switch(target)

@@ -4,13 +4,16 @@ Flag set mirrors the reference launcher (srcs/go/kungfu/runner/flags.go:28-110
 and cmd/kungfu-run/app/kungfu-run.go:18-112): -np, -H, -strategy, -w (watch),
 -k (keep), -config-server, -builtin-config-server, -logdir, -q, -timeout,
 -self/-nic discovery; TPU additions: -platform, -devices-per-worker,
--chips-per-host.
+-chips-per-host, -telemetry (fleet metrics/timeline aggregation,
+docs/observability.md).
 """
 from __future__ import annotations
 
 import argparse
+import os
 import socket
 import sys
+import time
 
 from ..elastic.config_client import ConfigClient
 from ..elastic.config_server import ConfigServer
@@ -62,6 +65,15 @@ def main(argv=None):
         help="seconds without worker heartbeat before the healer kills it "
              "(0 = disabled; catches hung-not-crashed workers)",
     )
+    ap.add_argument(
+        "-telemetry", dest="telemetry", action="store_true",
+        help="fleet telemetry: enable worker monitoring+tracing+journal and "
+             "serve merged /metrics and /timeline from this runner",
+    )
+    ap.add_argument(
+        "-telemetry-port", dest="telemetry_port", type=int, default=0,
+        help="fleet telemetry port (0 = ephemeral, printed as TELEMETRY_URL)",
+    )
     ap.add_argument("-config-server", dest="config_server", default="")
     ap.add_argument(
         "-builtin-config-server", dest="builtin_cs", action="store_true",
@@ -96,6 +108,23 @@ def main(argv=None):
     cluster = Cluster.from_hostlist(hosts, args.np)
     self_host = args.self_host or infer_self_ip(hosts)
 
+    if args.telemetry:
+        # arm the whole fleet: workers inherit these via Job.new_proc's env
+        # copy; the launcher's own journal lands next to theirs
+        os.environ.setdefault("KFT_CONFIG_ENABLE_MONITORING", "1")
+        os.environ.setdefault("KFT_CONFIG_ENABLE_TRACE", "1")
+        if not os.environ.get("KFT_JOURNAL_DIR"):
+            import tempfile
+
+            os.environ["KFT_JOURNAL_DIR"] = (
+                args.logdir or tempfile.mkdtemp(prefix="kft-telemetry-")
+            )
+        os.environ.setdefault("KFT_TRACE_DUMP_DIR", os.environ["KFT_JOURNAL_DIR"])
+        os.environ.setdefault("KFT_JOB_START", repr(time.time()))
+        from ..monitor.journal import set_journal_context
+
+        set_journal_context(rank="launcher", identity="launcher")
+
     cs = None
     config_url = args.config_server
     if args.builtin_cs or (args.watch and not config_url):
@@ -123,9 +152,12 @@ def main(argv=None):
     from .launcher import install_signal_trap
 
     install_signal_trap()
+    fleet = None
     try:
         if args.watch:
             client = ConfigClient(config_url)
+            if args.telemetry:
+                fleet = _start_fleet(args, lambda: _current_workers(client, cluster))
             runner = WatchRunner(
                 job, self_host, client, logdir=args.logdir, quiet=args.quiet,
                 keep=args.keep, heal=args.heal, restart_budget=args.restart_budget,
@@ -138,13 +170,36 @@ def main(argv=None):
                 print("RUNNER_HEAL_EVENTS: " + _json.dumps(runner.heal_events),
                       flush=True)
         else:
+            if args.telemetry:
+                fleet = _start_fleet(args, lambda: cluster.workers)
             rc = simple_run(
                 job, cluster, self_host, logdir=args.logdir, quiet=args.quiet, keep=args.keep
             )
     finally:
+        if fleet is not None:
+            fleet.close()
         if cs is not None:
             cs.stop()
     sys.exit(rc)
+
+
+def _current_workers(client: ConfigClient, initial: Cluster):
+    """Latest worker list from the config service (elastic jobs shrink and
+    grow under the aggregator), falling back to the launch-time cluster."""
+    got = client.poll_cluster()
+    return got[0].workers if got is not None else initial.workers
+
+
+def _start_fleet(args, workers_fn):
+    from ..monitor.fleet import FleetAggregator, targets_from_workers
+
+    fleet = FleetAggregator(
+        targets_fn=lambda: targets_from_workers(workers_fn()),
+        port=args.telemetry_port,
+    ).start()
+    print(f"TELEMETRY_URL: http://127.0.0.1:{fleet.port}", flush=True)
+    print(f"TELEMETRY_DIR: {os.environ.get('KFT_JOURNAL_DIR', '')}", flush=True)
+    return fleet
 
 
 if __name__ == "__main__":

@@ -20,6 +20,7 @@ from typing import Dict, List, Optional
 
 from ..elastic.config_client import ConfigClient
 from ..monitor.counters import global_counters
+from ..monitor.journal import journal_event
 from ..plan import Cluster, PeerID, PeerList
 from ..utils import get_logger
 from .job import ChipPool, Job, Proc
@@ -278,6 +279,7 @@ class WatchRunner:
         """
         counters = global_counters()
         counters.inc_event("worker_failures")
+        journal_event("worker_failure", peer=str(peer), rc=rc)
         deadline = time.monotonic() + 30.0
         while time.monotonic() < deadline:
             got = self.client.poll_cluster()
@@ -306,6 +308,9 @@ class WatchRunner:
                 "version": version + 1,
             })
             counters.inc_event("heals")
+            journal_event("heal_shrink", peer=str(peer), rc=rc,
+                          old_size=cluster.size(), new_size=shrunk.size(),
+                          cluster_version=version + 1)
             self._healed_to_zero = shrunk.size() == 0
             self._schedule_restart(peer)
             return
@@ -351,6 +356,8 @@ class WatchRunner:
             if self.client.put_cluster(regrown, version=version):
                 del self._regrow_at[peer]
                 global_counters().inc_event("worker_restarts")
+                journal_event("worker_restart", peer=str(peer),
+                              size=regrown.size(), cluster_version=version + 1)
                 log.info("RESTART: re-grew %s into the cluster (%d workers at v%d)",
                          peer, regrown.size(), version + 1)
             # CAS conflict: leave it scheduled; next tick re-reads
@@ -379,6 +386,9 @@ class WatchRunner:
                         "worker %s heartbeat stale %.1fs > %.1fs; killing it",
                         speer, age, self.heartbeat_timeout_s,
                     )
+                    journal_event("stall_kill", peer=str(speer),
+                                  age_s=round(age, 1),
+                                  timeout_s=self.heartbeat_timeout_s)
                     r.terminate(grace_s=0.5)
                     self._hb_amnesty_until = (
                         time.monotonic() + self.heartbeat_timeout_s

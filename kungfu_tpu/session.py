@@ -131,7 +131,10 @@ class Session:
 
     def set_strategy(self, strategy: Strategy) -> None:
         """Runtime strategy swap (SetGlobalStrategy analog)."""
+        from .monitor.journal import journal_event
+
         log.info("strategy swap: %s -> %s", self.strategy.name, strategy.name)
+        journal_event("strategy_switch", old=self.strategy.name, new=strategy.name)
         self.strategy = strategy
 
     def set_tree(self, forest) -> None:
@@ -290,15 +293,34 @@ class Session:
 
     def _run(self, kind: str, x: jax.Array, op: str = "sum", name: str = "",
              strategy: Optional[Strategy] = None, **kw) -> jax.Array:
+        from .utils import trace as T
+
+        nbytes = jnp.asarray(x).nbytes
+        span_args = None
+        if T.enabled():
+            # per-collective latency attribution (the fused-op papers'
+            # motivating view): op + impl/strategy + payload on every span
+            cfg = kw.get("compression")
+            span_args = {
+                "kind": kind, "op": op,
+                "impl": self._impl(strategy).name,
+                "strategy": (strategy if strategy is not None else self.strategy).name,
+                "bytes": int(nbytes), "dtype": str(jnp.asarray(x).dtype),
+            }
+            if cfg is not None and getattr(cfg, "scheme", "none") != "none":
+                span_args["compression"] = cfg.scheme
         t0 = time.perf_counter()
         with stall_detector(name or kind):
-            out = self._dispatch(kind, x, op=op, strategy=strategy, **kw)
-            out.block_until_ready()
-        nbytes = jnp.asarray(x).nbytes
-        self.stats.record(name or kind, nbytes, time.perf_counter() - t0)
+            with T.trace_scope(f"collective:{name or kind}", cat="collective",
+                               args=span_args):
+                out = self._dispatch(kind, x, op=op, strategy=strategy, **kw)
+                out.block_until_ready()
+        dt = time.perf_counter() - t0
+        self.stats.record(name or kind, nbytes, dt)
         c = self._byte_counters
         if c is not None:
             c.add_egress(name or kind, nbytes)
+            c.observe_hist("collective_latency_ms", dt * 1e3, label=name or kind)
         return out
 
     def all_reduce(self, x, op: str = "sum", name: str = "", strategy=None,
@@ -396,10 +418,17 @@ class Session:
         scheduler for (nccl/scheduler.cpp); SPMD-compiled steps never hit
         it because the order is fixed at compile time.
         """
+        from .utils import trace as T
+
         t0 = time.perf_counter()
         gname = name or "group_all_reduce"
         impl = self._impl(strategy)
-        with stall_detector(gname):
+        span = T.trace_scope(
+            f"collective:{gname}", cat="collective",
+            args={"kind": "group_all_reduce", "op": op, "impl": impl.name,
+                  "tensors": len(xs), "fuse": bool(fuse)} if T.enabled() else None,
+        )
+        with stall_detector(gname), span:
             if fuse and len(xs) > 1:
                 xs = [jnp.asarray(x) for x in xs]
                 for x in xs:
@@ -426,6 +455,7 @@ class Session:
         c = self._byte_counters
         if c is not None:
             c.add_egress(gname, total)
+            c.observe_hist("collective_latency_ms", dt * 1e3, label=gname)
         return outs
 
     def reduce(self, x, root: int = 0, op: str = "sum", name: str = ""):

@@ -1,9 +1,21 @@
 from .log import get_logger, log
 from .stall import stall_detector
 from .ema import EMA
-from .trace import trace_scope, log_event, profile_to
+from .trace import (
+    Span,
+    TraceBuffer,
+    export_chrome_trace,
+    global_trace_buffer,
+    job_now,
+    log_event,
+    profile_to,
+    record_span,
+    trace_scope,
+)
 
 __all__ = [
     "get_logger", "log", "stall_detector", "EMA",
-    "trace_scope", "log_event", "profile_to",
+    "trace_scope", "log_event", "profile_to", "record_span",
+    "Span", "TraceBuffer", "export_chrome_trace", "global_trace_buffer",
+    "job_now",
 ]

@@ -73,6 +73,13 @@ def _abort(name: str, waited_s: float, deadline_s: float) -> None:  # pragma: no
         "the supervisor can heal the cluster",
         name, waited_s, deadline_s, DEADLINE_ENV,
     )
+    try:  # journal flushes per emit, so the record survives the os._exit
+        from ..monitor.journal import journal_event
+
+        journal_event("stall_abort", op=name, waited_s=round(waited_s, 1),
+                      deadline_s=deadline_s)
+    except Exception:  # noqa: BLE001 - the abort must never be blocked
+        pass
     sys.stderr.flush()
     sys.stdout.flush()
     os._exit(STALL_ABORT_EXIT_CODE)

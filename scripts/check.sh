@@ -41,6 +41,13 @@ echo "== chaos smoke: scripted crash+heal drill (CPU) =="
 JAX_PLATFORMS=cpu python -m kungfu_tpu.chaos \
     --np 2 --plan "crash@step=5:rank=1" --total-samples 512 --timeout 180
 
+echo "== telemetry smoke: fleet aggregation + merged timeline (CPU) =="
+# 2-process run under -telemetry: fleet /metrics must merge both ranks
+# with consistent counter sums, /timeline must parse as valid Chrome trace
+# JSON with per-rank lanes, and the crash+heal plan must land in the
+# journal + a decomposed heal span (docs/observability.md)
+JAX_PLATFORMS=cpu python -m kungfu_tpu.monitor --smoke --np 2 --timeout 180
+
 echo "== tier-1 pytest =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider

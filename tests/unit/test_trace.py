@@ -1,0 +1,184 @@
+"""Span recorder (utils.trace): nesting, Chrome-trace schema, ring-buffer
+bounds, disabled-mode zero overhead, monotonic clock discipline."""
+import json
+import threading
+import time
+
+import pytest
+
+from kungfu_tpu.utils import trace as T
+
+
+@pytest.fixture(autouse=True)
+def _clean_buffer():
+    T.global_trace_buffer().clear()
+    yield
+    T.global_trace_buffer().clear()
+
+
+@pytest.fixture
+def traced(monkeypatch):
+    monkeypatch.setenv(T.ENABLE_ENV, "1")
+
+
+# -- spans + nesting -------------------------------------------------------------------
+
+
+def test_trace_scope_records_span(traced):
+    with T.trace_scope("outer", cat="test", args={"k": 1}):
+        time.sleep(0.01)
+    spans = T.global_trace_buffer().spans()
+    assert len(spans) == 1
+    s = spans[0]
+    assert s.name == "outer" and s.cat == "test" and s.args == {"k": 1}
+    assert s.dur >= 0.009
+    assert s.t_start >= 0.0  # job-relative
+
+
+def test_nested_spans_contained(traced):
+    with T.trace_scope("parent"):
+        with T.trace_scope("child"):
+            time.sleep(0.005)
+        time.sleep(0.005)
+    spans = {s.name: s for s in T.global_trace_buffer().spans()}
+    child, parent = spans["child"], spans["parent"]
+    # child closes first (inner scope), both on the same thread lane
+    assert child.tid == parent.tid
+    assert parent.t_start <= child.t_start
+    assert child.t_start + child.dur <= parent.t_start + parent.dur + 1e-6
+    assert parent.dur > child.dur
+
+
+def test_record_span_explicit_stamps(traced):
+    t0 = time.monotonic()
+    time.sleep(0.005)
+    T.record_span("manual", t0, cat="heal", args={"phase": "teardown"})
+    (s,) = T.global_trace_buffer().spans()
+    assert s.name == "manual" and s.dur >= 0.004
+
+
+def test_log_event_records_instant(traced):
+    T.log_event("milestone", detail="x")
+    (s,) = T.global_trace_buffer().spans()
+    assert s.phase == "i" and s.dur == 0.0 and s.args == {"detail": "x"}
+
+
+# -- disabled mode ---------------------------------------------------------------------
+
+
+def test_disabled_records_nothing(monkeypatch):
+    monkeypatch.delenv(T.ENABLE_ENV, raising=False)
+    with T.trace_scope("quiet"):
+        pass
+    T.record_span("quiet2", time.monotonic())
+    T.log_event("quiet3")
+    assert len(T.global_trace_buffer()) == 0
+
+
+def test_disabled_scope_is_cheap(monkeypatch):
+    """The disabled path must stay O(env lookup) — no span/dict work."""
+    monkeypatch.delenv(T.ENABLE_ENV, raising=False)
+    t0 = time.perf_counter()
+    for _ in range(2000):
+        with T.trace_scope("hot"):
+            pass
+    assert time.perf_counter() - t0 < 1.0
+    assert len(T.global_trace_buffer()) == 0
+
+
+# -- ring buffer -----------------------------------------------------------------------
+
+
+def test_buffer_bounds_drop_oldest():
+    buf = T.TraceBuffer(capacity=4)
+    for i in range(10):
+        buf.add(T.Span(f"s{i}", float(i), 0.1))
+    assert len(buf) == 4
+    assert buf.dropped == 6
+    assert [s.name for s in buf.spans()] == ["s6", "s7", "s8", "s9"]
+
+
+def test_buffer_capacity_env(monkeypatch):
+    monkeypatch.setenv(T.BUFFER_CAPACITY_ENV, "7")
+    assert T.TraceBuffer().capacity == 7
+    monkeypatch.setenv(T.BUFFER_CAPACITY_ENV, "bogus")
+    assert T.TraceBuffer().capacity == T.DEFAULT_CAPACITY
+
+
+def test_buffer_thread_safety():
+    buf = T.TraceBuffer(capacity=64)
+
+    def writer(k):
+        for i in range(200):
+            buf.add(T.Span(f"t{k}-{i}", 0.0, 0.0))
+
+    threads = [threading.Thread(target=writer, args=(k,)) for k in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(buf) == 64
+    assert buf.dropped == 4 * 200 - 64
+
+
+# -- Chrome trace schema ---------------------------------------------------------------
+
+
+def test_export_chrome_trace_schema():
+    buf = T.TraceBuffer(capacity=8)
+    buf.add(T.Span("step", 1.5, 0.25, cat="train", tid=3, args={"step": 7}))
+    buf.add(T.Span("evt", 2.0, 0.0, cat="event", phase="i"))
+    out = T.export_chrome_trace(buf, pid=2, process_name="rank 2")
+    assert json.loads(json.dumps(out)) == out  # JSON-serializable
+    evs = out["traceEvents"]
+    meta, complete, instant = evs[0], evs[1], evs[2]
+    assert meta == {"name": "process_name", "ph": "M", "pid": 2, "tid": 0,
+                    "args": {"name": "rank 2"}}
+    assert complete["ph"] == "X"
+    assert complete["ts"] == pytest.approx(1.5e6)
+    assert complete["dur"] == pytest.approx(0.25e6)
+    assert complete["pid"] == 2 and complete["tid"] == 3
+    assert complete["args"] == {"step": 7}
+    assert instant["ph"] == "i" and "dur" not in instant
+    # wall anchors ride along for offline cross-host alignment
+    assert "proc_start_wall" in out["otherData"]
+    assert "job_start_wall" in out["otherData"]
+
+
+def test_job_now_monotonic_and_anchored():
+    a = T.job_now()
+    time.sleep(0.01)
+    b = T.job_now()
+    assert b - a >= 0.009
+    # explicit stamp round-trips
+    m = time.monotonic()
+    assert T.job_now(m) == pytest.approx(T.job_now(), abs=0.05)
+
+
+def test_span_durations_survive_wall_jump(traced, monkeypatch):
+    """NTP-step immunity: spans never read time.time(), so poisoning the
+    wall clock must not corrupt a duration (the pre-fix recorder mixed
+    time.time() stamps into durations)."""
+    import kungfu_tpu.utils.trace as tr
+
+    monkeypatch.setattr(tr.time, "time", lambda: 1e12)  # absurd wall jump
+    with T.trace_scope("jumped"):
+        time.sleep(0.01)
+    (s,) = T.global_trace_buffer().spans()
+    assert 0.009 <= s.dur < 1.0
+
+
+# -- merge (fleet-side helper, exercised here at the span level) -----------------------
+
+
+def test_merge_chrome_traces_per_rank_lanes():
+    from kungfu_tpu.monitor.fleet import merge_chrome_traces
+
+    t0 = T.export_chrome_trace([T.Span("a", 0.0, 0.1)], pid=999, process_name="x")
+    t1 = T.export_chrome_trace([T.Span("b", 0.1, 0.1)], pid=999, process_name="y")
+    merged = merge_chrome_traces([(0, "rank 0", t0), (1, "rank 1", t1)])
+    pids = {e["pid"] for e in merged["traceEvents"]}
+    assert pids == {0, 1}  # re-homed lanes, original pids gone
+    lanes = {e["args"]["name"] for e in merged["traceEvents"]
+             if e.get("name") == "process_name"}
+    assert lanes == {"rank 0", "rank 1"}
