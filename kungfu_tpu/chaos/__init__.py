@@ -23,7 +23,9 @@ from .inject import (
     ChaosInjector,
     ServerChaos,
     injector_from_env,
+    maybe_crash_in_save,
     server_chaos_from_env,
+    set_launch_rank,
 )
 
 __all__ = [
@@ -35,5 +37,7 @@ __all__ = [
     "ChaosInjector",
     "ServerChaos",
     "injector_from_env",
+    "maybe_crash_in_save",
     "server_chaos_from_env",
+    "set_launch_rank",
 ]

@@ -1,35 +1,48 @@
-"""``python -m kungfu_tpu.chaos`` — scripted crash+heal smoke drill.
+"""``python -m kungfu_tpu.chaos`` — scripted failure drills.
 
-Launches a small heal-armed watch-mode job on CPU, injects the given fault
-plan, and asserts the self-healing contract end to end: the killed worker is
-removed from the cluster document, survivors resize to n-1 without restart,
-training reaches --total-samples with finite loss, and the heal event (old
-size, new size, mttr_s) appears in the worker metrics.  Exit 0 on a healthy
-heal, non-zero otherwise — the chaos stage of scripts/check.sh.
+Default mode launches a small heal-armed watch-mode job on CPU, injects the
+given fault plan, and asserts the self-healing contract end to end: the
+killed worker is removed from the cluster document, survivors resize to n-1
+without restart, training reaches --total-samples with finite loss, and the
+heal event (old size, new size, mttr_s, recovery_rung) appears in the worker
+metrics.  ``--expect-rung buddy`` additionally asserts the heal resynced
+from the in-memory tier with zero disk restores.  Exit 0 on a healthy heal,
+non-zero otherwise — the chaos stage of scripts/check.sh.
 
     python -m kungfu_tpu.chaos                    # crash@step=7:rank=2, np=3
     python -m kungfu_tpu.chaos --plan "hang@step=9:rank=1" --heartbeat-timeout 6
+
+``--ckpt-drill {corrupt,crash_in_save}`` runs the checkpoint-integrity
+drills instead (single process, two phases): phase 1 trains with the fault
+armed — post-finalize corruption of the latest step, or a primary killed
+between array commit and manifest rename — phase 2 restarts against the
+same directory and must demote the bad step (journaled) and resume from the
+prior *verified* one, never crash, never restore unverified bytes.
 """
 from __future__ import annotations
 
 import argparse
+import glob
 import json
 import os
 import re
 import subprocess
 import sys
+import tempfile
 
 from .plan import FAULT_PLAN_ENV, parse_fault_plan
 
 
 def run_drill(plan: str, np: int, total_samples: int, timeout_s: float,
-              heartbeat_timeout: float = 0.0) -> dict:
+              heartbeat_timeout: float = 0.0, checkpoint_dir: str = "",
+              checkpoint_every: int = 0, extra_env: dict | None = None) -> dict:
     """Run one heal drill; returns a summary dict (see keys below)."""
     parse_fault_plan(plan)  # typo'd plans must fail loudly, not run fault-free
     env = dict(os.environ)
     env[FAULT_PLAN_ENV] = plan
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)
+    env.update(extra_env or {})
     cmd = [
         sys.executable, "-m", "kungfu_tpu.run", "-w", "-heal",
         "-np", str(np), "-platform", "cpu", "-port", "0",
@@ -41,6 +54,10 @@ def run_drill(plan: str, np: int, total_samples: int, timeout_s: float,
         "--", sys.executable, "-m", "kungfu_tpu.testing.fake_adaptive_trainer",
         "--total-samples", str(total_samples), "--batch-size", "32",
     ]
+    if checkpoint_dir:
+        cmd += ["--checkpoint-dir", checkpoint_dir]
+    if checkpoint_every:
+        cmd += ["--checkpoint-every", str(checkpoint_every)]
     r = subprocess.run(cmd, env=env, capture_output=True, text=True,
                        timeout=timeout_s + 60)
     out = r.stdout + r.stderr
@@ -70,6 +87,114 @@ def run_drill(plan: str, np: int, total_samples: int, timeout_s: float,
     }
 
 
+def _journal_events(journal_dir: str) -> list:
+    events = []
+    for p in sorted(glob.glob(os.path.join(journal_dir, "journal-*.jsonl"))):
+        with open(p, encoding="utf-8") as f:
+            for line in f:
+                try:
+                    events.append(json.loads(line))
+                except ValueError:
+                    continue
+    return events
+
+
+def run_ckpt_drill(kind: str, timeout_s: float = 240.0) -> int:
+    """Checkpoint-integrity drill: hurt a checkpoint, restart, and assert
+    the restore ladder demoted the bad step onto the prior verified one.
+
+    Single process, two phases against one directory (checkpoint_every=10,
+    batch 32, 1024 samples -> saves at steps 10/20/30 + final):
+
+      corrupt         phase 1 flips bytes in the latest *manifested* step
+                      at train step 25 (that's step 20) then crashes at 27,
+                      so the corrupted step is the newest on disk
+      crash_in_save   phase 1 dies between step 20's array commit and its
+                      manifest rename — a finalized-looking torn step
+
+    Phase 2 restarts with no faults and must: demote the bad step (journaled
+    ``checkpoint_demoted``), resume from step 10 (``resume`` event), train to
+    completion, exit 0.  Never crash, never restore unverified bytes.
+    """
+    total, every = 1024, 10
+    if kind == "corrupt":
+        # corrupt step 20 once its orbax dir lands (the fault re-arms; the
+        # slow window buys the async finalize deterministic headroom), then
+        # die at 29 — BEFORE save(30) — so the corrupted step stays newest
+        plan = ("corrupt_ckpt@step=21:rank=0:ckpt_step=20;"
+                "slow@step=21:rank=0:ms=100:steps=6;"
+                "crash@step=29:rank=0")
+        # corruption surfaces as silently-wrong arrays (checksum) or a
+        # reader error (restore failed) depending on which chunk bytes the
+        # flip hit — both are demotions of a corrupt step
+        want_reasons = ("checksum mismatch", "restore failed")
+    elif kind == "crash_in_save":
+        plan = "crash_in_save@step=20:rank=0"
+        want_reasons = ("manifest missing",)
+    else:
+        raise ValueError(f"unknown ckpt drill {kind!r}")
+
+    def fail(msg: str, out: str = "") -> int:
+        print(f"CKPT DRILL FAILED ({kind}): {msg}", file=sys.stderr)
+        if out:
+            print(f"--- output tail ---\n{out[-3000:]}", file=sys.stderr)
+        return 1
+
+    with tempfile.TemporaryDirectory(prefix="kft-ckpt-drill-") as tmp:
+        ckpt_dir = os.path.join(tmp, "ckpt")
+        jdir = os.path.join(tmp, "journal")
+        cmd = [
+            sys.executable, "-m", "kungfu_tpu.testing.fake_adaptive_trainer",
+            "--total-samples", str(total), "--batch-size", "32",
+            "--checkpoint-dir", ckpt_dir, "--checkpoint-every", str(every),
+        ]
+        env = dict(os.environ, JAX_PLATFORMS="cpu", KFT_JOURNAL_DIR=jdir)
+        env.pop("XLA_FLAGS", None)
+        env.pop(FAULT_PLAN_ENV, None)
+
+        env1 = dict(env)
+        env1[FAULT_PLAN_ENV] = plan
+        r1 = subprocess.run(cmd, env=env1, capture_output=True, text=True,
+                            timeout=timeout_s)
+        if r1.returncode == 0:
+            return fail("phase 1 survived a fault plan that must kill it",
+                        r1.stdout + r1.stderr)
+
+        r2 = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                            timeout=timeout_s)
+        out2 = r2.stdout + r2.stderr
+        if r2.returncode != 0:
+            return fail(f"phase 2 exited {r2.returncode} — a bad checkpoint "
+                        "must demote, not crash the restart", out2)
+        m = re.search(r"RESULT: fake-adaptive trained=(\d+)", r2.stdout)
+        if not m or int(m.group(1)) < total:
+            return fail("phase 2 did not train to completion", out2)
+
+        events = _journal_events(jdir)
+        if kind == "corrupt":
+            fired = [e for e in events if e.get("event") == "chaos_corrupt_ckpt"]
+            if not fired:
+                return fail("the corrupt_ckpt fault never fired (no "
+                            "chaos_corrupt_ckpt journal event)", out2)
+        demoted = [e for e in events if e.get("event") == "checkpoint_demoted"
+                   and any(w in str(e.get("reason", "")) for w in want_reasons)]
+        if not demoted:
+            return fail(f"no checkpoint_demoted event with reason "
+                        f"~{want_reasons} in the journal", out2)
+        resumes = [e for e in events if e.get("event") == "resume"]
+        if not resumes:
+            return fail("no resume journal event (phase 2 started fresh?)", out2)
+        bad_step = max(e["step"] for e in demoted)
+        resumed_from = resumes[-1].get("ckpt_step")
+        if resumed_from is None or resumed_from >= bad_step:
+            return fail(f"resume landed on step {resumed_from}, not a step "
+                        f"older than the demoted {bad_step}", out2)
+        print(f"CKPT DRILL OK ({kind}): step {bad_step} demoted "
+              f"({demoted[-1]['reason']}), resumed from verified step "
+              f"{resumed_from}, retrained to {m.group(1)} samples")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="kungfu_tpu.chaos")
     ap.add_argument("--plan", default="crash@step=7:rank=2")
@@ -78,10 +203,32 @@ def main(argv=None) -> int:
     ap.add_argument("--timeout", type=float, default=240.0)
     ap.add_argument("--heartbeat-timeout", type=float, default=0.0,
                     help="arm launcher hang detection (needed for hang@ plans)")
+    ap.add_argument("--checkpoint-dir", default="",
+                    help="durable checkpoint dir for the workers")
+    ap.add_argument("--checkpoint-every", type=int, default=0)
+    ap.add_argument("--buddy", choices=("on", "off"), default="on",
+                    help="off sets KFT_BUDDY=0: disable the in-memory "
+                         "recovery tier so heals exercise the disk rung")
+    ap.add_argument("--expect-rung", choices=("buddy", "disk", "any"),
+                    default="any",
+                    help="assert the heal's recovery_rung (buddy implies "
+                         "zero disk restores — the ladder only reads disk "
+                         "after the RAM tier is exhausted)")
+    ap.add_argument("--ckpt-drill", choices=("corrupt", "crash_in_save"),
+                    default="",
+                    help="run a checkpoint-integrity drill instead of the "
+                         "crash+heal smoke")
     args = ap.parse_args(argv)
 
+    if args.ckpt_drill:
+        return run_ckpt_drill(args.ckpt_drill, timeout_s=args.timeout)
+
+    extra_env = {"KFT_BUDDY": "0"} if args.buddy == "off" else None
     summary = run_drill(args.plan, args.np, args.total_samples, args.timeout,
-                        heartbeat_timeout=args.heartbeat_timeout)
+                        heartbeat_timeout=args.heartbeat_timeout,
+                        checkpoint_dir=args.checkpoint_dir,
+                        checkpoint_every=args.checkpoint_every,
+                        extra_env=extra_env)
 
     def fail(msg: str) -> int:
         tail = summary["output"][-3000:]
@@ -100,7 +247,10 @@ def main(argv=None) -> int:
             return fail(f"trained {res['trained']} < {args.total_samples}")
         if not math.isfinite(res["loss"]):
             return fail(f"non-finite final loss {res['loss']}")
-    worker_faults = parse_fault_plan(args.plan).worker_faults()
+    # corrupt_ckpt is a worker fault but hurts only the disk artifact —
+    # it never provokes a heal on its own
+    worker_faults = [f for f in parse_fault_plan(args.plan).worker_faults()
+                     if f.kind in ("crash", "hang", "slow")]
     if worker_faults:
         if not summary["runner_heal_events"]:
             return fail("no RUNNER_HEAL_EVENTS from the healer")
@@ -109,8 +259,15 @@ def main(argv=None) -> int:
             return fail("no worker heal event with mttr_s")
         if not all(r["final_size"] == args.np - 1 for r in summary["results"]):
             return fail(f"survivors not at n-1={args.np - 1}")
+        if args.expect_rung != "any":
+            rungs = {e.get("recovery_rung") for e in ev}
+            if rungs != {args.expect_rung}:
+                return fail(f"expected recovery_rung={args.expect_rung}, "
+                            f"heal events show {sorted(rungs)}")
         print("CHAOS DRILL OK: healed "
               f"{ev[0]['old_size']} -> {ev[0]['new_size']} workers, "
+              f"rung={ev[0].get('recovery_rung')}/"
+              f"{ev[0].get('recovery_source')}, "
               f"mttr_s={ev[0]['mttr_s']}, final loss "
               f"{summary['results'][0]['loss']:.4f}")
     else:

@@ -1,16 +1,22 @@
 """Fault injectors — where the declarative plan meets the running system.
 
-Two injection points:
+Three injection points:
 
   ChaosInjector.on_step   called at the top of every elastic training step
-                          (elastic/trainer.py) — crashes, hangs and slowdowns
-                          fire here, keyed on (step, rank), so multi-process
+                          (elastic/trainer.py) — crashes, hangs, slowdowns
+                          and checkpoint corruption (`corrupt_ckpt`) fire
+                          here, keyed on (step, rank), so multi-process
                           tests replay each failure mode deterministically.
+  maybe_crash_in_save     called by the checkpoint manager between the orbax
+                          array commit and the manifest rename — the
+                          `crash_in_save` fault kills the primary exactly in
+                          the window that leaves a torn (manifest-less) step.
   ServerChaos.should_503  called per request by the config server — models a
                           control-plane outage window (the `flap` fault).
 
-Both are built from the same KFT_FAULT_PLAN env contract; a process with no
-plan pays nothing (injector_from_env returns None).
+All are built from the same KFT_FAULT_PLAN env contract; a process with no
+plan pays nothing (injector_from_env returns None, maybe_crash_in_save is a
+cached no-op).
 """
 from __future__ import annotations
 
@@ -40,11 +46,22 @@ class ChaosInjector:
         self._sleep = sleep_fn
         self._fired: Set[Fault] = set()  # one-shot kinds already triggered
 
-    def on_step(self, step: int, rank: int) -> None:
+    def on_step(self, step: int, rank: int, ckpt_dir: str = "") -> None:
         """Fire any fault scheduled for this (step, rank).  Crash and hang
-        are one-shot; slow applies per step across its window."""
+        are one-shot; slow applies per step across its window; corrupt_ckpt
+        re-arms until it finds a finalized target in `ckpt_dir`."""
         for f in self.plan.worker_faults():
             if f in self._fired or not f.matches(step, rank):
+                continue
+            if f.kind == "corrupt_ckpt":
+                target = _corrupt_checkpoint(ckpt_dir, f.ckpt_step)
+                if target is not None:
+                    self._fired.add(f)
+                    log.warning("CHAOS: corrupted checkpoint step %d under %s "
+                                "(train step %d rank %d)", target, ckpt_dir,
+                                step, rank)
+                    self._journal("chaos_corrupt_ckpt", step, rank,
+                                  ckpt_step=target)
                 continue
             if f.kind == "crash":
                 self._fired.add(f)
@@ -82,6 +99,109 @@ def injector_from_env() -> Optional[ChaosInjector]:
         return None
     log.info("fault plan armed: %s", ", ".join(f.kind for f in plan.worker_faults()))
     return ChaosInjector(plan)
+
+
+# -- checkpoint-integrity faults -------------------------------------------------------
+
+
+def _corrupt_checkpoint(ckpt_dir: str, ckpt_step: int = -1) -> Optional[int]:
+    """Flip 64 bytes mid-file in every array payload chunk of a finalized,
+    *manifested* checkpoint step (post-finalize bit rot, the corrupt_ckpt
+    fault).  Returns the corrupted step, or None when no target exists yet
+    (the fault re-arms).  ckpt_step=-1 targets the latest manifested step —
+    "manifested" because the fault models corruption AFTER a fully committed
+    save, not a race with the writer.
+
+    Every ocdbt ``d/`` chunk is hit because tensorstore keeps duplicate
+    payload copies (per-process dir + merged dir) — flipping only one copy
+    can be silently absorbed by the read path, which would make the drill
+    assert against a corruption that never happened.  Depending on which
+    bytes a chunk holds the damage surfaces as silently-wrong arrays (caught
+    by the manifest checksums) or a reader error (caught by the demote-on-
+    restore-failure path); both are real corruption outcomes.
+
+    "Finalized" means the orbax step directory exists (its appearance is an
+    atomic rename, so presence == arrays committed); the integrity manifest
+    may trail it by a step under async saves and is not required here.
+    """
+    if not ckpt_dir or not os.path.isdir(ckpt_dir):
+        return None
+    candidates = []
+    for name in os.listdir(ckpt_dir):
+        if not name.isdigit():
+            continue
+        if os.path.isdir(os.path.join(ckpt_dir, name, "state")):
+            candidates.append(int(name))
+    if ckpt_step >= 0:
+        if ckpt_step not in candidates:
+            return None
+        target = ckpt_step
+    elif candidates:
+        target = max(candidates)
+    else:
+        return None
+    state_root = os.path.join(ckpt_dir, str(target), "state")
+    victims = []
+    for root, _, fs in os.walk(state_root):
+        if os.path.basename(root) == "d":  # ocdbt payload chunk dirs
+            victims.extend(os.path.join(root, f) for f in fs)
+    victims = [f for f in victims if os.path.getsize(f) > 0]
+    if not victims:  # layout drift: fall back to the largest file
+        files = [os.path.join(r, f) for r, _, fs in os.walk(state_root) for f in fs]
+        files = [f for f in files if os.path.getsize(f) > 0]
+        if not files:
+            return None
+        victims = [max(files, key=os.path.getsize)]
+    for victim in victims:
+        size = os.path.getsize(victim)
+        span = min(64, size)
+        with open(victim, "r+b") as f:
+            f.seek((size - span) // 2)
+            data = f.read(span)
+            f.seek(-len(data), 1)
+            f.write(bytes(b ^ 0xFF for b in data))
+    return target
+
+
+# crash_in_save state: the checkpoint manager has no rank/injector plumbing,
+# so the save-path hook resolves its own plan from env (cached) and the
+# elastic loop registers the process's LAUNCH rank once at startup.
+_launch_rank = 0
+_save_faults: Optional[tuple] = None
+_save_fired: Set[Fault] = set()
+_crash_exit = os._exit  # injectable for unit tests
+
+
+def set_launch_rank(rank: int) -> None:
+    """Record this process's launch rank for save-path fault matching."""
+    global _launch_rank
+    _launch_rank = int(rank)
+
+
+def maybe_crash_in_save(ckpt_step: int) -> None:
+    """The crash_in_save hook: called by CheckpointManager between the orbax
+    array commit for `ckpt_step` and the manifest rename.  Kills the process
+    (os._exit) when the plan schedules it — leaving a finalized-looking but
+    manifest-less (torn) step for the restore ladder to demote."""
+    global _save_faults
+    if _save_faults is None:
+        _save_faults = plan_from_env().save_faults()
+    for f in _save_faults:
+        if f in _save_fired or f.step != int(ckpt_step) or f.rank != _launch_rank:
+            continue
+        _save_fired.add(f)
+        log.warning("CHAOS: crash_in_save at checkpoint step %d (exit %d) — "
+                    "arrays committed, manifest NOT renamed", ckpt_step, f.code)
+        ChaosInjector._journal("chaos_crash_in_save", ckpt_step, _launch_rank,
+                               code=f.code)
+        _crash_exit(f.code)
+
+
+def _reset_save_faults_for_tests() -> None:
+    global _save_faults, _launch_rank
+    _save_faults = None
+    _launch_rank = 0
+    _save_fired.clear()
 
 
 class ServerChaos:

@@ -20,12 +20,26 @@ Kinds (see docs/fault_tolerance.md for the full grammar):
                                     request (default N=5) — a control-plane
                                     outage window
 
+Checkpoint-integrity faults (docs/fault_tolerance.md, recovery ladder):
+
+  corrupt_ckpt@step=N:rank=R[:ckpt_step=S]
+                                    at training step >= N, worker R flips
+                                    bytes in the arrays of finalized
+                                    checkpoint step S (default: the latest
+                                    manifested step) — post-finalize bit
+                                    rot; re-arms until a target exists
+  crash_in_save@step=S:rank=R[:code=C]
+                                    worker R os._exit(C)s while finalizing
+                                    checkpoint step S, BETWEEN the array
+                                    commit and the manifest rename (default
+                                    code 43) — the torn-step shape
+
 Durations accept a trailing "s" or "ms" ("3s", "250ms", bare numbers are
 seconds).  Ranks refer to the worker's LAUNCH rank (its rank when the
 process first joined), not its current rank — current ranks shift when the
 cluster heals or resizes, and a drill's scripted victim must stay the same
 process for the replay to be deterministic.  Every fault fires at most once
-except `slow`, which is a window.
+except `slow` (a window) and `corrupt_ckpt` (re-arms until it corrupts).
 """
 from __future__ import annotations
 
@@ -35,8 +49,9 @@ from typing import List, Optional, Tuple
 
 FAULT_PLAN_ENV = "KFT_FAULT_PLAN"
 
-_KINDS = ("crash", "hang", "slow", "flap")
+_KINDS = ("crash", "hang", "slow", "flap", "corrupt_ckpt", "crash_in_save")
 DEFAULT_CRASH_CODE = 41
+DEFAULT_CRASH_IN_SAVE_CODE = 43
 DEFAULT_FLAP_AFTER = 5
 
 
@@ -63,6 +78,7 @@ class Fault:
     steps: int = 0                  # slow: window length; 0 = until end
     duration_s: float = 0.0         # flap: outage window
     after: int = DEFAULT_FLAP_AFTER  # flap: requests served before outage
+    ckpt_step: int = -1             # corrupt_ckpt: target step; -1 = latest
 
     def matches(self, step: int, rank: int) -> bool:
         """True when a worker-side fault fires at (step, rank)."""
@@ -70,6 +86,10 @@ class Fault:
             hi = self.step + self.steps if self.steps else None
             in_window = step >= self.step and (hi is None or step < hi)
             return in_window and rank == self.rank
+        if self.kind == "corrupt_ckpt":
+            # re-arms: a finalized+manifested target may not exist yet at
+            # step N under async saves — keep trying until one does
+            return step >= self.step and rank == self.rank
         return step == self.step and rank == self.rank
 
 
@@ -104,6 +124,12 @@ def _parse_one(spec: str) -> Fault:
         f["code"] = int(kv.pop("code", DEFAULT_CRASH_CODE))
         if f["code"] == 0:
             raise ValueError(f"crash code must be non-zero: {spec!r}")
+    elif kind == "crash_in_save":
+        f["code"] = int(kv.pop("code", DEFAULT_CRASH_IN_SAVE_CODE))
+        if f["code"] == 0:
+            raise ValueError(f"crash_in_save code must be non-zero: {spec!r}")
+    elif kind == "corrupt_ckpt":
+        f["ckpt_step"] = int(kv.pop("ckpt_step", -1))
     elif kind == "hang":
         f["secs"] = _duration_s(kv.pop("secs", "0"), spec)
     elif kind == "slow":
@@ -125,7 +151,15 @@ class FaultPlan:
     faults: Tuple[Fault, ...]
 
     def worker_faults(self) -> Tuple[Fault, ...]:
-        return tuple(f for f in self.faults if f.kind in ("crash", "hang", "slow"))
+        """Faults fired from the step loop (ChaosInjector.on_step)."""
+        return tuple(
+            f for f in self.faults
+            if f.kind in ("crash", "hang", "slow", "corrupt_ckpt")
+        )
+
+    def save_faults(self) -> Tuple[Fault, ...]:
+        """Faults fired from inside the checkpoint write path."""
+        return tuple(f for f in self.faults if f.kind == "crash_in_save")
 
     def flap_faults(self) -> Tuple[Fault, ...]:
         return tuple(f for f in self.faults if f.kind == "flap")

@@ -28,15 +28,38 @@ only this process, regardless of cluster changes.  The read path
 finalized step directories and restores with plain Checkpointers.  Across a
 resize the primary must ``release()`` the manager before the distributed
 runtime is torn down and re-acquire with ``set_primary`` after re-init.
+
+Integrity (PR 5, kungfu_tpu/resilience/manifest.py): the write path computes
+a per-step manifest (per-leaf crc32 over the host bytes, pytree structure
+hash, byte sizes, cluster version) and commits it via atomic rename into the
+finalized step directory — the manifest, not the directory, is the real
+finalization marker.  ``restore`` re-checksums what orbax hands back
+(measured: a 64-byte flip in an ocdbt payload restores silently-wrong
+arrays with no error), and ``restore_latest_verified`` walks steps newest to
+oldest, demoting torn / corrupt / manifest-less ones with a journaled
+reason instead of raising mid-heal.  Write-path failures (an async flush
+error surfaces at the *next* save/wait) are caught at this boundary and
+journaled as ``checkpoint_save_failed`` — a durable-state gap is visible,
+never fatal to training.
 """
 from __future__ import annotations
 
 import os
-from typing import Any, Dict, Optional, Tuple
+import threading
+from typing import Any, Dict, List, Optional, Tuple
 
+from .monitor.journal import journal_event
 from .utils import get_logger, trace_scope
 
 log = get_logger("kungfu.checkpoint")
+
+
+def _count_event(key: str) -> None:
+    from .monitor.counters import counters_if_enabled
+
+    c = counters_if_enabled()
+    if c is not None:
+        c.inc_event(key)
 
 
 def reset_orbax_runtime_caches() -> None:
@@ -91,6 +114,10 @@ class CheckpointManager:
         self._save_interval_steps = save_interval_steps
         self._async_save = async_save
         os.makedirs(self.directory, exist_ok=True)
+        # manifests computed at save() time, committed (atomic rename into
+        # the step dir) once orbax finalizes that step — see
+        # _finalize_manifests for why the two moments differ under async
+        self._pending_manifests: Dict[int, Dict[str, Any]] = {}
         self._mgr = self._make_manager() if is_primary else None
 
     def _mp_options(self, tag: str):
@@ -132,7 +159,14 @@ class CheckpointManager:
 
     def save(self, step: int, state: Any, meta: Optional[Dict[str, Any]] = None,
              force: bool = False) -> bool:
-        """Queue an async save; returns True if a save was accepted."""
+        """Queue an async save; returns True if a save was accepted.
+
+        Failures — including an async flush error from the *previous* save,
+        which orbax surfaces here rather than where it happened — are caught
+        at this boundary: journaled as ``checkpoint_save_failed`` (with the
+        step attribution the raw exception lacks), counted, and swallowed so
+        training continues with a visible durable-state gap.
+        """
         if self._mgr is None:
             return False
         ocp = self._ocp
@@ -141,20 +175,115 @@ class CheckpointManager:
         # device arrays -> host before handing to the async writer so the
         # training loop can immediately donate/overwrite its buffers
         host_state = jax.tree.map(lambda x: jax.device_get(x), state)
+        meta = dict(meta or {})
         args = ocp.args.Composite(
             state=ocp.args.StandardSave(host_state),
-            meta=ocp.args.JsonSave(dict(meta or {})),
+            meta=ocp.args.JsonSave(meta),
         )
-        with trace_scope(f"checkpoint-save-{step}"):
-            accepted = self._mgr.save(step, args=args, force=force)
+        try:
+            with trace_scope(f"checkpoint-save-{step}"):
+                # orbax's async path drains the previous save first, so any
+                # pending step is finalized on disk once this returns — the
+                # moment its manifest can be committed
+                accepted = self._mgr.save(step, args=args, force=force)
+        except Exception as e:  # noqa: BLE001 - the manager boundary
+            self._on_save_failed(step, e)
+            return False
         if accepted:
+            from .resilience.manifest import build_manifest
+
+            self._pending_manifests[int(step)] = build_manifest(
+                step, host_state, meta=meta,
+                cluster_version=meta.get("cluster_version"),
+            )
             log.info("checkpoint step %d queued to %s", step, self.directory)
+        self._finalize_manifests(exclude=int(step))
         return bool(accepted)
 
-    def wait(self) -> None:
-        """Block until queued async saves are durable."""
-        if self._mgr is not None:
-            self._mgr.wait_until_finished()
+    def _on_save_failed(self, step: Optional[int], e: BaseException) -> None:
+        """An async flush died: surface it here (journal + counter + log),
+        not as an exception far from the cause."""
+        log.error("checkpoint save failed (step %s): %s: %s",
+                  step, type(e).__name__, str(e)[:300])
+        journal_event("checkpoint_save_failed", step=step,
+                      error=f"{type(e).__name__}: {str(e)[:300]}")
+        _count_event("checkpoint_save_failed")
+        # the failed save's manifest must never be committed
+        if step is not None:
+            self._pending_manifests.pop(int(step), None)
+
+    def _finalize_manifests(self, exclude: Optional[int] = None) -> None:
+        """Commit manifests for steps orbax has finalized on disk.
+
+        Under async checkpointing the step directory appears (atomic orbax
+        rename) strictly after save() returns, so manifests trail by one
+        drain point: the next save(), wait(), or release().  The commit is
+        itself an atomic rename — a crash between orbax's finalize and this
+        rename leaves a detectably torn (manifest-less) step, which the
+        restore ladder demotes.
+        """
+        from .resilience.manifest import write_manifest
+
+        for step in sorted(self._pending_manifests):
+            if step == exclude:
+                continue
+            if not os.path.isdir(os.path.join(self.directory, str(step))):
+                continue  # not finalized yet (or GC'd); keep pending
+            manifest = self._pending_manifests.pop(step)
+            from .chaos.inject import maybe_crash_in_save
+
+            # chaos drill hook: "crash_in_save" kills the primary HERE —
+            # arrays durable, manifest not yet renamed (the torn-step shape)
+            maybe_crash_in_save(step)
+            try:
+                write_manifest(self.directory, manifest)
+            except OSError as e:
+                self._on_save_failed(step, e)
+
+    def wait(self, deadline_s: Optional[float] = None) -> bool:
+        """Block until queued async saves are durable; returns completion.
+
+        With ``deadline_s`` the wait is bounded (the SIGTERM preemption path
+        must not let a hung flush eat the whole grace window): False means
+        the flush was still in flight when the deadline expired.  Flush
+        errors are absorbed at this boundary (journal + counter), so wait()
+        never raises for a write-side failure.
+        """
+        if self._mgr is None:
+            return True
+        try:
+            if deadline_s is None:
+                self._mgr.wait_until_finished()
+            else:
+                err: List[BaseException] = []
+
+                def _drain():
+                    try:
+                        self._mgr.wait_until_finished()
+                    except BaseException as e:  # noqa: BLE001 - reported below
+                        err.append(e)
+
+                t = threading.Thread(target=_drain, daemon=True)
+                t.start()
+                t.join(deadline_s)
+                if t.is_alive():
+                    log.warning("checkpoint flush still in flight after %.1fs "
+                                "deadline", deadline_s)
+                    return False
+                if err:
+                    raise err[0]
+        except Exception as e:  # noqa: BLE001 - the manager boundary
+            self._on_save_failed(None, e)
+            return False
+        self._finalize_manifests()
+        return True
+
+    def finalize_manifests(self) -> None:
+        """Commit manifests for any step orbax has finalized in the
+        background.  Cheap when nothing is pending — the elastic step loop
+        calls this every step so a manifest trails its arrays by about one
+        step, not a whole checkpoint interval."""
+        self._finalize_manifests()
 
     # -- elastic transitions ----------------------------------------------------------
 
@@ -163,8 +292,11 @@ class CheckpointManager:
         distributed runtime backing this process is torn down (resize or
         detach); pair with `set_primary` after re-init."""
         if self._mgr is not None:
-            self._mgr.wait_until_finished()
-            self._mgr.close()
+            self.wait()  # absorbs flush errors + commits trailing manifests
+            try:
+                self._mgr.close()
+            except Exception as e:  # noqa: BLE001 - the manager boundary
+                self._on_save_failed(None, e)
             self._mgr = None
 
     def set_primary(self, is_primary: bool) -> None:
@@ -186,8 +318,16 @@ class CheckpointManager:
         steps = self.all_steps()
         return steps[-1] if steps else None
 
-    def restore(self, step: Optional[int] = None,
-                like: Any = None) -> Tuple[Any, Dict[str, Any]]:
+    def verified_steps(self) -> List[int]:
+        """Steps carrying a readable integrity manifest (cheap check — full
+        checksum verification happens at restore)."""
+        from .resilience.manifest import read_manifest
+
+        return [s for s in self.all_steps()
+                if read_manifest(self.directory, s) is not None]
+
+    def restore(self, step: Optional[int] = None, like: Any = None,
+                verify: bool = True) -> Tuple[Any, Dict[str, Any]]:
         """Restore (state, meta); `like` is an abstract/concrete pytree
         template used to re-place arrays (pass your freshly-initialized
         state to restore onto the current topology).
@@ -195,14 +335,21 @@ class CheckpointManager:
         When `step` is omitted, the latest finalized step is read — retrying
         on a fresher step if the primary's max_to_keep garbage collection
         deletes the directory mid-read (the barrier-free read path has no
-        pin on the step it is streaming)."""
+        pin on the step it is streaming).
+
+        With ``verify`` (default), restored bytes are re-checksummed against
+        the step's manifest; a mismatch raises CheckpointIntegrityError (use
+        ``restore_latest_verified`` for the demote-and-fall-back behavior).
+        A manifest-less step restores with a warning — pre-manifest
+        directories remain readable, they just carry no integrity evidence.
+        """
         auto = step is None
         for attempt in range(3):
             s = self.latest_step() if auto else step
             if s is None:
                 raise FileNotFoundError(f"no checkpoints under {self.directory}")
             try:
-                return self._restore_step(s, like)
+                state, meta = self._restore_step(s, like)
             except FileNotFoundError:
                 if not auto or attempt == 2:
                     raise
@@ -210,7 +357,78 @@ class CheckpointManager:
                     "checkpoint step %d vanished mid-restore (GC); retrying "
                     "with the latest step", s,
                 )
+                continue
+            if verify:
+                self._verify_restored(s, state, strict=True)
+            journal_event("checkpoint_restored", step=s, verified=verify)
+            _count_event("checkpoint_restored")
+            return state, meta
         raise AssertionError("unreachable")
+
+    def _verify_restored(self, step: int, state: Any, strict: bool) -> bool:
+        """Checksum `state` against step's manifest.  strict=True raises on
+        mismatch; either mode returns False for unverifiable/corrupt."""
+        from .resilience.manifest import (
+            CheckpointIntegrityError,
+            read_manifest,
+            verify_manifest,
+        )
+
+        manifest = read_manifest(self.directory, step)
+        if manifest is None:
+            log.warning("checkpoint step %d has no integrity manifest; "
+                        "restored WITHOUT verification", step)
+            return False
+        problems = verify_manifest(manifest, state)
+        if problems:
+            msg = (f"checkpoint step {step} failed integrity verification: "
+                   + "; ".join(problems[:5]))
+            if strict:
+                raise CheckpointIntegrityError(msg)
+            log.error("%s", msg)
+            return False
+        return True
+
+    def restore_latest_verified(
+        self, like: Any = None
+    ) -> Optional[Tuple[Any, Dict[str, Any], int, List[Dict[str, Any]]]]:
+        """The disk rungs of the recovery ladder: walk steps newest to
+        oldest, return the first whose bytes verify against its manifest.
+
+        Torn, corrupt, and manifest-less steps are *demoted* — journaled
+        (``checkpoint_demoted`` with the reason) and skipped, never raised
+        mid-heal.  Returns (state, meta, step, demotions) or None when no
+        step verifies (including the empty directory).
+        """
+        from .resilience.manifest import read_manifest
+
+        demotions: List[Dict[str, Any]] = []
+
+        def demote(step: int, reason: str) -> None:
+            demotions.append({"candidate": f"step:{step}", "reason": reason})
+            journal_event("checkpoint_demoted", step=step, reason=reason)
+            _count_event("checkpoint_demoted")
+            log.warning("checkpoint step %d demoted: %s", step, reason)
+
+        for s in sorted(self.all_steps(), reverse=True):
+            if read_manifest(self.directory, s) is None:
+                demote(s, "manifest missing or unreadable (torn step)")
+                continue
+            try:
+                state, meta = self._restore_step(s, like)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:  # noqa: BLE001 - demote, never raise mid-heal
+                demote(s, f"restore failed: {type(e).__name__}: {str(e)[:160]}")
+                continue
+            if not self._verify_restored(s, state, strict=False):
+                demote(s, "checksum mismatch (corrupt arrays)")
+                continue
+            journal_event("checkpoint_restored", step=s, verified=True,
+                          demotions=len(demotions))
+            _count_event("checkpoint_restored")
+            return state, meta, s, demotions
+        return None
 
     def _restore_step(self, step: int, like: Any) -> Tuple[Any, Dict[str, Any]]:
         ocp = self._ocp

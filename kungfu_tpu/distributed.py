@@ -183,4 +183,10 @@ def teardown_distributed_runtime(graceful: bool = True) -> None:
     # healed-to-smaller rebuild believe it is still the old world size
     state.process_id = 0
     state.num_processes = 1
-    log.info("dirty distributed teardown in %.2fs", time.perf_counter() - t0)
+    dt = time.perf_counter() - t0
+    # the teardown phase of every recovery-ladder climb: journal it so a
+    # slow heal can be attributed to a wedged shutdown, not the ladder
+    from .monitor.journal import journal_event
+
+    journal_event("dirty_teardown", duration_s=round(dt, 4))
+    log.info("dirty distributed teardown in %.2fs", dt)

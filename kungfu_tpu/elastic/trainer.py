@@ -27,13 +27,19 @@ peer.go:211-222, holds by construction).
 Self-healing (docs/fault_tolerance.md): under a `-heal` launcher the loop
 also survives *unplanned* failures.  A collective that dies because a peer
 vanished (or a consensus that times out) escalates to the suspected-dead-
-peer path: checkpoint what we have, tear the backend down WITHOUT the
+peer path: pick a state source off the **recovery ladder**
+(kungfu_tpu/resilience — buddy RAM tier first: live buffers, then this
+rank's rolling snapshot, then a fetch from the buddy peer; verified disk
+steps only when RAM has nothing), tear the backend down WITHOUT the
 all-tasks barrier, wait for the healer's shrunk cluster document, and
 re-rendezvous at the new version's fenced port — training continues at the
-smaller size with at most one step of repeated work (the progress counters
-are pmax-synced).  SIGTERM is treated as a preemption notice: final
-checkpoint, self-removal from the cluster document, DETACHED announce,
-clean exit.  Failures are injectable via KFT_FAULT_PLAN (kungfu_tpu.chaos).
+smaller size.  The chosen rung/source lands on the heal event
+(`recovery_rung`, `recovery_source`) and in the counters.  SIGTERM is
+treated as a preemption notice: final checkpoint with a bounded flush wait
+(KFT_PREEMPT_FLUSH_DEADLINE_S), self-removal from the cluster document,
+DETACHED announce, clean exit.  Failures are injectable via KFT_FAULT_PLAN
+(kungfu_tpu.chaos), including checkpoint-integrity faults (corrupt_ckpt,
+crash_in_save).
 """
 from __future__ import annotations
 
@@ -355,7 +361,10 @@ def run_elastic(
     """
     import kungfu_tpu
     from ..chaos import injector_from_env
+    from ..chaos.inject import set_launch_rank
     from ..monitor.counters import global_counters
+    from ..resilience import BuddySnapshots, buddy_enabled
+    from ..resilience import ladder as _ladder
     from ..train import DataParallelTrainer, TrainState
 
     _maybe_enable_compile_cache()
@@ -388,8 +397,11 @@ def run_elastic(
     chaos = injector_from_env()
     # faults key on the LAUNCH rank: current ranks shift when the cluster
     # heals/resizes, and a drill's scripted victim must stay the same
-    # process for the replay to be deterministic
+    # process for the replay to be deterministic.  The save-path fault
+    # (crash_in_save) fires inside the checkpoint manager, which has no
+    # rank notion — register it once here.
     chaos_rank = peer.rank
+    set_launch_rank(chaos_rank)
     hb_file = os.environ.get("KFT_HEARTBEAT_FILE", "")
     # SIGTERM = preemption notice (TPU maintenance, spot reclaim, planned
     # kill): finish the current step, then checkpoint + detach cleanly.
@@ -495,13 +507,31 @@ def run_elastic(
         )
         if ckpt.latest_step() is not None:
             # durable resume: load on every process, then the initial sync
-            # below re-establishes bit-identical state across the cluster
+            # below re-establishes bit-identical state across the cluster.
+            # The walk is the disk half of the recovery ladder — torn /
+            # corrupt / manifest-less steps are demoted with a journaled
+            # reason and the next older verified step is tried; a directory
+            # with NO verified step starts fresh instead of trusting
+            # unverified bytes.
             sp0, so0 = snap(state)
-            restored, meta = ckpt.restore(like={"params": sp0, "opt": so0})
-            offset = int(meta.get("trained_samples", 0))
-            step = int(meta.get("step", 0))
-            state = trainer.place_state(restored["params"], restored["opt"], step)
-            log.info("resumed from checkpoint: step %d, %d samples", step, offset)
+            got = ckpt.restore_latest_verified(like={"params": sp0, "opt": so0})
+            if got is None:
+                log.warning(
+                    "checkpoint dir %s has steps but none verify; starting "
+                    "from scratch (see checkpoint_demoted journal events)",
+                    cfg.checkpoint_dir,
+                )
+                journal_event("checkpoint_resume_skipped",
+                              directory=cfg.checkpoint_dir)
+            else:
+                restored, meta, ckpt_step, _ = got
+                offset = int(meta.get("trained_samples", 0))
+                step = int(meta.get("step", 0))
+                state = trainer.place_state(restored["params"], restored["opt"], step)
+                journal_event("resume", step=step, trained_samples=offset,
+                              ckpt_step=ckpt_step)
+                log.info("resumed from checkpoint: step %d, %d samples "
+                         "(verified ckpt step %d)", step, offset, ckpt_step)
 
     # initial sync: identical at version 0, but a worker joining an already-
     # running cluster (spawned at version N) gets real state here
@@ -517,20 +547,31 @@ def run_elastic(
     t_start = time.time()
     metrics: Dict[str, Any] = {"loss": np.float32(np.nan)}
 
-    # last-known-good host state for the recovery path: the step whose
-    # collective died poisons its output buffers AND donated its inputs, so
-    # a live snapshot at failure time can be impossible — heal-armed jobs
-    # refresh this rolling copy every snapshot_every steps instead
+    # the buddy tier: the step whose collective died poisons its output
+    # buffers AND donated its inputs, so a live snapshot at failure time can
+    # be impossible — heal-armed jobs refresh a rolling host copy every
+    # snapshot_every steps AND ship it to a ring-offset buddy rank (another
+    # host when one exists), making the state survive any single host loss
+    # entirely in RAM.  Rebuilt on every membership change (ranks shift).
     _snapshot_every = cfg.snapshot_every or max(1, cfg.check_every)
-    _last_good: Dict[str, Any] = {}
+    buddy: Optional[BuddySnapshots] = None
 
-    def _update_last_good() -> None:
+    def _rebuild_buddy(seed: bool) -> None:
+        """(Re-)derive the buddy assignment for the CURRENT peer list; with
+        `seed`, immediately stash+ship a snapshot so the recovery ladder
+        never finds the tier empty."""
+        nonlocal buddy
+        if buddy is not None:
+            buddy.close()
+            buddy = None
         if not heal_armed:
             return
-        sp_g, so_g = snap(state)
-        _last_good.update(step=step, offset=offset, params=sp_g, opt=so_g)
+        buddy = BuddySnapshots(peer)
+        if seed and buddy_enabled():
+            sp_g, so_g = snap(state)
+            buddy.update(step, offset, sp_g, so_g)
 
-    _update_last_good()  # seed: recovery must never find it empty
+    _rebuild_buddy(seed=True)
 
     def save_ckpt(force: bool = False) -> None:
         if ckpt is None or not ckpt.writes:
@@ -538,19 +579,38 @@ def run_elastic(
         sp_c, so_c = snap(state)
         ckpt.save(step, {"params": sp_c, "opt": so_c},
                   meta={"trained_samples": offset, "step": step,
-                        "cluster_size": peer.size}, force=force)
+                        "cluster_size": peer.size,
+                        "cluster_version": peer.cluster_version}, force=force)
 
     def _detach_preempted() -> None:
         """SIGTERM path: durable checkpoint, self-removal from the cluster
         document (so survivors/healer see a *planned* detach, not a death),
         DETACHED announce, clean exit."""
         log.warning("preemption: final checkpoint + detach at step %d", step)
+        flush_completed = None
         if ckpt is not None:
+            # the flush wait is DEADLINE-BOUNDED: a hung async writer must
+            # not eat the whole preemption grace window — better to detach
+            # with a journaled durable-state gap than to be SIGKILLed
+            # mid-everything when the grace period expires
+            deadline = float(
+                os.environ.get("KFT_PREEMPT_FLUSH_DEADLINE_S", "") or 30.0
+            )
             try:
                 save_ckpt(force=True)
-                ckpt.wait()
-                ckpt.close()
+                flush_completed = ckpt.wait(deadline_s=deadline)
+                if flush_completed:
+                    ckpt.close()
+                else:
+                    # close() would re-enter the unbounded wait; leave the
+                    # daemon writer behind and let exit reap it
+                    log.warning(
+                        "preemption: checkpoint flush missed the %.0fs "
+                        "deadline; detaching with a durable-state gap",
+                        deadline,
+                    )
             except Exception as e:  # noqa: BLE001 - exit path must not throw
+                flush_completed = False
                 log.warning("preemption checkpoint failed: %s", e)
         if client is not None:
             from ..plan import Cluster as _Cluster, PeerList as _PeerList
@@ -566,7 +626,8 @@ def run_elastic(
             except OSError as e:
                 log.warning("preemption self-removal failed: %s", e)
         global_counters().inc_event("preemptions")
-        journal_event("preemption", step=step, trained_samples=offset)
+        journal_event("preemption", step=step, trained_samples=offset,
+                      flush_completed=flush_completed)
         print(f"DETACHED: preempted at step {step} ({offset} samples trained)",
               flush=True)
         sys.exit(0)
@@ -586,27 +647,42 @@ def run_elastic(
         journal_event("peer_failure_suspected", reason=type(cause).__name__,
                       detail=str(cause)[:200], step=step, old_size=old_size)
         phases: Dict[str, float] = {}
-        try:
-            # the live state is usually poisoned (its buffers' definition
-            # event is the failed collective, and the step donated its
-            # inputs) — but a consensus-side failure leaves it intact
-            snap_params, snap_opt = snap(state)
-        except Exception:  # noqa: BLE001 - poisoned buffers
+        # climb the recovery ladder: buddy RAM tier (live buffers -> own
+        # rolling snapshot -> fetch-back from the buddy peer) before any
+        # disk read; verified disk steps (newest first, torn/corrupt ones
+        # demoted) only when RAM has nothing.  Every demotion is journaled.
+        outcome = _ladder.climb(
+            live_fn=lambda: snap(state), buddy=buddy, ckpt=ckpt,
+            step=step, offset=offset,
+        )
+        if outcome is None:
+            # the job has genuinely lost its state (in-memory tier disabled
+            # or empty AND no verified checkpoint): surface the original
+            # failure rather than silently restoring unverified bytes
+            journal_event("recovery_exhausted", step=step,
+                          reason=type(cause).__name__)
+            log.critical("recovery ladder exhausted; re-raising the failure")
+            raise cause
+        snap_params, snap_opt = outcome.params, outcome.opt
+        if outcome.source != "live":
             log.warning(
-                "live state unreadable after the failure; rolling back to the "
-                "step-%d snapshot (%d samples)", _last_good["step"], _last_good["offset"],
+                "recovering from %s/%s: rolling back to step %d (%d samples)",
+                outcome.rung, outcome.source, outcome.step, outcome.offset,
             )
-            snap_params, snap_opt = _last_good["params"], _last_good["opt"]
-            step, offset = _last_good["step"], _last_good["offset"]
+        step, offset = outcome.step, outcome.offset
+        phases["state_source_s"] = outcome.elapsed_s
         if ckpt is not None:
             try:
                 # best-effort durable point for the chosen snapshot:
                 # primary-only, single-member barriers — safe to run with
-                # dead peers in the cluster
-                if ckpt.writes:
+                # dead peers in the cluster.  A disk-sourced state is
+                # already durable; re-saving it would be a wasted flush.
+                if ckpt.writes and not outcome.already_durable:
                     ckpt.save(step, {"params": snap_params, "opt": snap_opt},
                               meta={"trained_samples": offset, "step": step,
-                                    "cluster_size": peer.size}, force=True)
+                                    "cluster_size": peer.size,
+                                    "cluster_version": peer.cluster_version},
+                              force=True)
                 ckpt.release()
             except Exception as e:  # noqa: BLE001
                 log.warning("recovery checkpoint failed: %s", e)
@@ -703,13 +779,19 @@ def run_elastic(
         state = TrainState(synced["params"], synced["opt"], step)
         data = make_data(peer.rank, peer.size, offset)
         skip_check_at = step
+        # the healed membership has new ranks: re-derive the buddy ring and
+        # seed it so a back-to-back second failure still finds the RAM tier
+        _rebuild_buddy(seed=True)
         _pending_heal = {
             "version": version, "old_size": old_size, "new_size": peer.size,
             "reason": type(cause).__name__, "t_detect": t_detect,
+            "recovery_rung": outcome.rung, "recovery_source": outcome.source,
+            "recovery_demotions": len(outcome.demotions),
             "phases": dict(phases),
         }
-        log.info("recovered onto %d-worker cluster at v%d; resuming at step %d",
-                 peer.size, version, step)
+        log.info("recovered onto %d-worker cluster at v%d from %s/%s; "
+                 "resuming at step %d", peer.size, version, outcome.rung,
+                 outcome.source, step)
 
     def step_once() -> None:
         nonlocal trainer, programs, state, data, offset, step, skip_check_at
@@ -720,7 +802,8 @@ def run_elastic(
         if hb_file:
             _touch(hb_file)  # liveness signal for the healer's hang detection
         if chaos is not None:
-            chaos.on_step(step, chaos_rank)
+            # ckpt_dir arms the checkpoint-integrity faults (corrupt_ckpt)
+            chaos.on_step(step, chaos_rank, ckpt_dir=cfg.checkpoint_dir)
 
         # -- schedule-driven proposal (rank 0, reference hooks/elastic.py:14-88)
         if client is not None and schedule and peer.rank == 0:
@@ -805,6 +888,9 @@ def run_elastic(
                     state = TrainState(synced["params"], synced["opt"], step)
                     data = make_data(peer.rank, peer.size, offset)
                     skip_check_at = step
+                    # membership changed: the buddy ring is stale (ranks
+                    # shifted, peers joined/left) — re-derive and re-seed
+                    _rebuild_buddy(seed=True)
                     resizes += 1
                     resize_events.append(ev)
                     tracing.record_span("resize", m_resize0, cat="elastic",
@@ -850,9 +936,17 @@ def run_elastic(
                 heal_events.append(hev)
                 global_counters().inc_event("heals")
                 global_counters().set_gauge("heal_mttr_s", hev["mttr_s"])
+                rung = hev.get("recovery_rung")
+                if rung:
+                    # per-rung MTTR: the ladder's value proposition is the
+                    # buddy-vs-disk gap, so keep both visible in /metrics
+                    global_counters().inc_event(f"heals_rung_{rung}")
+                    global_counters().set_gauge(f"heal_mttr_{rung}_s",
+                                                hev["mttr_s"])
                 journal_event("heal", **hev)
-                log.info("healed %d -> %d workers: mttr %.2fs",
-                         hev["old_size"], hev["new_size"], hev["mttr_s"])
+                log.info("healed %d -> %d workers from %s/%s: mttr %.2fs",
+                         hev["old_size"], hev["new_size"], rung,
+                         hev.get("recovery_source"), hev["mttr_s"])
                 _pending_heal = None
         else:
             with stall_detector("elastic_train_step", force=heal_armed):
@@ -862,12 +956,18 @@ def run_elastic(
         offset += cfg.batch_size * trainer.world
         step += 1
 
-        if heal_armed and step % _snapshot_every == 0:
-            _update_last_good()
-        if ckpt is not None and ckpt.writes and step % max(1, cfg.checkpoint_every) == 0:
-            with tracing.trace_scope("step:checkpoint", cat="train",
-                                     args={"step": step}):
-                save_ckpt()
+        if buddy is not None and buddy_enabled() and step % _snapshot_every == 0:
+            sp_b, so_b = snap(state)
+            buddy.update(step, offset, sp_b, so_b)
+        if ckpt is not None and ckpt.writes:
+            if step % max(1, cfg.checkpoint_every) == 0:
+                with tracing.trace_scope("step:checkpoint", cat="train",
+                                         args={"step": step}):
+                    save_ckpt()
+            else:
+                # commit integrity manifests for async saves orbax finalized
+                # since the last drain — no-op when nothing is pending
+                ckpt.finalize_manifests()
 
     from ..monitor.counters import counters_if_enabled
 

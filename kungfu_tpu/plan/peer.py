@@ -116,6 +116,34 @@ class PeerList(tuple):
             seen.setdefault(p.host, p)
         return PeerList(seen.values())
 
+    def ring_buddies(self) -> List[int]:
+        """Ring-offset buddy assignment: buddies[r] is the rank holding rank
+        r's in-memory snapshot redundancy (kungfu_tpu/resilience/buddy.py).
+
+        For each rank the buddy is ``(r + k) % n`` for the smallest k >= 1
+        whose peer lives on a *different host* — falling back to the plain
+        k=1 ring when the cluster is single-host (CPU test shape, where host
+        disjointness is unsatisfiable).  Guarantees: never self (n > 1),
+        host-disjoint whenever more than one host exists, and deterministic
+        from the document alone so every peer computes the same assignment
+        without coordination.  Recomputed on every resize/heal (ranks shift).
+        A single peer has nobody to buddy with: buddies == [-1].
+        """
+        n = len(self)
+        if n <= 1:
+            return [-1] * n
+        multi_host = self.host_count() > 1
+        out: List[int] = []
+        for r, p in enumerate(self):
+            if multi_host:
+                k = next(
+                    k for k in range(1, n) if self[(r + k) % n].host != p.host
+                )
+            else:
+                k = 1
+            out.append((r + k) % n)
+        return out
+
     def diff(self, other: "PeerList") -> "PeerList":
         """Peers in self but not in other (order preserved)."""
         o = set(other)

@@ -1,0 +1,184 @@
+"""Buddy snapshots — peer-redundant in-memory train-state copies.
+
+The common recovery case at pod scale is a *single* worker loss, and paying
+a disk round-trip for it is the wrong tier: every rank keeps its latest
+host snapshot in RAM and additionally ships a copy to a **buddy** rank on
+another host (ring-offset assignment, PeerList.ring_buddies), so the state
+survives any single host loss entirely in memory.  On heal the recovery
+ladder (ladder.py) resyncs from this tier — a local dict read or one peer
+fetch — and only falls to disk when the RAM tier has nothing.
+
+Transport is the existing p2p blob store (kungfu_tpu/store.py): snapshots
+land in the buddy's StoreServer RAM under a single per-origin slot
+(``kft-snap:<origin host:port>``), so holding w wards costs w snapshots,
+bounded and version-free.  The payload is a pickled pytree of host numpy
+arrays — an intra-job, same-interpreter trust boundary (the store never
+crosses jobs), chosen because optimizer states are arbitrary pytrees that
+path-keyed formats cannot rebuild generically.
+
+Shipping is best-effort with a short deadline: a dead or slow buddy costs
+``ship_timeout`` once per snapshot cadence, never a training stall — the
+gap is surfaced via the ``buddy_ship_failed`` counter + journal, mirroring
+the checkpoint_save_failed contract (a durability gap must be visible, not
+fatal).  Disable the whole tier with ``KFT_BUDDY=0`` (recovery then climbs
+straight to verified disk — the bench A/B knob behind mttr_buddy_s vs
+mttr_disk_s).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..utils import get_logger
+
+log = get_logger("kungfu.resilience")
+
+SNAP_NAME_PREFIX = "kft-snap:"
+BUDDY_ENV = "KFT_BUDDY"
+DEFAULT_SHIP_TIMEOUT_S = 5.0
+
+
+def buddy_enabled() -> bool:
+    """The in-memory recovery tier is on unless KFT_BUDDY=0/false/off."""
+    return os.environ.get(BUDDY_ENV, "").lower() not in ("0", "false", "off", "no")
+
+
+def pack_snapshot(step: int, offset: int, state: Dict[str, Any],
+                  origin_rank: int, cluster_version: int) -> np.ndarray:
+    """Serialize one snapshot into a flat uint8 blob for the store."""
+    payload = {
+        "step": int(step),
+        "offset": int(offset),
+        "origin_rank": int(origin_rank),
+        "cluster_version": int(cluster_version),
+        "state": state,
+    }
+    return np.frombuffer(pickle.dumps(payload, protocol=4), dtype=np.uint8)
+
+
+def unpack_snapshot(blob: np.ndarray) -> Optional[Dict[str, Any]]:
+    """Inverse of pack_snapshot; None on any decode failure (a torn or
+    foreign blob must read as a miss, not a crash mid-heal)."""
+    try:
+        payload = pickle.loads(np.asarray(blob, dtype=np.uint8).tobytes())
+        if not isinstance(payload, dict) or "state" not in payload:
+            return None
+        return payload
+    except Exception:  # noqa: BLE001 - untrusted bytes by definition
+        return None
+
+
+class BuddySnapshots:
+    """This rank's half of the buddy protocol, bound to one cluster shape.
+
+    Owns (1) the local latest snapshot (the rolling last-known-good copy the
+    heal path rolls back to) and (2) the shipping of that snapshot to the
+    assigned buddy's store.  Rebuild after every resize/heal — the
+    assignment is a pure function of the peer list and ranks shift.
+    """
+
+    def __init__(self, peer, ship_timeout_s: float = DEFAULT_SHIP_TIMEOUT_S):
+        self.peer = peer
+        self.rank = peer.rank
+        self.buddies: List[int] = peer.config.peers.ring_buddies()
+        self.buddy_rank: int = self.buddies[self.rank] if self.buddies else -1
+        self._ship_timeout = ship_timeout_s
+        self._own: Optional[Dict[str, Any]] = None
+        self._name = f"{SNAP_NAME_PREFIX}{peer.self_id}"
+        self._client = None  # dedicated short-deadline client, lazily built
+
+    # -- write side (the step loop) ---------------------------------------------------
+
+    def update(self, step: int, offset: int, params: Any, opt: Any) -> None:
+        """Refresh the local snapshot and ship a copy to the buddy.
+
+        Called every snapshot_every steps with host (numpy) pytrees.  The
+        local copy always lands; the remote ship is best-effort under a
+        deadline and its failure is counted, not raised.
+        """
+        self._own = {
+            "step": int(step), "offset": int(offset),
+            "origin_rank": self.rank,
+            "cluster_version": self.peer.cluster_version,
+            "state": {"params": params, "opt": opt},
+        }
+        if self.buddy_rank < 0:
+            return
+        blob = pack_snapshot(step, offset, self._own["state"],
+                             self.rank, self.peer.cluster_version)
+        t0 = time.perf_counter()
+        try:
+            # dedicated short-deadline client (NOT the peer's gossip client,
+            # whose generous connect retries would stall the step loop on a
+            # dead buddy); its traffic still lands in the store:* counters
+            if self._client is None:
+                from ..store import StoreClient
+
+                self._client = StoreClient(
+                    retries=2, retry_interval=0.05,
+                    op_timeout=self._ship_timeout,
+                )
+            self._client.save(self.peer.config.peers[self.buddy_rank],
+                              self._name, blob)
+            self._count("buddy_snapshots_shipped")
+        except Exception as e:  # noqa: BLE001 - durability gap, not fatal
+            self._count("buddy_ship_failed")
+            from ..monitor.journal import journal_event
+
+            journal_event("buddy_ship_failed", step=step,
+                          buddy=self.buddy_rank, error=str(e)[:200])
+            log.warning("buddy ship to rank %d failed in %.2fs: %s",
+                        self.buddy_rank, time.perf_counter() - t0, str(e)[:200])
+
+    # -- read side (the recovery ladder) ----------------------------------------------
+
+    def latest(self) -> Optional[Dict[str, Any]]:
+        """This rank's own in-RAM snapshot (source "self")."""
+        return self._own
+
+    def fetch(self, timeout_s: float = 10.0) -> Optional[Dict[str, Any]]:
+        """Pull back the copy we shipped to our buddy (source "peer:<r>").
+
+        The path for a rank whose own RAM copy is unusable (e.g. the failure
+        raced the snapshot update): the buddy holds the bytes we shipped.
+        Miss (None) on any failure — the ladder demotes to disk.
+        """
+        if self.buddy_rank < 0:
+            return None
+        try:
+            blob = self.peer.request(
+                self.buddy_rank, self._name, wait=False, timeout=timeout_s
+            )
+        except Exception as e:  # noqa: BLE001
+            log.warning("buddy fetch from rank %d failed: %s",
+                        self.buddy_rank, str(e)[:200])
+            return None
+        if blob is None:
+            return None
+        return unpack_snapshot(blob)
+
+    def held_wards(self) -> List[str]:
+        """Origin identities whose snapshots THIS rank currently holds
+        (observability: who loses redundancy if we die)."""
+        srv = getattr(self.peer, "_store_server", None)
+        if srv is None:
+            return []
+        return [n[len(SNAP_NAME_PREFIX):] for n in srv.store.names()
+                if n.startswith(SNAP_NAME_PREFIX)]
+
+    def close(self) -> None:
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+
+    @staticmethod
+    def _count(key: str) -> None:
+        from ..monitor.counters import counters_if_enabled
+
+        c = counters_if_enabled()
+        if c is not None:
+            c.inc_event(key)

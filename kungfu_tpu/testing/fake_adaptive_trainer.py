@@ -24,6 +24,8 @@ def main(argv=None) -> int:
     ap.add_argument("--check-every", type=int, default=2)
     ap.add_argument("--checkpoint-dir", default="", help="durable checkpoint dir")
     ap.add_argument("--checkpoint-every", type=int, default=10)
+    ap.add_argument("--snapshot-every", type=int, default=0,
+                    help="buddy/rolling RAM snapshot cadence (0 = check-every)")
     args = ap.parse_args(argv)
 
     from ..elastic.trainer import ElasticConfig, run_elastic
@@ -70,6 +72,7 @@ def main(argv=None) -> int:
             check_every=args.check_every,
             checkpoint_dir=args.checkpoint_dir,
             checkpoint_every=args.checkpoint_every,
+            snapshot_every=args.snapshot_every,
         ),
     )
     mesh = out["trainer"].mesh

@@ -37,9 +37,22 @@ if [ "$fast" = "1" ]; then
     exit 0
 fi
 
-echo "== chaos smoke: scripted crash+heal drill (CPU) =="
+echo "== chaos smoke: scripted crash+heal drill (CPU, buddy-RAM rung) =="
+# --expect-rung buddy: the heal must resync from the peer-redundant
+# in-memory tier (recovery_rung=buddy journaled, zero disk restores)
 JAX_PLATFORMS=cpu python -m kungfu_tpu.chaos \
-    --np 2 --plan "crash@step=5:rank=1" --total-samples 512 --timeout 180
+    --np 2 --plan "crash@step=5:rank=1" --total-samples 512 --timeout 180 \
+    --expect-rung buddy
+
+echo "== checkpoint integrity: corrupt-step drill (CPU) =="
+# post-finalize byte flips must demote the corrupted step (journaled) and
+# the restart must land on the prior verified step, exit 0 end to end
+JAX_PLATFORMS=cpu python -m kungfu_tpu.chaos --ckpt-drill corrupt --timeout 240
+
+echo "== checkpoint integrity: crash-in-save drill (CPU) =="
+# a primary killed between array commit and manifest rename leaves a torn
+# step; the restart must demote it and resume from the verified one
+JAX_PLATFORMS=cpu python -m kungfu_tpu.chaos --ckpt-drill crash_in_save --timeout 240
 
 echo "== telemetry smoke: fleet aggregation + merged timeline (CPU) =="
 # 2-process run under -telemetry: fleet /metrics must merge both ranks

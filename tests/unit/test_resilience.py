@@ -1,0 +1,485 @@
+"""Recovery ladder subsystem (kungfu_tpu/resilience/).
+
+Fast tier: manifest round-trip + tamper detection, buddy-assignment
+invariants across resizes, snapshot pack/unpack, ladder demotion order with
+fakes, the extended chaos grammar, and the crash_in_save hook.  Slow tier
+(`slow` marker): orbax-backed torn/corrupt-step demotion, the bounded flush
+wait, and one multi-process drill asserting a worker crash heals from buddy
+RAM with zero disk restores (`faults` + `slow`).
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from kungfu_tpu.plan import PeerID, PeerList
+from kungfu_tpu.resilience import (
+    build_manifest,
+    manifest_path,
+    pack_snapshot,
+    read_manifest,
+    structure_hash,
+    unpack_snapshot,
+    verify_manifest,
+    write_manifest,
+)
+from kungfu_tpu.resilience import ladder
+
+
+def _tree(scale: float = 1.0):
+    return {
+        "params": {"w": np.full((8, 3), scale, np.float32),
+                   "b": np.zeros((3,), np.float32)},
+        "opt": (np.asarray(3, np.int32), {"m": np.full((8, 3), 0.5, np.float32)}),
+    }
+
+
+# -- manifests -------------------------------------------------------------------------
+
+
+class TestManifest:
+    def test_round_trip_and_verify_clean(self, tmp_path):
+        tree = _tree(2.0)
+        m = build_manifest(7, tree, meta={"trained_samples": 224},
+                          cluster_version=3)
+        os.makedirs(tmp_path / "7")
+        path = write_manifest(str(tmp_path), m)
+        assert path == manifest_path(str(tmp_path), 7)
+        assert not os.path.exists(path + ".tmp")  # committed atomically
+        got = read_manifest(str(tmp_path), 7)
+        assert got is not None
+        assert got["step"] == 7
+        assert got["cluster_version"] == 3
+        assert got["meta"] == {"trained_samples": 224}
+        assert got["structure"] == structure_hash(tree)
+        assert verify_manifest(got, tree) == []
+
+    def test_value_tamper_names_the_leaf(self, tmp_path):
+        tree = _tree(1.0)
+        m = build_manifest(0, tree)
+        bad = _tree(1.0)
+        bad["params"]["w"][0, 0] = 99.0
+        problems = verify_manifest(m, bad)
+        assert len(problems) == 1
+        assert "checksum mismatch" in problems[0] and "params/w" in problems[0]
+
+    def test_structure_drift_detected(self):
+        tree = _tree(1.0)
+        m = build_manifest(0, tree)
+        # dtype drift
+        bad = _tree(1.0)
+        bad["params"]["b"] = bad["params"]["b"].astype(np.float64)
+        assert any("dtype" in p for p in verify_manifest(m, bad))
+        # shape drift
+        bad2 = _tree(1.0)
+        bad2["params"]["w"] = bad2["params"]["w"][:4]
+        assert any("shape" in p for p in verify_manifest(m, bad2))
+        # missing + extra leaves
+        bad3 = _tree(1.0)
+        del bad3["params"]["b"]
+        bad3["params"]["c"] = np.zeros((1,), np.float32)
+        problems = verify_manifest(m, bad3)
+        assert any("missing" in p for p in problems)
+        assert any("unexpected" in p for p in problems)
+
+    def test_missing_or_torn_manifest_reads_none(self, tmp_path):
+        assert read_manifest(str(tmp_path), 5) is None
+        os.makedirs(tmp_path / "5")
+        with open(manifest_path(str(tmp_path), 5), "w") as f:
+            f.write('{"version": 1, "step": 5, "lea')  # torn write
+        assert read_manifest(str(tmp_path), 5) is None
+        with open(manifest_path(str(tmp_path), 5), "w") as f:
+            json.dump({"version": 99, "step": 5, "leaves": []}, f)
+        assert read_manifest(str(tmp_path), 5) is None  # foreign version
+
+    def test_structure_hash_ignores_values(self):
+        assert structure_hash(_tree(1.0)) == structure_hash(_tree(42.0))
+
+    def test_verify_is_container_representation_insensitive(self):
+        """A template-less orbax restore rebuilds namedtuple nodes (optax
+        state) as plain dicts — the manifest paths must match anyway."""
+        import collections
+
+        Trace = collections.namedtuple("TraceState", ["trace"])
+        saved = {"opt": Trace(trace={"w": np.full((4,), 2.0, np.float32)})}
+        restored = {"opt": {"trace": {"w": np.full((4,), 2.0, np.float32)}}}
+        m = build_manifest(0, saved)
+        assert verify_manifest(m, restored) == []
+        assert structure_hash(saved) == structure_hash(restored)
+
+
+# -- buddy assignment ------------------------------------------------------------------
+
+
+def _peers(*hosts):
+    counts = {}
+    out = []
+    for h in hosts:
+        counts[h] = counts.get(h, 0) + 1
+        out.append(PeerID(h, 10000 + counts[h]))
+    return PeerList(out)
+
+
+class TestBuddyAssignment:
+    def _check_invariants(self, peers):
+        buddies = peers.ring_buddies()
+        n = len(peers)
+        assert len(buddies) == n
+        for r, b in enumerate(buddies):
+            if n == 1:
+                assert b == -1
+                continue
+            assert 0 <= b < n
+            assert b != r, f"rank {r} is its own buddy"
+            if peers.host_count() > 1:
+                assert peers[b].host != peers[r].host, (
+                    f"rank {r} ({peers[r].host}) buddied on the same host"
+                )
+        return buddies
+
+    def test_single_host_ring(self):
+        assert _peers("a", "a", "a").ring_buddies() == [1, 2, 0]
+
+    def test_multi_host_is_host_disjoint(self):
+        buddies = self._check_invariants(_peers("a", "a", "b", "b"))
+        assert buddies == [2, 2, 0, 0]
+
+    def test_unbalanced_hosts(self):
+        self._check_invariants(_peers("a", "a", "a", "b"))
+        self._check_invariants(_peers("a", "b", "b", "b", "b"))
+
+    def test_across_resizes(self):
+        # the elastic shrink keeps a prefix: invariants must hold at every
+        # size the cluster can pass through, and the assignment must be a
+        # pure function of the document (recomputable without coordination)
+        full = _peers("a", "a", "b", "b", "c", "c")
+        for size in range(1, len(full) + 1):
+            shrunk = PeerList(full[:size])
+            b1 = self._check_invariants(shrunk)
+            assert b1 == PeerList(full[:size]).ring_buddies()  # deterministic
+
+    def test_n1_has_no_buddy(self):
+        assert _peers("a").ring_buddies() == [-1]
+
+
+# -- snapshot packing ------------------------------------------------------------------
+
+
+class TestSnapshotPack:
+    def test_round_trip_preserves_pytree(self):
+        import optax
+
+        params = {"w": np.arange(12, dtype=np.float32).reshape(3, 4)}
+        opt = optax.sgd(0.1, momentum=0.9).init(params)
+        blob = pack_snapshot(7, 224, {"params": params, "opt": opt}, 1, 3)
+        assert blob.dtype == np.uint8
+        got = unpack_snapshot(blob)
+        assert got["step"] == 7 and got["offset"] == 224
+        assert got["origin_rank"] == 1 and got["cluster_version"] == 3
+        np.testing.assert_array_equal(got["state"]["params"]["w"], params["w"])
+        # optax state round-trips as the same pytree type (trace momentum)
+        assert type(got["state"]["opt"]) is type(opt)
+
+    def test_garbage_blob_is_a_miss(self):
+        assert unpack_snapshot(np.zeros(16, np.uint8)) is None
+        assert unpack_snapshot(np.frombuffer(b"not a pickle", np.uint8)) is None
+
+
+# -- the ladder (with fakes) -----------------------------------------------------------
+
+
+class _FakeBuddy:
+    buddy_rank = 1
+
+    def __init__(self, own=None, fetched=None):
+        self._own, self._fetched = own, fetched
+
+    def latest(self):
+        return self._own
+
+    def fetch(self, timeout_s=10.0):
+        return self._fetched
+
+
+class _FakeCkpt:
+    def __init__(self, result=None):
+        self._result = result
+
+    def restore_latest_verified(self, like=None):
+        return self._result
+
+
+def _snap_dict(step, offset, scale):
+    return {"step": step, "offset": offset,
+            "state": {"params": {"w": np.full((2,), scale, np.float32)},
+                      "opt": ()}}
+
+
+class TestLadder:
+    def test_live_wins_when_readable(self):
+        out = ladder.climb(lambda: ("P", "O"), _FakeBuddy(), None, 9, 288)
+        assert (out.rung, out.source) == ("buddy", "live")
+        assert (out.step, out.offset) == (9, 288)
+        assert out.params == "P" and not out.already_durable
+        assert out.demotions == []
+
+    def test_poisoned_live_falls_to_self(self):
+        def boom():
+            raise ValueError("Gloo allreduce failed: Connection closed by peer")
+
+        out = ladder.climb(boom, _FakeBuddy(own=_snap_dict(6, 192, 1.0)),
+                           None, 9, 288)
+        assert (out.rung, out.source) == ("buddy", "self")
+        assert (out.step, out.offset) == (6, 192)  # rolled back
+        assert [d["candidate"] for d in out.demotions] == ["live"]
+
+    def test_missing_self_falls_to_peer_fetch(self):
+        def boom():
+            raise ValueError("poisoned")
+
+        out = ladder.climb(boom, _FakeBuddy(fetched=_snap_dict(4, 128, 2.0)),
+                           None, 9, 288)
+        assert (out.rung, out.source) == ("buddy", "peer:1")
+        assert out.step == 4
+        assert [d["candidate"] for d in out.demotions] == ["live", "self"]
+
+    def test_empty_ram_tier_falls_to_verified_disk(self):
+        def boom():
+            raise ValueError("poisoned")
+
+        ck = _FakeCkpt(({"params": "P", "opt": "O"},
+                        {"step": 3, "trained_samples": 96}, 3,
+                        [{"candidate": "step:5", "reason": "checksum mismatch"}]))
+        out = ladder.climb(boom, _FakeBuddy(), ck, 9, 288)
+        assert (out.rung, out.source) == ("disk", "step:3")
+        assert (out.step, out.offset) == (3, 96)
+        assert out.already_durable
+        # ladder demotions + the disk walk's own demotions, in order
+        assert [d["candidate"] for d in out.demotions] == [
+            "live", "self", "peer:1", "step:5",
+        ]
+
+    def test_exhausted_ladder_returns_none(self):
+        def boom():
+            raise ValueError("poisoned")
+
+        assert ladder.climb(boom, _FakeBuddy(), _FakeCkpt(None), 9, 288) is None
+        assert ladder.climb(boom, _FakeBuddy(), None, 9, 288) is None
+
+    def test_kft_buddy_0_skips_the_ram_tier(self, monkeypatch):
+        monkeypatch.setenv("KFT_BUDDY", "0")
+        live_calls = []
+
+        def live():
+            live_calls.append(1)
+            return ("P", "O")
+
+        ck = _FakeCkpt(({"params": "P", "opt": "O"},
+                        {"step": 3, "trained_samples": 96}, 3, []))
+        out = ladder.climb(live, _FakeBuddy(own=_snap_dict(6, 192, 1.0)),
+                           ck, 9, 288)
+        assert (out.rung, out.source) == ("disk", "step:3")
+        assert not live_calls  # the whole in-memory tier is disabled
+
+
+# -- chaos grammar + hooks -------------------------------------------------------------
+
+
+class TestCheckpointFaults:
+    def test_parse_corrupt_ckpt(self):
+        from kungfu_tpu.chaos import parse_fault_plan
+
+        f = parse_fault_plan("corrupt_ckpt@step=25:rank=0:ckpt_step=20").faults[0]
+        assert (f.kind, f.step, f.rank, f.ckpt_step) == ("corrupt_ckpt", 25, 0, 20)
+        # re-arms: matches any step >= its trigger
+        assert f.matches(25, 0) and f.matches(400, 0)
+        assert not f.matches(24, 0) and not f.matches(25, 1)
+        default = parse_fault_plan("corrupt_ckpt@step=5:rank=1").faults[0]
+        assert default.ckpt_step == -1
+
+    def test_parse_crash_in_save(self):
+        from kungfu_tpu.chaos import parse_fault_plan
+
+        f = parse_fault_plan("crash_in_save@step=20:rank=0").faults[0]
+        assert (f.kind, f.step, f.code) == ("crash_in_save", 20, 43)
+        plan = parse_fault_plan("crash_in_save@step=20:rank=0;crash@step=9:rank=1")
+        assert [x.kind for x in plan.save_faults()] == ["crash_in_save"]
+        assert [x.kind for x in plan.worker_faults()] == ["crash"]
+
+    @pytest.mark.parametrize("bad", [
+        "corrupt_ckpt@step=5",                 # missing rank
+        "crash_in_save@step=5:rank=0:code=0",  # must be observable
+        "corrupt_ckpt@step=5:rank=0:zork=1",   # unknown arg
+    ])
+    def test_malformed(self, bad):
+        from kungfu_tpu.chaos import parse_fault_plan
+
+        with pytest.raises(ValueError):
+            parse_fault_plan(bad)
+
+    def test_crash_in_save_hook(self, monkeypatch):
+        from kungfu_tpu.chaos import inject
+
+        inject._reset_save_faults_for_tests()
+        monkeypatch.setenv(
+            "KFT_FAULT_PLAN", "crash_in_save@step=20:rank=1:code=55"
+        )
+        exits = []
+        monkeypatch.setattr(inject, "_crash_exit", exits.append)
+        try:
+            inject.maybe_crash_in_save(20)  # launch rank 0: no match
+            assert exits == []
+            inject.set_launch_rank(1)
+            inject.maybe_crash_in_save(10)  # wrong checkpoint step
+            assert exits == []
+            inject.maybe_crash_in_save(20)
+            assert exits == [55]
+            inject.maybe_crash_in_save(20)  # one-shot
+            assert exits == [55]
+        finally:
+            inject._reset_save_faults_for_tests()
+
+    def test_corrupt_without_target_rearms(self, tmp_path):
+        from kungfu_tpu.chaos.inject import _corrupt_checkpoint
+
+        assert _corrupt_checkpoint("") is None
+        assert _corrupt_checkpoint(str(tmp_path)) is None  # no steps yet
+        # a tmp (unfinalized) orbax dir is never a target
+        os.makedirs(tmp_path / "20.orbax-checkpoint-tmp-1" / "state")
+        assert _corrupt_checkpoint(str(tmp_path)) is None
+
+
+# -- orbax-backed integration (compile/IO heavy -> slow tier) --------------------------
+
+
+@pytest.mark.slow
+class TestVerifiedRestore:
+    def _mgr(self, tmp_path, **kw):
+        from kungfu_tpu.checkpoint import CheckpointManager
+
+        return CheckpointManager(str(tmp_path / "ckpt"), **kw)
+
+    def _save(self, mgr, step, scale):
+        assert mgr.save(step, {"w": np.full((256,), scale, np.float32)},
+                        meta={"step": step, "trained_samples": step * 32})
+        mgr.wait()
+
+    def test_manifest_written_and_restore_verifies(self, tmp_path):
+        mgr = self._mgr(tmp_path)
+        self._save(mgr, 10, 1.0)
+        assert mgr.verified_steps() == [10]
+        assert os.path.isfile(manifest_path(mgr.directory, 10))
+        state, meta = mgr.restore()
+        np.testing.assert_allclose(np.asarray(state["w"]), 1.0)
+        assert meta["step"] == 10
+        mgr.close()
+
+    def test_torn_step_is_skipped(self, tmp_path):
+        mgr = self._mgr(tmp_path, max_to_keep=5)
+        self._save(mgr, 10, 1.0)
+        self._save(mgr, 20, 2.0)
+        os.remove(manifest_path(mgr.directory, 20))  # torn: arrays, no manifest
+        got = mgr.restore_latest_verified()
+        assert got is not None
+        state, meta, step, demotions = got
+        assert step == 10 and meta["step"] == 10
+        np.testing.assert_allclose(np.asarray(state["w"]), 1.0)
+        assert len(demotions) == 1
+        assert "manifest missing" in demotions[0]["reason"]
+        mgr.close()
+
+    def test_corrupt_step_is_demoted(self, tmp_path):
+        from kungfu_tpu.chaos.inject import _corrupt_checkpoint
+        from kungfu_tpu.resilience import CheckpointIntegrityError
+
+        mgr = self._mgr(tmp_path, max_to_keep=5)
+        self._save(mgr, 10, 1.0)
+        self._save(mgr, 20, 2.0)
+        assert _corrupt_checkpoint(mgr.directory) == 20
+        # strict restore refuses the corrupt step...
+        with pytest.raises((CheckpointIntegrityError, Exception)):
+            mgr.restore(step=20)
+        # ...and the ladder walk lands on the older verified one
+        got = mgr.restore_latest_verified()
+        assert got is not None
+        state, meta, step, demotions = got
+        assert step == 10
+        np.testing.assert_allclose(np.asarray(state["w"]), 1.0)
+        assert demotions and demotions[0]["candidate"] == "step:20"
+        mgr.close()
+
+    def test_no_verified_step_returns_none(self, tmp_path):
+        mgr = self._mgr(tmp_path)
+        assert mgr.restore_latest_verified() is None  # empty dir
+        self._save(mgr, 10, 1.0)
+        os.remove(manifest_path(mgr.directory, 10))
+        assert mgr.restore_latest_verified() is None  # only a torn step
+        mgr.close()
+
+    def test_save_failure_is_absorbed_and_journaled(self, tmp_path, monkeypatch):
+        from kungfu_tpu.monitor import journal
+
+        jfile = tmp_path / "journal.jsonl"
+        monkeypatch.setenv("KFT_JOURNAL_FILE", str(jfile))
+        journal._reset_for_tests()
+        try:
+            mgr = self._mgr(tmp_path)
+
+            def boom(*a, **k):
+                raise RuntimeError("async flush died: disk full")
+
+            monkeypatch.setattr(mgr._mgr, "save", boom)
+            assert mgr.save(10, {"w": np.zeros((4,), np.float32)}) is False
+            events = journal.read_journal(str(jfile))
+            assert [e["event"] for e in events] == ["checkpoint_save_failed"]
+            assert "disk full" in events[0]["error"]
+        finally:
+            journal._reset_for_tests()
+
+    def test_wait_deadline_bounds_a_hung_flush(self, tmp_path, monkeypatch):
+        import time as _time
+
+        mgr = self._mgr(tmp_path)
+
+        def hang():
+            _time.sleep(30)
+
+        monkeypatch.setattr(mgr._mgr, "wait_until_finished", hang)
+        t0 = _time.monotonic()
+        assert mgr.wait(deadline_s=0.3) is False
+        assert _time.monotonic() - t0 < 5.0
+
+
+# -- the buddy-RAM heal drill (multi-process) ------------------------------------------
+
+
+@pytest.mark.faults
+@pytest.mark.slow
+class TestBuddyHealDrill:
+    def test_crash_heals_from_buddy_ram_with_zero_disk_reads(self, tmp_path):
+        """The acceptance drill: crash a worker, assert the survivors heal
+        from the in-memory tier (journal recovery_rung=buddy) without a
+        single disk restore (no checkpoint_restored events)."""
+        from kungfu_tpu.chaos.__main__ import _journal_events, run_drill
+
+        jdir = str(tmp_path / "journal")
+        summary = run_drill(
+            "crash@step=7:rank=2", np=3, total_samples=1536, timeout_s=180,
+            extra_env={"KFT_JOURNAL_DIR": jdir},
+        )
+        assert summary["returncode"] == 0, summary["output"][-3000:]
+        assert summary["results"], "no worker RESULT line"
+        assert all(r["final_size"] == 2 for r in summary["results"])
+        assert summary["heal_events"], "no heal events"
+        for ev in summary["heal_events"]:
+            assert ev["recovery_rung"] == "buddy", ev
+            assert ev["recovery_source"] in ("live", "self") or \
+                ev["recovery_source"].startswith("peer:"), ev
+            assert ev["mttr_s"] < 60
+        events = _journal_events(jdir)
+        heals = [e for e in events if e.get("event") == "heal"]
+        assert heals and all(e.get("recovery_rung") == "buddy" for e in heals)
+        # zero disk reads: the ladder never touched the checkpoint tier
+        assert not [e for e in events if e.get("event") == "checkpoint_restored"]
+        assert not [e for e in events if e.get("event") == "checkpoint_demoted"]
