@@ -19,7 +19,19 @@ import sys
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="kungfu_tpu.benchmarks")
     p.add_argument("--bench", default="all_reduce",
-                   choices=["all_reduce", "p2p", "attention", "compression"])
+                   choices=["all_reduce", "p2p", "attention", "compression",
+                            "serving"])
+    p.add_argument("--slots", type=int, default=4,
+                   help="KV slots for --bench serving")
+    p.add_argument("--requests", type=int, default=64,
+                   help="request count for --bench serving")
+    p.add_argument("--max-new", type=int, default=32,
+                   help="tokens per request for --bench serving")
+    p.add_argument("--kv-cache-dtype", default="model",
+                   choices=["model", "int8"],
+                   help="KV cache storage dtype for --bench serving")
+    p.add_argument("--preset", default="tiny",
+                   help="serving model preset (see serving.worker.PRESETS)")
     p.add_argument("--size", type=int, default=1 << 22,
                    help="elements for --bench compression")
     p.add_argument("--out", default=None,
@@ -47,6 +59,16 @@ def main(argv=None) -> int:
             batch=args.batch, seq_len=args.seq_len, heads=args.heads,
             head_dim=args.head_dim, steps=args.steps, warmup=args.warmup,
             grad=not args.no_grad,
+        )
+        return 0
+
+    if args.bench == "serving":
+        from .serving import bench_serving
+
+        bench_serving(
+            requests=args.requests, max_new=args.max_new, slots=args.slots,
+            preset=args.preset, kv_cache_dtype=args.kv_cache_dtype,
+            out=args.out,
         )
         return 0
 

@@ -1,0 +1,101 @@
+"""Serving micro-benchmark — steady-state continuous-batching throughput.
+
+In-process, single replica: drives a ServingEngine with a closed-loop
+request stream (mixed prompt lengths over the prefill buckets) and reports
+
+  tokens_per_sec      generated tokens / wall over the measured window
+  ttft_p50/p99_ms     submit -> first new token (queue wait + prefill)
+  decode_p50/p99_ms   one fixed-shape decode step (the per-token latency
+                      floor; batch-level, so it is the TPOT every active
+                      slot shares)
+  prefill_p50/p99_ms  one bucketed prefill dispatch
+
+The fleet-level numbers (failover_requeue_s, rejoin latency) come from the
+subprocess serve drill (kungfu_tpu.serving.drill) — bench.py composes both
+into the BENCH json's "serving" section.
+
+    python -m kungfu_tpu.benchmarks --bench serving [--out serving.json]
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import Optional
+
+
+def bench_serving(requests: int = 64, max_new: int = 32, slots: int = 4,
+                  preset: str = "tiny", warmup: int = 4,
+                  kv_cache_dtype: str = "model",
+                  out: Optional[str] = None) -> dict:
+    import numpy as np
+
+    from ..monitor.counters import Counters
+    from ..serving.engine import ServingEngine
+    from ..serving.request import Request
+    from ..serving.worker import build_config, seed_params
+
+    overrides = json.dumps({"kv_cache_dtype": kv_cache_dtype})
+    cfg = build_config(preset, overrides)
+    params = seed_params(cfg, seed=0)
+    counters = Counters()
+    engine = ServingEngine(cfg, params, slots=slots,
+                           queue_capacity=requests + warmup + 1,
+                           counters=counters)
+
+    rs = np.random.RandomState(0)
+    buckets = engine.buckets
+
+    def one_request():
+        n = int(rs.randint(2, min(buckets[-1], cfg.max_len - max_new - 1)))
+        prompt = tuple(int(t) for t in rs.randint(1, cfg.vocab_size, n))
+        return Request(prompt=prompt, max_new_tokens=max_new)
+
+    # warmup: compile every prefill bucket + the decode program outside the
+    # measured window
+    for b in buckets:
+        engine.submit(Request(prompt=tuple([1] * min(b, 4)) + tuple(
+            [2] * max(0, min(b, cfg.max_len - max_new - 1) - 4)),
+            max_new_tokens=2))
+    engine.run_until_idle()
+    tok0 = engine.total_tokens
+    # fresh histograms for the measured window: the warmup observations
+    # include jit compiles and would skew every percentile
+    counters = Counters()
+    engine.counters = counters
+
+    reqs = [one_request() for _ in range(requests)]
+    t0 = time.perf_counter()
+    for r in reqs:
+        engine.submit(r)
+    results = engine.run_until_idle(timeout_s=600.0)
+    wall = time.perf_counter() - t0
+
+    assert len(results) == requests and all(r.status == "ok" for r in results)
+    hists = counters.hist_summaries()
+
+    def pct(metric: str, key: str):
+        v = hists.get(metric, {}).get("", {}).get(key)
+        return round(v, 3) if v is not None else None
+
+    record = {
+        "bench": "serving",
+        "preset": preset,
+        "kv_cache_dtype": kv_cache_dtype,
+        "slots": slots,
+        "requests": requests,
+        "max_new_tokens": max_new,
+        "tokens_per_sec": round((engine.total_tokens - tok0) / wall, 2),
+        "requests_per_sec": round(requests / wall, 2),
+        "ttft_p50_ms": pct("ttft_ms", "p50"),
+        "ttft_p99_ms": pct("ttft_ms", "p99"),
+        "decode_p50_ms": pct("tok_latency_ms", "p50"),
+        "decode_p99_ms": pct("tok_latency_ms", "p99"),
+        "prefill_p50_ms": pct("prefill_ms", "p50"),
+        "prefill_p99_ms": pct("prefill_ms", "p99"),
+        "wall_s": round(wall, 3),
+    }
+    print("RESULT: " + json.dumps(record), flush=True)
+    if out:
+        with open(out, "w") as f:
+            json.dump(record, f, indent=2)
+    return record

@@ -218,7 +218,54 @@ def main(argv=None) -> int:
                     default="",
                     help="run a checkpoint-integrity drill instead of the "
                          "crash+heal smoke")
+    ap.add_argument("--serve-drill", action="store_true",
+                    help="run the serving drill instead: kill a serving "
+                         "rank mid-stream, assert zero dropped requests + "
+                         "bounded p99, buddy-weight rejoin (rank_rejoined "
+                         "journal), and scale-down/scale-up commits through "
+                         "the config server (docs/serving.md)")
+    ap.add_argument("--serve-requests", type=int, default=12)
+    ap.add_argument("--serve-p99-bound", type=float, default=60.0,
+                    help="client-visible p99 latency bound for the drill")
+    ap.add_argument("--no-autoscale-drill", action="store_true",
+                    help="serve drill: skip the autoscale phase (failover "
+                         "only — the bench A/B uses this)")
+    ap.add_argument("--json", default="",
+                    help="serve drill: also write the metrics dict here")
     args = ap.parse_args(argv)
+
+    if args.serve_drill:
+        from ..serving.drill import run_serve_drill
+
+        summary = run_serve_drill(
+            np=args.np if args.np != 3 else 2,  # serve default is 2 ranks
+            buddy=args.buddy, timeout_s=args.timeout,
+            requests=args.serve_requests, p99_bound_s=args.serve_p99_bound,
+            skip_autoscale=args.no_autoscale_drill,
+        )
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(summary, f, indent=2)
+        if not summary["ok"]:
+            print("SERVE DRILL FAILED: " + "; ".join(summary["failures"]),
+                  file=sys.stderr)
+            if summary.get("output_tail"):
+                print("--- output tail ---\n" + summary["output_tail"],
+                      file=sys.stderr)
+            return 1
+        print("SERVE DRILL OK: "
+              f"{summary['completed']}/{summary['requests']} requests, "
+              f"0 dropped, {summary['requeued_requests']} requeued "
+              f"(warm resumes {summary.get('warm_resumes', 0)}), "
+              f"rejoin rung={summary.get('rejoin_rung')} in "
+              f"{summary.get('rejoin_restore_s')}s, "
+              f"failover_requeue_s={summary.get('failover_requeue_s')}, "
+              f"p99={summary['latency_p99_s']}s, "
+              f"tokens/s={summary['tokens_per_sec']}"
+              + ("" if args.no_autoscale_drill else
+                 f", scale_down in {summary.get('scale_down_s')}s, "
+                 f"scale_up in {summary.get('scale_up_s')}s"))
+        return 0
 
     if args.ckpt_drill:
         return run_ckpt_drill(args.ckpt_drill, timeout_s=args.timeout)

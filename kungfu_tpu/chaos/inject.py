@@ -83,6 +83,20 @@ class ChaosInjector:
             elif f.kind == "slow":
                 self._sleep(f.ms / 1e3)
 
+    def on_serve_tokens(self, total_tokens: int, rank: int) -> None:
+        """Fire `crash_serve` once the serving engine has generated
+        `total_tokens` tokens — called by the serving worker after every
+        decode iteration (serving/worker.py), so the kill lands MID-STREAM
+        with requests in flight."""
+        for f in self.plan.serve_faults():
+            if f in self._fired or rank != f.rank or total_tokens < f.tokens:
+                continue
+            self._fired.add(f)
+            log.warning("CHAOS: crash_serve at %d generated tokens rank %d "
+                        "(exit %d)", total_tokens, rank, f.code)
+            self._journal("chaos_crash_serve", total_tokens, rank, code=f.code)
+            self._exit(f.code)
+
     @staticmethod
     def _journal(event: str, step: int, rank: int, **fields) -> None:
         """Scripted faults stamp the journal (flushed per emit) so a drill's
@@ -93,11 +107,14 @@ class ChaosInjector:
 
 
 def injector_from_env() -> Optional[ChaosInjector]:
-    """ChaosInjector for this process's KFT_FAULT_PLAN, or None (no plan)."""
+    """ChaosInjector for this process's KFT_FAULT_PLAN, or None (no plan).
+    Covers both the training step faults (on_step) and the serving-loop
+    faults (on_serve_tokens) — each loop calls only its own hook."""
     plan = plan_from_env()
-    if not plan.worker_faults():
+    armed = plan.worker_faults() + plan.serve_faults()
+    if not armed:
         return None
-    log.info("fault plan armed: %s", ", ".join(f.kind for f in plan.worker_faults()))
+    log.info("fault plan armed: %s", ", ".join(f.kind for f in armed))
     return ChaosInjector(plan)
 
 

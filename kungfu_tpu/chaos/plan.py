@@ -20,6 +20,15 @@ Kinds (see docs/fault_tolerance.md for the full grammar):
                                     request (default N=5) — a control-plane
                                     outage window
 
+Serving faults (docs/serving.md, serve drills):
+
+  crash_serve@tokens=N:rank=R[:code=C]
+                                    serving worker R calls os._exit(C) once
+                                    its engine has generated >= N tokens
+                                    total (default code 45) — a mid-stream
+                                    rank kill with requests in flight; the
+                                    router must re-queue them, never drop
+
 Checkpoint-integrity faults (docs/fault_tolerance.md, recovery ladder):
 
   corrupt_ckpt@step=N:rank=R[:ckpt_step=S]
@@ -49,9 +58,11 @@ from typing import List, Optional, Tuple
 
 FAULT_PLAN_ENV = "KFT_FAULT_PLAN"
 
-_KINDS = ("crash", "hang", "slow", "flap", "corrupt_ckpt", "crash_in_save")
+_KINDS = ("crash", "hang", "slow", "flap", "corrupt_ckpt", "crash_in_save",
+          "crash_serve")
 DEFAULT_CRASH_CODE = 41
 DEFAULT_CRASH_IN_SAVE_CODE = 43
+DEFAULT_CRASH_SERVE_CODE = 45
 DEFAULT_FLAP_AFTER = 5
 
 
@@ -79,6 +90,7 @@ class Fault:
     duration_s: float = 0.0         # flap: outage window
     after: int = DEFAULT_FLAP_AFTER  # flap: requests served before outage
     ckpt_step: int = -1             # corrupt_ckpt: target step; -1 = latest
+    tokens: int = -1                # crash_serve: generated-token trigger
 
     def matches(self, step: int, rank: int) -> bool:
         """True when a worker-side fault fires at (step, rank)."""
@@ -114,6 +126,18 @@ def _parse_one(spec: str) -> Fault:
             kind="flap",
             duration_s=_duration_s(kv.pop("config_server"), spec),
             after=int(kv.pop("after", DEFAULT_FLAP_AFTER)),
+            **_reject_leftovers(kv, spec),
+        )
+
+    if kind == "crash_serve":
+        if "tokens" not in kv or "rank" not in kv:
+            raise ValueError(f"crash_serve fault needs tokens= and rank=: {spec!r}")
+        code = int(kv.pop("code", DEFAULT_CRASH_SERVE_CODE))
+        if code == 0:
+            raise ValueError(f"crash_serve code must be non-zero: {spec!r}")
+        return Fault(
+            kind="crash_serve", tokens=int(kv.pop("tokens")),
+            rank=int(kv.pop("rank")), code=code,
             **_reject_leftovers(kv, spec),
         )
 
@@ -160,6 +184,10 @@ class FaultPlan:
     def save_faults(self) -> Tuple[Fault, ...]:
         """Faults fired from inside the checkpoint write path."""
         return tuple(f for f in self.faults if f.kind == "crash_in_save")
+
+    def serve_faults(self) -> Tuple[Fault, ...]:
+        """Faults fired from the serving decode loop (on_serve_tokens)."""
+        return tuple(f for f in self.faults if f.kind == "crash_serve")
 
     def flap_faults(self) -> Tuple[Fault, ...]:
         return tuple(f for f in self.faults if f.kind == "flap")

@@ -92,6 +92,23 @@ class ConfigClient:
             log.warning("config server unreachable: %s", e)
             return None
 
+    def get_health(self) -> Optional[dict]:
+        """GET the cheap /health endpoint: {ok, version, size, cleared}
+        without deserializing the cluster document (the autoscaler / LB
+        poll path).  None when the server is unreachable past the retry
+        budget — liveness pollers treat that as "down", not an exception."""
+
+        def _get():
+            with urllib.request.urlopen(
+                self.url + "/health", timeout=self.timeout_s
+            ) as r:
+                return json.loads(r.read().decode())
+
+        try:
+            return self._with_retry(_get, "config health GET")
+        except OSError:
+            return None
+
     def put_cluster(self, cluster: Cluster, version: Optional[int] = None) -> bool:
         """PUT a new cluster config; server validates + bumps version.
 

@@ -9,6 +9,12 @@ builtin-config-server) or standalone:
 
     python -m kungfu_tpu.elastic.config_server -port 9100 [-init hostfile-json]
 
+Serving-era extension: GET /health (any path ending in "/health") returns
+{ok, version, size, cleared} without serializing the cluster document — the
+cheap poll target for the serving autoscaler and external load balancers
+(GET-the-document was previously the only read).  /health answers even
+inside a chaos flap window (liveness, not document plane).
+
 Two healing-era extensions over the reference:
   - a PUT body carrying `"version": N` is *conditional* — rejected (409)
     unless N matches the stored version, so concurrent healers on different
@@ -80,6 +86,18 @@ class _State:
             self.cluster = None
             self.cleared = True
 
+    def health(self) -> dict:
+        """Cheap liveness + document-version snapshot: no Cluster
+        deserialization, no worker list — what autoscalers and external
+        load balancers poll at high frequency."""
+        with self.lock:
+            return {
+                "ok": True,
+                "version": self.version,
+                "size": self.cluster.size() if self.cluster is not None else 0,
+                "cleared": self.cleared,
+            }
+
 
 class ConfigServer:
     """Threaded config server; use .start()/.stop() embedded, or serve_forever."""
@@ -115,6 +133,13 @@ class ConfigServer:
                 if self.path.startswith("/stop"):
                     self._send(200, b"{}")
                     threading.Thread(target=stop_cb, daemon=True).start()
+                    return
+                if self.path.rstrip("/").endswith("/health"):
+                    # liveness endpoint: served even inside a chaos flap
+                    # window — the flap models document-plane overload, and
+                    # pollers (autoscaler, external LBs) must still get the
+                    # cheap version answer without a full-document GET
+                    self._send(200, json.dumps(state.health()).encode())
                     return
                 if self._flapped():
                     return

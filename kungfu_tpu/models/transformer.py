@@ -155,18 +155,20 @@ class TransformerConfig:
 
 
 def apply_rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
-    """Rotary position embedding on [B, L, H, D] with positions [L].
+    """Rotary position embedding on [B, L, H, D] with positions [L] or [B, L].
 
     Rotates pairs (x[..., :D/2], x[..., D/2:]) in fp32, casts back.  Called
     with GLOBAL positions before any sequence-parallel sharding region, so
-    each sp shard's rows carry their true absolute position.
+    each sp shard's rows carry their true absolute position.  Per-row [B, L]
+    positions are the continuous-batching decode shape: every serving slot
+    sits at its own cache cursor (serving/engine.py).
     """
     d = x.shape[-1]
     half = d // 2
     freqs = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) / half))
-    ang = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # [L, half]
-    cos = jnp.cos(ang)[None, :, None, :]
-    sin = jnp.sin(ang)[None, :, None, :]
+    ang = positions.astype(jnp.float32)[..., None] * freqs  # [..., L, half]
+    cos = jnp.cos(ang)[..., :, None, :]  # [(B,) L, 1, half] — bcasts over H
+    sin = jnp.sin(ang)[..., :, None, :]
     xf = x.astype(jnp.float32)
     x1, x2 = xf[..., :half], xf[..., half:]
     out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
@@ -227,17 +229,24 @@ class Attention(nn.Module):
                 )
             else:
                 kscale = vscale = None
+            # PER-SLOT cursors [B]: every batch row is an independent serving
+            # slot with its own write position — the enabler for continuous
+            # batching (serving/engine.py packs requests of different ages
+            # into one fixed-shape decode batch).  generate() keeps all rows
+            # in lockstep, so the [B] shape is invisible to the train path.
             cache_idx = self.variable(
-                "cache", "idx", lambda: jnp.zeros((), jnp.int32)
+                "cache", "idx", lambda: jnp.zeros((B,), jnp.int32)
             )
-            # sticky overflow flag: once any write ran past max_len the
-            # clamped dynamic_update_slice has clobbered older slots, so
-            # EVERY later output is suspect, not just out-of-range rows
+            # sticky PER-SLOT overflow flags: once a row's write ran past
+            # max_len the clamped dynamic_update_slice has clobbered that
+            # row's older slots, so EVERY later output of that row is
+            # suspect, not just out-of-range positions.  Cleared per slot
+            # when the serving engine re-prefills it.
             cache_ovf = self.variable(
-                "cache", "overflowed", lambda: jnp.zeros((), jnp.bool_)
+                "cache", "overflowed", lambda: jnp.zeros((B,), jnp.bool_)
             )
-            idx0 = cache_idx.value
-            pos = idx0 + jnp.arange(L)
+            idx0 = cache_idx.value                      # [B]
+            pos = idx0[:, None] + jnp.arange(L)[None, :]  # [B, L]
             q = apply_rope(q, pos, cfg.rope_theta)
             k = apply_rope(k, pos, cfg.rope_theta)
 
@@ -251,17 +260,19 @@ class Attention(nn.Module):
                 return qx, sc
 
             def store(cache_var, scale_var, x):
-                """Write x at the cursor (quantizing + scale write if int8)."""
+                """Write x at each slot's own cursor (quantizing + scale
+                write if int8).  vmapped over the batch dim: rows land at
+                per-slot positions, the continuous-batching write shape."""
                 if quant:
                     x, sc = quantize(x)
-                    scale_var.value = jax.lax.dynamic_update_slice(
-                        scale_var.value, sc, (0, idx0, 0)
-                    )
+                    scale_var.value = jax.vmap(
+                        lambda c, u, i: jax.lax.dynamic_update_slice(c, u, (i, 0))
+                    )(scale_var.value, sc, idx0)
                 else:
                     x = x.astype(cache_var.value.dtype)
-                cache_var.value = jax.lax.dynamic_update_slice(
-                    cache_var.value, x, (0, idx0, 0, 0)
-                )
+                cache_var.value = jax.vmap(
+                    lambda c, u, i: jax.lax.dynamic_update_slice(c, u, (i, 0, 0))
+                )(cache_var.value, x, idx0)
 
             def load(cache_var, scale_var):
                 """Full cache in the model dtype.  int8: the dequant (exact
@@ -276,7 +287,7 @@ class Attention(nn.Module):
 
             if not self.is_initializing():
                 # init() traces the module once to create the cache — it
-                # must not write tokens or advance the cursor
+                # must not write tokens or advance the cursors
                 store(cache_k, kscale, k)
                 store(cache_v, vscale, v)
                 cache_idx.value = idx0 + L
@@ -297,25 +308,28 @@ class Attention(nn.Module):
                 "blkgd,bmkd->bkglm", qg, kf,
                 preferred_element_type=jnp.float32,
             ) * scale
-            q_pos = pos[:, None]                       # [L, 1]
-            c_pos = jnp.arange(cfg.max_len)[None, :]   # [1, max_len]
-            valid = c_pos <= q_pos
+            q_pos = pos[:, :, None]                        # [B, L, 1]
+            c_pos = jnp.arange(cfg.max_len)[None, None, :]  # [1, 1, max_len]
+            valid = c_pos <= q_pos                          # [B, L, max_len]
             if cfg.window:  # sliding-window models decode windowed too
                 valid = jnp.logical_and(valid, q_pos - c_pos < cfg.window)
-            s = jnp.where(valid[None, None, None], s, -1e30)
+            s = jnp.where(valid[:, None, None], s, -1e30)
             p = jax.nn.softmax(s, axis=-1)
             o = jnp.einsum(
                 "bkglm,bmkd->blkgd", p.astype(vf.dtype), vf,
                 preferred_element_type=jnp.float32,
             ).reshape(B, L, H, D)
-            # cursor past max_len clamps the cache write and clobbers older
-            # slots — poison with NaN so overflow is LOUD instead of
-            # silently-wrong logits (generate() bounds the total; this
-            # guards the raw decode apply() surface).  The sticky flag
-            # poisons in-range rows of overflowing and LATER calls too:
-            # they attend to corrupted K/V.
+            # a cursor past max_len clamps that row's cache write and
+            # clobbers its older slots — poison the ROW with NaN so overflow
+            # is LOUD instead of silently-wrong logits (generate() bounds
+            # the total; this guards the raw decode apply() surface).  The
+            # sticky per-slot flag poisons in-range outputs of overflowing
+            # and LATER calls of that slot too: they attend to corrupted
+            # K/V.  Other slots stay clean — the serving engine relies on
+            # overflow being contained to the offending slot.
             poison = jnp.logical_or(
-                (pos >= cfg.max_len)[None, :, None, None], cache_ovf.value
+                (pos >= cfg.max_len)[:, :, None, None],
+                cache_ovf.value[:, None, None, None],
             )
             o = jnp.where(poison, jnp.nan, o)
             o = o.astype(cfg.dtype).reshape(B, L, cfg.d_model)

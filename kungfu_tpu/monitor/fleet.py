@@ -263,8 +263,14 @@ class FleetAggregator:
         text = merge_prometheus(bodies)
         text += "# TYPE kungfu_fleet_scrape_errors_total counter\n"
         text += f"kungfu_fleet_scrape_errors_total {self._scrape_errors}\n"
-        for rank in sorted(errors):
-            text += f'kungfu_fleet_ranks_scraped{{rank="{rank}"}} 0\n'
+        # per-rank reachability as a complete 0/1 series (not only the
+        # failures): external pollers — the serving load balancer, an
+        # alerting rule — need "rank present and healthy" to be a positive
+        # signal they can sum, not the absence of an error line
+        text += "# TYPE kungfu_fleet_ranks_scraped gauge\n"
+        for rank in sorted(set(bodies) | set(errors)):
+            up = 1 if rank in bodies else 0
+            text += f'kungfu_fleet_ranks_scraped{{rank="{rank}"}} {up}\n'
         return text
 
     def merged_timeline(self) -> Dict[str, Any]:

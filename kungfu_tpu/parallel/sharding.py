@@ -142,3 +142,44 @@ def param_shardings(mesh: Mesh, abstract_params: Any, rules=None) -> Any:
         specs,
         is_leaf=lambda x: isinstance(x, P),
     )
+
+
+def decode_cache_shardings(mesh: Mesh, cache: Any) -> Any:
+    """NamedShardings pinning a decode KV cache onto a serving mesh.
+
+    The cache tree (models/transformer.py decode mode) has per-layer leaves
+    `cached_k`/`cached_v` [slots, max_len, kv_heads, head_dim], int8 scales
+    `scale_k`/`scale_v` [slots, max_len, kv_heads], and per-slot
+    `idx`/`overflowed` [slots].  Serving shards the SLOT axis over "dp"
+    (independent requests — every decode step is collective-free on that
+    axis) and the kv-head axis over "tp" to match the Megatron q/k/v kernel
+    sharding, so the tp psums of the attention output are the only decode
+    collectives.  Sequence-parallel serving (sharding max_len over "sp", the
+    ring-attention layout) is a per-call shard_map decision, not a storage
+    pin — see docs/serving.md.
+
+    A tp degree that does not divide kv_heads leaves the head axis
+    replicated (GQA caches can have fewer kv heads than tp shards).
+    """
+    names = set(mesh.axis_names)
+    dp = "dp" if "dp" in names else None
+    tp = "tp" if "tp" in names else None
+
+    def spec_for(path, leaf) -> NamedSharding:
+        name = getattr(path[-1], "key", "")
+        row_dp = dp
+        if dp is not None and leaf.shape[0] % mesh.shape["dp"] != 0:
+            row_dp = None
+        row_tp = tp
+        if tp is not None and leaf.ndim >= 3:
+            if leaf.shape[2] % mesh.shape["tp"] != 0:
+                row_tp = None
+        if name in ("cached_k", "cached_v") and leaf.ndim == 4:
+            return NamedSharding(mesh, P(row_dp, None, row_tp, None))
+        if name in ("scale_k", "scale_v") and leaf.ndim == 3:
+            return NamedSharding(mesh, P(row_dp, None, row_tp))
+        if name in ("idx", "overflowed") and leaf.ndim == 1:
+            return NamedSharding(mesh, P(row_dp))
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map_with_path(spec_for, cache)

@@ -42,6 +42,13 @@ def infer_self_ip(hostlist: HostList) -> str:
 
 
 def main(argv=None):
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "-serve":
+        # `kungfu-run -serve ...` — the serving fleet has its own flag set
+        # (worker count, autoscale bounds, model preset); delegate wholesale
+        from ..serving.__main__ import main as serve_main
+
+        sys.exit(serve_main(argv[1:]))
     ap = argparse.ArgumentParser(
         "kungfu-tpu-run", description="launch distributed kungfu_tpu workers"
     )

@@ -1,0 +1,39 @@
+"""Elastic inference serving — the trainer's adaptive runtime, pointed at
+request traffic.
+
+The same machinery that makes training self-healing (elastic membership via
+the config server, buddy RAM snapshots, fleet telemetry, the chaos harness)
+runs a production serving fleet here:
+
+  engine.py      continuous-batching loop over the flagship transformer's
+                 decode mode: bucketed prefill + one fixed-shape decode
+                 program, per-slot KV-cache cursors, int8 cache dtype from
+                 the model config, optional tp-sharded weights
+  queue.py       bounded admission queue with deadlines, re-queue-to-front,
+                 and backpressure
+  slots.py       KV-slot ledger + jitted cache graft/reset
+  worker.py      one serving rank: HTTP /generate + buddy weight/warm-state
+                 snapshots + telemetry + chaos injection
+  router.py      fleet front door: admission, dispatch, re-queue on worker
+                 loss (zero drops), queue-depth autoscaler driving the
+                 config server's conditional-PUT document
+  __main__.py    `python -m kungfu_tpu.serving` / `kungfu-run -serve`: the
+                 supervisor gluing config server + workers + router +
+                 autoscaler + fleet telemetry into one process tree
+
+See docs/serving.md for the architecture and failure semantics.
+"""
+from .engine import BackpressureError, ServingEngine, default_buckets
+from .queue import AdmissionQueue
+from .request import Request, Result
+from .slots import SlotManager
+
+__all__ = [
+    "AdmissionQueue",
+    "BackpressureError",
+    "Request",
+    "Result",
+    "ServingEngine",
+    "SlotManager",
+    "default_buckets",
+]

@@ -1,0 +1,340 @@
+"""Scripted serving drill — the serve-mode chaos smoke + bench probe.
+
+Launches a real serving fleet (`python -m kungfu_tpu.serving`) on CPU with a
+`crash_serve` fault armed, drives it with a threaded client, and asserts the
+serving contract end to end:
+
+  1. failover: a worker dies MID-STREAM with requests in flight; every
+     request still completes (zero drops), the router journals the
+     re-queues, the victim rejoins from a live peer's weights
+     (`rank_rejoined` with recovery_rung=buddy) in under the rejoin budget,
+     and client-visible p99 latency stays under the bound
+  2. determinism: a prompt replayed after the failover yields byte-identical
+     tokens (greedy decode + identical replica weights — the re-queue path
+     changed nothing observable)
+  3. autoscale: an idle window commits a scale-DOWN through the config
+     server's conditional PUT, a burst then commits a scale-UP; both are
+     read back via the cheap /health document-version endpoint
+
+Returns a metrics dict (bench.py's `--bench serving` section feeds from it:
+steady tokens/sec, TTFT/decode percentiles, failover_requeue_s, rejoin
+rung/latency).  Exit-code semantics live in the chaos CLI wrapper
+(`python -m kungfu_tpu.chaos --serve-drill`).
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+from typing import Dict, List, Optional
+
+
+def _percentile(xs: List[float], p: float) -> Optional[float]:
+    if not xs:
+        return None
+    xs = sorted(xs)
+    k = min(len(xs) - 1, max(0, int(round(p * (len(xs) - 1)))))
+    return xs[k]
+
+
+def _journal_events(journal_dir: str) -> List[dict]:
+    events = []
+    for path in sorted(glob.glob(os.path.join(journal_dir, "journal-*.jsonl"))):
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                try:
+                    events.append(json.loads(line))
+                except ValueError:
+                    continue
+    return events
+
+
+class _Client:
+    def __init__(self, url: str):
+        self.url = url
+
+    def generate(self, prompt, max_new: int, timeout_s: float = 120.0) -> dict:
+        body = json.dumps(
+            {"prompt": list(prompt), "max_new_tokens": max_new}
+        ).encode()
+        req = urllib.request.Request(
+            self.url + "/v1/generate", data=body, method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=timeout_s) as r:
+            return json.loads(r.read().decode())
+
+    def health_size(self, config_url: str) -> Optional[int]:
+        try:
+            with urllib.request.urlopen(config_url + "/health", timeout=3) as r:
+                return int(json.loads(r.read().decode()).get("size", -1))
+        except (OSError, ValueError):
+            return None
+
+
+def run_serve_drill(np: int = 2, buddy: str = "on", timeout_s: float = 300.0,
+                    requests: int = 12, max_new: int = 16,
+                    crash_tokens: int = 24, p99_bound_s: float = 60.0,
+                    skip_autoscale: bool = False) -> Dict:
+    """Run the drill; returns {"ok": bool, "failures": [...], metrics...}."""
+    failures: List[str] = []
+    metrics: Dict = {"np": np, "buddy": buddy, "requests": requests}
+
+    tmp = tempfile.mkdtemp(prefix="kft-serve-drill-")
+    jdir = os.path.join(tmp, "journal")
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        KFT_FAULT_PLAN=f"crash_serve@tokens={crash_tokens}:rank=1",
+        KFT_JOURNAL_DIR=jdir,
+        # aggressive autoscale windows so the drill finishes in seconds
+        KFT_SERVE_SCALE_UP_DEPTH="3",
+        KFT_SERVE_SCALE_UP_TICKS="2",
+        KFT_SERVE_SCALE_DOWN_TICKS="6",
+        KFT_SERVE_TICK_S="0.25",
+    )
+    env.pop("XLA_FLAGS", None)
+    if buddy == "off":
+        env["KFT_BUDDY"] = "0"
+    cmd = [
+        sys.executable, "-m", "kungfu_tpu.serving", "-np", str(np),
+        "--min-size", "1", "--max-size", str(np), "--platform", "cpu",
+        "--preset", "tiny", "--slots", "2", "--telemetry",
+        "--timeout", str(int(timeout_s)), "-q",
+    ]
+    if skip_autoscale:
+        cmd.append("--no-autoscale")
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    lines: List[str] = []
+    pump = threading.Thread(
+        target=lambda: [lines.append(ln) for ln in proc.stdout], daemon=True
+    )
+    pump.start()
+
+    def find(pattern: str, deadline_s: float = 60.0) -> Optional[str]:
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < deadline_s:
+            for line in list(lines):
+                m = re.search(pattern, line)
+                if m:
+                    return m.group(1)
+            if proc.poll() is not None:
+                return None
+            time.sleep(0.1)
+        return None
+
+    try:
+        serve_url = find(r"SERVE_URL: (\S+)")
+        config_url = find(r"CONFIG_URL: (\S+)", 5.0)
+        if not serve_url or not config_url:
+            failures.append("fleet never printed SERVE_URL/CONFIG_URL")
+            return {"ok": False, "failures": failures,
+                    "output": "".join(lines)[-3000:], **metrics}
+        client = _Client(serve_url)
+
+        # wait for the full fleet to come healthy before loading it (CPU
+        # workers pay several seconds of jax import before their first probe)
+        t0 = time.monotonic()
+        healthy = 0
+        while time.monotonic() - t0 < 90:
+            try:
+                with urllib.request.urlopen(serve_url + "/stats",
+                                            timeout=3) as r:
+                    st = json.loads(r.read().decode())
+                healthy = sum(
+                    1 for w in st["workers"].values() if w["healthy"]
+                )
+                if healthy >= np:
+                    break
+            except (OSError, ValueError):
+                pass
+            time.sleep(0.25)
+        if healthy < np:
+            failures.append(f"only {healthy}/{np} workers came healthy")
+        metrics["boot_s"] = round(time.monotonic() - t0, 3)
+
+        # ---- phase A: failover under load ------------------------------------
+        prompts = [[1 + (i % 5), 2, 3 + (i % 7), 4, 5 + (i % 3)]
+                   for i in range(requests)]
+        results: List[Optional[dict]] = [None] * requests
+        lat: List[float] = [0.0] * requests
+        errs: List[str] = []
+
+        def one(i: int) -> None:
+            t0 = time.monotonic()
+            try:
+                results[i] = client.generate(prompts[i], max_new,
+                                             timeout_s=p99_bound_s + 30)
+            except OSError as e:
+                errs.append(f"request {i}: {e}")
+            lat[i] = time.monotonic() - t0
+
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(requests)]
+        t_load0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=p99_bound_s + 60)
+        load_s = time.monotonic() - t_load0
+        if errs:
+            failures.append(f"client errors: {errs[:3]}")
+        done = [r for r in results if r is not None and r["status"] == "ok"]
+        if len(done) != requests:
+            failures.append(f"only {len(done)}/{requests} requests completed")
+        requeued = [r for r in done if r.get("requeues", 0) > 0]
+        p99 = _percentile([x for x in lat if x > 0], 0.99)
+        metrics.update(
+            completed=len(done),
+            requeued_requests=len(requeued),
+            latency_p50_s=round(_percentile(lat, 0.50) or 0, 3),
+            latency_p99_s=round(p99 or 0, 3),
+            load_window_s=round(load_s, 3),
+        )
+        tok_total = sum(max_new for _ in done)
+        metrics["tokens_per_sec"] = round(tok_total / max(load_s, 1e-9), 2)
+        if p99 is None or p99 > p99_bound_s:
+            failures.append(f"p99 latency {p99} exceeds bound {p99_bound_s}s")
+
+        # ---- phase B: determinism across the failover ------------------------
+        if done:
+            replay = client.generate(prompts[0], max_new)
+            if replay["tokens"] != results[0]["tokens"]:
+                failures.append(
+                    "replayed prompt diverged after failover: "
+                    f"{results[0]['tokens']} vs {replay['tokens']}"
+                )
+
+        # wait for the victim's rejoin to land in the journal before any
+        # teardown: the respawned worker pays a multi-second jax import
+        # before it can journal rank_rejoined, and the assertion below
+        # reads that record
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 60:
+            if any(e.get("event") == "rank_rejoined"
+                   for e in _journal_events(jdir)):
+                break
+            time.sleep(0.5)
+        metrics["rejoin_visible_s"] = round(time.monotonic() - t0, 3)
+
+        # ---- phase C: autoscale down then up ---------------------------------
+        if not skip_autoscale:
+            t0 = time.monotonic()
+            scaled_down = False
+            while time.monotonic() - t0 < 30:
+                if client.health_size(config_url) == 1:
+                    scaled_down = True
+                    break
+                time.sleep(0.25)
+            if not scaled_down:
+                failures.append("idle fleet never scaled down to min size")
+            metrics["scale_down_s"] = round(time.monotonic() - t0, 3)
+
+            # sustained closed-loop burst: 10 concurrent clients against 2
+            # slots keeps queue depth above the high-water mark until the
+            # scale-up commits (a finite burst on the tiny model drains
+            # faster than the autoscaler's sustain window)
+            stop_burst = threading.Event()
+
+            def burst_loop(i: int) -> None:
+                while not stop_burst.is_set():
+                    try:
+                        client.generate(prompts[i % requests], max_new,
+                                        timeout_s=60)
+                    except OSError:
+                        time.sleep(0.1)
+
+            burst = [threading.Thread(target=burst_loop, args=(i,),
+                                      daemon=True) for i in range(10)]
+            for t in burst:
+                t.start()
+            t0 = time.monotonic()
+            scaled_up = False
+            while time.monotonic() - t0 < 45:
+                if (client.health_size(config_url) or 0) >= 2:
+                    scaled_up = True
+                    break
+                time.sleep(0.25)
+            stop_burst.set()
+            for t in burst:
+                t.join(timeout=p99_bound_s + 60)
+            if not scaled_up:
+                failures.append("loaded fleet never scaled back up")
+            metrics["scale_up_s"] = round(time.monotonic() - t0, 3)
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+        pump.join(timeout=5)
+
+    out = "".join(lines)
+    stats = {}
+    m = re.search(r"SERVE_STATS: (\{.*\})", out)
+    if m:
+        stats = json.loads(m.group(1))
+    scale_events = []
+    m = re.search(r"AUTOSCALE_EVENTS: (\[.*\])", out)
+    if m:
+        scale_events = json.loads(m.group(1))
+
+    # ---- journal assertions --------------------------------------------------
+    events = _journal_events(jdir)
+    by_kind: Dict[str, List[dict]] = {}
+    for e in events:
+        by_kind.setdefault(str(e.get("event")), []).append(e)
+
+    if stats.get("dropped", 0) != 0:
+        failures.append(f"router reports dropped={stats.get('dropped')}")
+    if not by_kind.get("chaos_crash_serve"):
+        failures.append("crash_serve fault never fired")
+    if not by_kind.get("request_requeued"):
+        failures.append("no request_requeued journal events (kill missed "
+                        "the in-flight window?)")
+    rejoins = by_kind.get("rank_rejoined", [])
+    if not rejoins:
+        failures.append("victim never journaled rank_rejoined")
+    else:
+        want_rung = "buddy" if buddy == "on" else "seed"
+        rungs = {e.get("recovery_rung") for e in rejoins}
+        if want_rung not in rungs:
+            failures.append(f"rank_rejoined rung {sorted(rungs)}, "
+                            f"expected {want_rung}")
+        metrics["rejoin_rung"] = sorted(rungs)[0]
+        metrics["rejoin_restore_s"] = max(
+            float(e.get("restore_s", 0)) for e in rejoins
+        )
+    requeues_t = [e["t_wall"] for e in by_kind.get("request_requeued", [])]
+    resumed_t = [e["t_wall"]
+                 for e in by_kind.get("requeued_request_completed", [])]
+    if requeues_t and resumed_t:
+        metrics["failover_requeue_s"] = round(
+            max(resumed_t) - min(requeues_t), 3
+        )
+    if not skip_autoscale:
+        kinds = {e["kind"] for e in scale_events}
+        if "scale_down" not in kinds or "scale_up" not in kinds:
+            failures.append(
+                f"autoscaler committed {sorted(kinds)}, need both "
+                "scale_down and scale_up"
+            )
+        if not by_kind.get("scale_down") or not by_kind.get("scale_up"):
+            failures.append("scale events missing from the journal")
+    metrics["journal_event_counts"] = {k: len(v) for k, v in by_kind.items()}
+    metrics["warm_resumes"] = sum(
+        1 for e in by_kind.get("request_requeued", [])
+        if e.get("warm_tokens", 0) > 0
+    )
+    return {"ok": not failures, "failures": failures,
+            "output_tail": out[-3000:] if failures else "", **metrics}

@@ -1,0 +1,358 @@
+"""Continuous-batching inference engine over the flagship transformer.
+
+One engine = one model replica serving many concurrent requests through a
+fixed-shape slot batch:
+
+  * admission: requests queue in an `AdmissionQueue`; a free KV slot admits
+    the oldest live request (deadline-expired ones are swept to rejection,
+    never wedged)
+  * prefill: the request's tokens run through a batch-1 decode-mode forward,
+    padded RIGHT to the nearest bucket length — causal attention makes the
+    padding invisible to real positions, so bucketing costs zero accuracy
+    and bounds the compile count to len(buckets).  The resulting cache row
+    is grafted into the big cache at the slot (slots.write_slot), cursor set
+    to the TRUE length
+  * decode: one fixed-shape [slots, 1] step advances every active slot one
+    token; free slots ride along on a dummy token and their outputs are
+    ignored.  No recompile ever happens after warmup: the decode program is
+    a single (shape, dtype) signature regardless of the request mix
+  * completion: a slot frees on max_new_tokens or eos; its row is reused by
+    the next admission (slots.reset_slot keeps the free row's ride-along
+    cursor at 0)
+
+The per-slot cache cursors this relies on live in models/transformer.py
+(decode mode).  The int8 KV-cache storage dtype comes straight from the
+model config (`kv_cache_dtype="int8"`): the serving cache stores quantized
+bytes + scales exactly as the training-side decode bench does.
+
+Sharded serving: pass `mesh` (and optionally `rules`) to place the params
+under the parallel/sharding.py rules table (Megatron tp for q/k/v/mlp) —
+the KV cache inherits the head sharding through GSPMD, pinned explicitly by
+parallel.sharding.decode_cache_shardings.  Long-context sequence-parallel
+serving (ring/ulysses) shards the cache's max_len axis instead; see
+docs/serving.md for the trade-off.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.transformer import TransformerConfig, TransformerLM
+from ..utils import get_logger
+from ..utils.trace import trace_scope
+from .queue import AdmissionQueue
+from .request import Request, Result
+from .slots import SlotManager, reset_slot, write_slot
+
+log = get_logger("kungfu.serving")
+
+
+def default_buckets(max_len: int, lo: int = 16) -> Tuple[int, ...]:
+    """Powers of two from `lo` up to (and always including) max_len."""
+    out: List[int] = []
+    b = lo
+    while b < max_len:
+        out.append(b)
+        b *= 2
+    out.append(max_len)
+    return tuple(out)
+
+
+class _Pending:
+    """Handle returned by submit(); worker HTTP threads block on wait()."""
+
+    def __init__(self, req: Request):
+        self.request = req
+        self._done = threading.Event()
+        self.result: Optional[Result] = None
+
+    def _finish(self, result: Result) -> None:
+        self.result = result
+        self._done.set()
+
+    def wait(self, timeout_s: Optional[float] = None) -> Optional[Result]:
+        self._done.wait(timeout_s)
+        return self.result
+
+
+class ServingEngine:
+    def __init__(
+        self,
+        cfg: TransformerConfig,
+        params: Any,
+        slots: int = 4,
+        queue_capacity: int = 64,
+        prefill_buckets: Optional[Sequence[int]] = None,
+        mesh=None,
+        rules=None,
+        counters=None,
+    ):
+        assert cfg.rope, "serving decode requires a rope config (cache cursors)"
+        # decode overrides mirror generate(): full attention on the cache, a
+        # dense head, GSPMD (not shard_map) sharding under `mesh`
+        self.dcfg = dataclasses.replace(
+            cfg, decode=True, attention="full", mesh=None, head="dense"
+        )
+        self.model = TransformerLM(self.dcfg)
+        self.n_slots = slots
+        self.queue = AdmissionQueue(queue_capacity)
+        self.slot_mgr = SlotManager(slots)
+        self.counters = counters
+        self.buckets = tuple(sorted(prefill_buckets or default_buckets(cfg.max_len)))
+        assert self.buckets[-1] <= cfg.max_len
+
+        probe = jnp.zeros((slots, 1), jnp.int32)
+        variables = self.model.init(jax.random.PRNGKey(0), probe)
+        self.cache = variables["cache"]
+        self._small_cache0 = self.model.init(
+            jax.random.PRNGKey(0), probe[:1]
+        )["cache"]
+        if mesh is not None:
+            from ..parallel.sharding import decode_cache_shardings, param_shardings
+
+            params = jax.device_put(
+                params, param_shardings(mesh, variables["params"], rules)
+            )
+            self.cache = jax.device_put(
+                self.cache, decode_cache_shardings(mesh, self.cache)
+            )
+        self.params = params
+
+        # host-side per-slot decode state (fixed [slots] arrays)
+        self._next_tok = np.zeros(slots, np.int32)
+        self._rng = np.random.default_rng(0)
+        self._pending: Dict[str, _Pending] = {}
+        self._completed_lock = threading.Lock()
+        self.total_tokens = 0      # generated tokens, engine lifetime
+        self.total_completed = 0
+
+        model = self.model
+
+        def _fix_cursor(cache, true_len):
+            def fix(path, leaf):
+                name = getattr(path[-1], "key", None)
+                if name == "idx":
+                    return jnp.full_like(leaf, true_len)
+                if name == "overflowed":
+                    return jnp.zeros_like(leaf)
+                return leaf
+
+            return jax.tree_util.tree_map_with_path(fix, cache)
+
+        @jax.jit
+        def _prefill(params, cache0, tokens, true_len):
+            # tokens [1, bucket]; right-padding is causally invisible to the
+            # real positions, so logits at true_len-1 are exact
+            logits, st = model.apply(
+                {"params": params, "cache": cache0}, tokens, mutable=["cache"]
+            )
+            last = jax.lax.dynamic_index_in_dim(
+                logits, true_len - 1, axis=1, keepdims=False
+            )[0].astype(jnp.float32)  # [V]
+            return last, _fix_cursor(st["cache"], true_len)
+
+        @jax.jit
+        def _decode(params, cache, toks):
+            # toks [slots, 1] — THE fixed decode signature; free slots carry
+            # a dummy token whose output is never read
+            logits, st = model.apply(
+                {"params": params, "cache": cache}, toks, mutable=["cache"]
+            )
+            return logits[:, -1].astype(jnp.float32), st["cache"]
+
+        self._prefill = _prefill
+        self._decode = _decode
+
+    # -- submission ----------------------------------------------------------------
+
+    def submit(self, req: Request) -> _Pending:
+        """Admit a request; raises ValueError when it can never fit, returns
+        a handle whose wait() yields the Result.  A full queue raises
+        BackpressureError — the HTTP layer's 503."""
+        need = len(req.prefill_tokens) + req.remaining_new_tokens
+        if need > self.dcfg.max_len:
+            raise ValueError(
+                f"request needs {need} cache rows > max_len={self.dcfg.max_len}"
+            )
+        if len(req.prefill_tokens) > self.buckets[-1]:
+            raise ValueError("prompt longer than the largest prefill bucket")
+        pending = _Pending(req)
+        with self._completed_lock:
+            self._pending[req.req_id] = pending
+        if not self.queue.put(req):
+            with self._completed_lock:
+                del self._pending[req.req_id]
+            raise BackpressureError(f"queue full ({self.queue.capacity})")
+        self._gauge()
+        return pending
+
+    # -- the scheduler iteration ---------------------------------------------------
+
+    def step(self) -> List[Result]:
+        """One continuous-batching iteration: reject expired, admit+prefill
+        into free slots, one decode step for the batch.  Returns the
+        requests completed during this iteration."""
+        done: List[Result] = []
+        for req in self.queue.drain_expired():
+            done.append(self._finish(req, status="expired"))
+        while self.slot_mgr.free_count:
+            req = self.queue.pop()
+            if req is None:
+                break
+            if req.expired():
+                done.append(self._finish(req, status="expired"))
+                continue
+            self._admit(req)
+        if self.slot_mgr.active_count:
+            done.extend(self._decode_step())
+        for req in self.queue.drain_expired():
+            done.append(self._finish(req, status="expired"))
+        self._gauge()
+        return done
+
+    def run_until_idle(self, timeout_s: float = 120.0) -> List[Result]:
+        """Drive step() until queue and slots drain (test/bench harness)."""
+        t0 = time.monotonic()
+        out: List[Result] = []
+        while self.queue.depth() or self.slot_mgr.active_count:
+            out.extend(self.step())
+            if time.monotonic() - t0 > timeout_s:
+                raise TimeoutError("engine did not drain")
+        return out
+
+    # -- internals -----------------------------------------------------------------
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"no prefill bucket fits {n} tokens")
+
+    def _admit(self, req: Request) -> None:
+        slot = self.slot_mgr.allocate(req)
+        assert slot is not None
+        toks = req.prefill_tokens
+        bucket = self._bucket_for(len(toks))
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, : len(toks)] = toks
+        with trace_scope("serve:prefill", cat="serving",
+                         args={"tokens": len(toks), "bucket": bucket}):
+            t0 = time.monotonic()
+            last_logits, small = self._prefill(
+                self.params, self._small_cache0, jnp.asarray(padded),
+                len(toks),
+            )
+            self.cache = write_slot(self.cache, small, slot)
+            first = self._pick(np.asarray(last_logits), req.temperature)
+            dt = time.monotonic() - t0
+        req.ttft_s = time.monotonic() - req.submitted_t
+        self._observe("ttft_ms", req.ttft_s * 1e3)
+        self._observe("prefill_ms", dt * 1e3)
+        self._push_token(slot, req, int(first))
+
+    def _decode_step(self) -> List[Result]:
+        toks = jnp.asarray(self._next_tok[:, None])
+        with trace_scope("serve:decode", cat="serving",
+                         args={"active": self.slot_mgr.active_count}):
+            t0 = time.monotonic()
+            logits, self.cache = self._decode(self.params, self.cache, toks)
+            logits = np.asarray(logits)
+            dt = time.monotonic() - t0
+        self._observe("tok_latency_ms", dt * 1e3)
+        done: List[Result] = []
+        for slot, req in sorted(self.slot_mgr.active().items()):
+            nxt = self._pick(logits[slot], req.temperature)
+            finished = self._push_token(slot, req, int(nxt), from_decode=True)
+            if finished is not None:
+                done.append(finished)
+        return done
+
+    def _push_token(self, slot: int, req: Request, tok: int,
+                    from_decode: bool = False) -> Optional[Result]:
+        """Record one generated token for `slot`; frees the slot and returns
+        the Result when the request is finished."""
+        req.generated.append(tok)
+        self.total_tokens += 1
+        hit_eos = req.eos_id >= 0 and tok == req.eos_id
+        if len(req.generated) >= req.remaining_new_tokens or hit_eos:
+            self.slot_mgr.release(slot)
+            self.cache = reset_slot(self.cache, slot)
+            self._next_tok[slot] = 0
+            return self._finish(req, status="ok")
+        self._next_tok[slot] = tok
+        return None
+
+    def _pick(self, logits: np.ndarray, temperature: float) -> int:
+        if temperature <= 0.0:
+            return int(np.argmax(logits))
+        z = logits.astype(np.float64) / temperature
+        z -= z.max()
+        p = np.exp(z)
+        p /= p.sum()
+        return int(self._rng.choice(len(p), p=p))
+
+    def _finish(self, req: Request, status: str) -> Result:
+        req.finished_t = time.monotonic()
+        lat = (req.finished_t - req.submitted_t) * 1e3
+        result = Result(
+            req_id=req.req_id,
+            tokens=tuple(req.all_tokens()) if status == "ok" else tuple(req.prompt),
+            status=status,
+            ttft_ms=round(req.ttft_s * 1e3, 3) if req.ttft_s is not None else None,
+            latency_ms=round(lat, 3),
+            requeues=req.requeues,
+        )
+        if status == "ok":
+            self.total_completed += 1
+            self._count("requests_completed")
+        else:
+            self._count("requests_expired")
+        with self._completed_lock:
+            pending = self._pending.pop(req.req_id, None)
+        if pending is not None:
+            pending._finish(result)
+        return result
+
+    def in_flight(self) -> List[dict]:
+        """Queued + slotted requests with their progress — the warm-resume
+        snapshot a worker ships to its buddy (worker.py)."""
+        out = []
+        for req in self.slot_mgr.active().values():
+            d = req.to_json()
+            d["generated"] = list(req.generated)
+            out.append(d)
+        return out
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "queue_depth": self.queue.depth(),
+            "active_slots": self.slot_mgr.active_count,
+            "free_slots": self.slot_mgr.free_count,
+            "total_tokens": self.total_tokens,
+            "total_completed": self.total_completed,
+        }
+
+    def _observe(self, metric: str, value: float) -> None:
+        if self.counters is not None:
+            self.counters.observe_hist(metric, value)
+
+    def _count(self, event: str) -> None:
+        if self.counters is not None:
+            self.counters.inc_event(event)
+
+    def _gauge(self) -> None:
+        if self.counters is not None:
+            self.counters.set_gauge("queue_depth", float(self.queue.depth()))
+            self.counters.set_gauge(
+                "active_slots", float(self.slot_mgr.active_count)
+            )
+
+
+class BackpressureError(RuntimeError):
+    """Admission queue full — callers translate to HTTP 503 + Retry-After."""

@@ -1,0 +1,85 @@
+"""Admission queue — bounded FIFO with deadlines, re-queue, backpressure.
+
+One queue shape serves both tiers of the serving stack:
+
+  router     the fleet-level admission queue; dispatchers pull from it and
+             a failed dispatch (dead worker) pushes the request BACK TO THE
+             FRONT so a victim's in-flight work jumps the line instead of
+             re-aging behind fresh arrivals
+  worker     the engine-level queue feeding free KV slots
+
+`put` rejects (returns False) once `capacity` is reached — that is the
+backpressure signal the HTTP front door turns into a 503 and the drill's
+load generator treats as "slow down", never a silent drop.  Deadline-expired
+requests are swept OUT of the queue at pop time and returned separately so
+the caller can reject them explicitly (a wedged request is the failure mode;
+an expired one must come back with status="expired", docs/serving.md).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import List, Optional, Tuple
+
+from .request import Request
+
+
+class AdmissionQueue:
+    def __init__(self, capacity: int = 256):
+        self.capacity = capacity
+        self._lock = threading.Condition()
+        self._q: deque = deque()
+        self._expired: List[Request] = []
+
+    def put(self, req: Request) -> bool:
+        """Admit at the tail; False = over capacity (backpressure)."""
+        with self._lock:
+            if len(self._q) >= self.capacity:
+                return False
+            self._q.append(req)
+            self._lock.notify()
+            return True
+
+    def requeue(self, req: Request) -> None:
+        """Push a failed-dispatch request back to the FRONT (it has already
+        waited its turn once; capacity is not re-checked — a re-queue must
+        never drop).  Bumps the request's requeue count."""
+        with self._lock:
+            req.requeues += 1
+            self._q.appendleft(req)
+            self._lock.notify()
+
+    def pop(self, timeout_s: float = 0.0) -> Optional[Request]:
+        """Next live request (FIFO), sweeping expired ones aside; None on
+        timeout / empty."""
+        deadline = time.monotonic() + timeout_s
+        with self._lock:
+            while True:
+                now = time.monotonic()
+                while self._q:
+                    req = self._q.popleft()
+                    if req.expired(now):
+                        self._expired.append(req)
+                        continue
+                    return req
+                remaining = deadline - now
+                if remaining <= 0:
+                    return None
+                self._lock.wait(remaining)
+
+    def drain_expired(self) -> List[Request]:
+        """Requests swept out for missing their deadline since the last
+        drain; the caller owns rejecting them."""
+        with self._lock:
+            out, self._expired = self._expired, []
+            return out
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+    def snapshot(self) -> Tuple[int, int]:
+        """(queued, expired-pending-rejection) sizes."""
+        with self._lock:
+            return len(self._q), len(self._expired)

@@ -1,0 +1,123 @@
+"""Serving request/response data model.
+
+A `Request` is one generation job: prompt tokens in, up to `max_new_tokens`
+out, optionally under a wall-clock deadline.  Requests flow launcher-side
+(router admission queue -> worker dispatch) and worker-side (engine queue ->
+slot batch) in the same shape; `prior_tokens` carries tokens a previous
+incarnation already generated so a re-queued request resumes mid-stream
+instead of regenerating from scratch (the "warm KV" path: greedy decode is
+deterministic, so re-prefilling prompt+prior rebuilds the exact cache the
+dead rank held — see docs/serving.md).
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+import time
+from typing import List, Optional, Tuple
+
+_ids = itertools.count()
+_ids_lock = threading.Lock()
+
+
+def next_request_id(prefix: str = "req") -> str:
+    with _ids_lock:
+        return f"{prefix}-{next(_ids)}"
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request.  Mutable: the engine appends generated tokens
+    and stamps latency marks as the request moves through its lifecycle."""
+
+    prompt: Tuple[int, ...]
+    max_new_tokens: int
+    req_id: str = ""
+    temperature: float = 0.0
+    eos_id: int = -1                      # -1: no early stop
+    deadline_s: float = 0.0               # 0: no deadline
+    prior_tokens: Tuple[int, ...] = ()    # warm-resume: already generated
+    submitted_t: float = dataclasses.field(default_factory=time.monotonic)
+    # filled in by the engine
+    generated: List[int] = dataclasses.field(default_factory=list)
+    ttft_s: Optional[float] = None        # first NEW token (prefill done)
+    finished_t: Optional[float] = None
+    requeues: int = 0                     # times re-queued after a rank loss
+
+    def __post_init__(self):
+        if not self.req_id:
+            self.req_id = next_request_id()
+        self.prompt = tuple(int(t) for t in self.prompt)
+        self.prior_tokens = tuple(int(t) for t in self.prior_tokens)
+        if not self.prompt:
+            raise ValueError("empty prompt")
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+
+    @property
+    def remaining_new_tokens(self) -> int:
+        """Tokens still owed after any warm-resumed prior output."""
+        return max(0, self.max_new_tokens - len(self.prior_tokens))
+
+    @property
+    def prefill_tokens(self) -> Tuple[int, ...]:
+        """What prefill consumes: the prompt plus warm-resumed output (the
+        resumed tokens deterministically rebuild the dead rank's KV rows)."""
+        return self.prompt + self.prior_tokens
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        if not self.deadline_s:
+            return False
+        now = time.monotonic() if now is None else now
+        return now - self.submitted_t > self.deadline_s
+
+    def all_tokens(self) -> List[int]:
+        return list(self.prompt) + list(self.prior_tokens) + list(self.generated)
+
+    def to_json(self) -> dict:
+        return {
+            "id": self.req_id,
+            "prompt": list(self.prompt),
+            "max_new_tokens": self.max_new_tokens,
+            "temperature": self.temperature,
+            "eos_id": self.eos_id,
+            "deadline_s": self.deadline_s,
+            "prior_tokens": list(self.prior_tokens),
+            "requeues": self.requeues,
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "Request":
+        return cls(
+            prompt=tuple(d["prompt"]),
+            max_new_tokens=int(d["max_new_tokens"]),
+            req_id=str(d.get("id", "")),
+            temperature=float(d.get("temperature", 0.0)),
+            eos_id=int(d.get("eos_id", -1)),
+            deadline_s=float(d.get("deadline_s", 0.0)),
+            prior_tokens=tuple(d.get("prior_tokens", ())),
+            requeues=int(d.get("requeues", 0)),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class Result:
+    """Terminal outcome of one request."""
+
+    req_id: str
+    tokens: Tuple[int, ...]          # prompt + prior + generated
+    status: str                      # "ok" | "expired"
+    ttft_ms: Optional[float] = None
+    latency_ms: Optional[float] = None
+    requeues: int = 0
+
+    def to_json(self) -> dict:
+        return {
+            "id": self.req_id,
+            "tokens": list(self.tokens),
+            "status": self.status,
+            "ttft_ms": self.ttft_ms,
+            "latency_ms": self.latency_ms,
+            "requeues": self.requeues,
+        }

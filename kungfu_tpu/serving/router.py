@@ -1,0 +1,497 @@
+"""Fleet front door: admission, dispatch, re-queue, autoscale.
+
+The Router owns the fleet-level AdmissionQueue and a table of serving
+workers (reconciled from the elastic cluster document).  Dispatcher threads
+pull requests and POST them to the least-loaded healthy worker; a dispatch
+that dies mid-flight (connection drop, 5xx — the worker was killed) marks
+the worker unhealthy, recovers any warm progress the victim shipped to its
+ring buddy, and re-queues the request AT THE FRONT.  A request leaves the
+router only as a completed Result or an explicit deadline rejection — never
+silently: `requests_dropped` exists to stay at zero and the serve drill
+asserts exactly that.
+
+The Autoscaler turns the queue-depth/latency signal into cluster-document
+writes: sustained depth above the high-water mark grows the document by one
+worker (conditional PUT through elastic/config_server.py — the same
+consensus path training resizes use), a sustained idle fleet shrinks it.
+The supervisor (serving/__main__.py) materializes document changes into
+worker processes; the router just watches the document.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+from ..elastic.config_client import ConfigClient
+from ..monitor.journal import journal_event
+from ..plan import Cluster, PeerID
+from ..utils import get_logger
+from .queue import AdmissionQueue
+from .request import Request, Result
+
+log = get_logger("kungfu.serving")
+
+
+class WorkerRef:
+    def __init__(self, peer: PeerID):
+        self.peer = peer
+        self.url = f"http://{peer.host}:{peer.port}"
+        self.in_flight = 0
+        self.healthy = False  # a worker must pass one probe before dispatch
+        self.last_error = ""
+
+
+class Router:
+    def __init__(self, slots_per_worker: int = 4, queue_capacity: int = 256,
+                 counters=None, probe_s: float = 0.25,
+                 request_timeout_s: float = 120.0):
+        self.slots_per_worker = slots_per_worker
+        self.queue = AdmissionQueue(queue_capacity)
+        self.counters = counters
+        self.probe_s = probe_s
+        self.request_timeout_s = request_timeout_s
+        self._lock = threading.Lock()
+        self._workers: Dict[PeerID, WorkerRef] = {}
+        self._buddy_of: Dict[PeerID, Optional[PeerID]] = {}
+        self._results: Dict[str, dict] = {}  # req_id -> {event, result}
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self.completed = 0
+        self.requeued = 0
+        self.expired = 0
+        self._active = 0  # requests actually in dispatch (not reserved slots)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self.port = 0
+
+    # -- worker table (reconciled from the cluster document) -----------------------
+
+    def set_workers(self, workers) -> None:
+        """Adopt the document's worker list; keeps health/in-flight state of
+        peers that survived, computes ring buddies for warm recovery."""
+        with self._lock:
+            new: Dict[PeerID, WorkerRef] = {}
+            for p in workers:
+                new[p] = self._workers.get(p) or WorkerRef(p)
+            self._workers = new
+            buddies = workers.ring_buddies() if len(workers) else []
+            self._buddy_of = {
+                p: (workers[buddies[i]] if buddies and buddies[i] >= 0 else None)
+                for i, p in enumerate(workers)
+            }
+
+    def workers(self) -> List[WorkerRef]:
+        with self._lock:
+            return list(self._workers.values())
+
+    def healthy_count(self) -> int:
+        with self._lock:
+            return sum(1 for w in self._workers.values() if w.healthy)
+
+    def total_in_flight(self) -> int:
+        """Reserved worker capacity (dispatchers park one reservation each
+        while waiting for work) — the CAPACITY signal, not the load one."""
+        with self._lock:
+            return sum(w.in_flight for w in self._workers.values())
+
+    def active_requests(self) -> int:
+        """Requests currently inside a dispatch — the autoscaler's busy
+        signal (reserved-but-idle dispatcher slots don't count)."""
+        with self._lock:
+            return self._active
+
+    # -- submission ----------------------------------------------------------------
+
+    def submit(self, req: Request) -> bool:
+        """False = backpressure (queue full)."""
+        holder = {"event": threading.Event(), "result": None}
+        with self._lock:
+            self._results[req.req_id] = holder
+        if not self.queue.put(req):
+            with self._lock:
+                del self._results[req.req_id]
+            return False
+        self._gauge()
+        return True
+
+    def wait(self, req_id: str, timeout_s: float) -> Optional[Result]:
+        with self._lock:
+            holder = self._results.get(req_id)
+        if holder is None:
+            return None
+        holder["event"].wait(timeout_s)
+        with self._lock:
+            self._results.pop(req_id, None)
+        return holder["result"]
+
+    def _deliver(self, req: Request, result: Result) -> None:
+        with self._lock:
+            holder = self._results.get(req.req_id)
+        if holder is not None:
+            holder["result"] = result
+            holder["event"].set()
+        if result.status == "ok":
+            self.completed += 1
+            self._count("requests_completed")
+            if result.requeues > 0:
+                # the failover-MTTR anchor: t(last of these) - t(first
+                # request_requeued) is the request-visible recovery window
+                journal_event("requeued_request_completed",
+                              req_id=req.req_id, requeues=result.requeues,
+                              latency_ms=result.latency_ms)
+            if self.counters is not None and result.ttft_ms is not None:
+                self.counters.observe_hist("ttft_ms", result.ttft_ms)
+            if self.counters is not None and result.latency_ms is not None:
+                self.counters.observe_hist("request_latency_ms",
+                                           result.latency_ms)
+        else:
+            self.expired += 1
+            self._count("requests_expired")
+
+    # -- dispatch ------------------------------------------------------------------
+
+    def _pick_worker(self) -> Optional[WorkerRef]:
+        with self._lock:
+            candidates = [w for w in self._workers.values()
+                          if w.healthy and w.in_flight < self.slots_per_worker]
+            if not candidates:
+                return None
+            w = min(candidates, key=lambda w: w.in_flight)
+            w.in_flight += 1
+            return w
+
+    def _dispatch_loop(self) -> None:
+        while not self._stop.is_set():
+            # acquire capacity FIRST, then pop: requests waiting for a slot
+            # stay IN the queue, so queue_depth — the autoscale signal —
+            # reflects real backlog instead of being siphoned into
+            # dispatcher-held limbo
+            w = self._pick_worker()
+            if w is None:
+                time.sleep(0.02)
+                continue
+            try:
+                req = self.queue.pop(timeout_s=0.1)
+                for expired in self.queue.drain_expired():
+                    self._deliver(expired, Result(
+                        req_id=expired.req_id, tokens=tuple(expired.prompt),
+                        status="expired", requeues=expired.requeues))
+                if req is None:
+                    continue
+                if req.expired():
+                    self._deliver(req, Result(
+                        req_id=req.req_id, tokens=tuple(req.prompt),
+                        status="expired", requeues=req.requeues))
+                    continue
+                with self._lock:
+                    self._active += 1
+                try:
+                    self._dispatch_one(w, req)
+                finally:
+                    with self._lock:
+                        self._active -= 1
+            finally:
+                with self._lock:
+                    w.in_flight -= 1
+            self._gauge()
+
+    def _dispatch_one(self, w: WorkerRef, req: Request) -> None:
+        body = json.dumps(req.to_json()).encode()
+        http_req = urllib.request.Request(
+            w.url + "/generate", data=body, method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(
+                http_req, timeout=self.request_timeout_s
+            ) as r:
+                doc = json.loads(r.read().decode())
+        except urllib.error.HTTPError as e:
+            if e.code in (400,):  # semantically rejected: not a worker loss
+                self._deliver(req, Result(
+                    req_id=req.req_id, tokens=tuple(req.prompt),
+                    status="expired", requeues=req.requeues))
+                return
+            self._requeue_after_failure(w, req, f"HTTP {e.code}")
+            return
+        except OSError as e:
+            self._requeue_after_failure(w, req, str(e)[:120])
+            return
+        self._deliver(req, Result(
+            req_id=doc["id"], tokens=tuple(doc["tokens"]),
+            status=doc.get("status", "ok"), ttft_ms=doc.get("ttft_ms"),
+            latency_ms=doc.get("latency_ms"),
+            requeues=req.requeues,
+        ))
+
+    def _requeue_after_failure(self, w: WorkerRef, req: Request,
+                               err: str) -> None:
+        """The zero-drop contract: a failed dispatch re-queues, with any
+        warm progress the victim shipped to its ring buddy grafted on so
+        the retry resumes mid-output instead of regenerating."""
+        with self._lock:
+            was_healthy = w.healthy
+            w.healthy = False
+            w.last_error = err
+        if was_healthy:
+            journal_event("worker_unhealthy", peer=str(w.peer), error=err)
+            self._count("serve_worker_failures")
+        resumed = self._recover_warm(w.peer, req)
+        self.requeued += 1
+        self._count("requests_requeued")
+        journal_event("request_requeued", req_id=req.req_id,
+                      peer=str(w.peer), error=err,
+                      warm_tokens=len(req.prior_tokens) if resumed else 0)
+        self.queue.requeue(req)
+
+    def _recover_warm(self, dead: PeerID, req: Request) -> bool:
+        """Pull the dead rank's warm set from its ring buddy; on a hit the
+        request resumes from prompt+generated (greedy decode is
+        deterministic, so the re-prefill rebuilds the exact KV rows)."""
+        with self._lock:
+            buddy = self._buddy_of.get(dead)
+            bw = self._workers.get(buddy) if buddy is not None else None
+        if bw is None:
+            return False
+        # find the dead peer's rank in the warm namespace: workers ship
+        # keyed by their LAUNCH rank, which the healthz probe reports
+        try:
+            with urllib.request.urlopen(
+                bw.url + f"/warm?origin={self._rank_of(dead)}", timeout=1.0
+            ) as r:
+                items = json.loads(r.read().decode()).get("items", [])
+        except (OSError, ValueError):
+            return False
+        for it in items:
+            if it.get("id") == req.req_id and it.get("generated"):
+                prior = tuple(req.prior_tokens) + tuple(it["generated"])
+                # cap: never resume past the request's budget
+                req.prior_tokens = prior[: req.max_new_tokens]
+                return True
+        return False
+
+    def _rank_of(self, peer: PeerID) -> int:
+        with self._lock:
+            w = self._workers.get(peer)
+        return getattr(w, "launch_rank", -1) if w is not None else -1
+
+    # -- health probing ------------------------------------------------------------
+
+    def _probe_loop(self) -> None:
+        while not self._stop.is_set():
+            for w in self.workers():
+                try:
+                    with urllib.request.urlopen(
+                        w.url + "/healthz", timeout=1.0
+                    ) as r:
+                        doc = json.loads(r.read().decode())
+                    w.launch_rank = int(doc.get("rank", -1))
+                    if not w.healthy:
+                        log.info("worker %s healthy (rank=%s rung=%s)",
+                                 w.peer, doc.get("rank"),
+                                 doc.get("weight_rung"))
+                    w.healthy = True
+                except (OSError, ValueError) as e:
+                    if w.healthy:
+                        journal_event("worker_unhealthy", peer=str(w.peer),
+                                      error=str(e)[:120])
+                    w.healthy = False
+                    w.last_error = str(e)[:120]
+            self._gauge()
+            self._stop.wait(self.probe_s)
+
+    # -- front door ----------------------------------------------------------------
+
+    def start(self, host: str = "127.0.0.1", port: int = 0,
+              dispatchers: int = 0) -> "Router":
+        n = dispatchers or max(4, 2 * self.slots_per_worker)
+        for i in range(n):
+            t = threading.Thread(target=self._dispatch_loop, daemon=True,
+                                 name=f"dispatch-{i}")
+            t.start()
+            self._threads.append(t)
+        t = threading.Thread(target=self._probe_loop, daemon=True,
+                             name="probe")
+        t.start()
+        self._threads.append(t)
+
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code: int, body: bytes) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path.rstrip("/") == "/stats":
+                    self._send(200, json.dumps(outer.stats()).encode())
+                else:
+                    self._send(404, b'{"error": "not found"}')
+
+            def do_POST(self):
+                if self.path.rstrip("/") != "/v1/generate":
+                    self._send(404, b'{"error": "not found"}')
+                    return
+                n = int(self.headers.get("Content-Length", "0"))
+                try:
+                    req = Request.from_json(json.loads(self.rfile.read(n)))
+                except (ValueError, KeyError) as e:
+                    self._send(400, json.dumps({"error": str(e)}).encode())
+                    return
+                if not outer.submit(req):
+                    self._send(503, b'{"error": "queue full"}')
+                    return
+                result = outer.wait(req.req_id, outer.request_timeout_s)
+                if result is None:
+                    self._send(504, b'{"error": "request timed out"}')
+                    return
+                self._send(200, json.dumps(result.to_json()).encode())
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        t = threading.Thread(target=self._httpd.serve_forever, daemon=True,
+                             name="front-door")
+        t.start()
+        self._threads.append(t)
+        log.info("router front door on http://%s:%d/v1/generate", host,
+                 self.port)
+        return self
+
+    def stats(self) -> dict:
+        return {
+            "queue_depth": self.queue.depth(),
+            "in_flight": self.active_requests(),
+            "workers": {
+                str(w.peer): {"healthy": w.healthy,
+                              "in_flight": w.in_flight}
+                for w in self.workers()
+            },
+            "completed": self.completed,
+            "requeued": self.requeued,
+            "expired": self.expired,
+            "dropped": 0,  # by construction; the drill asserts it anyway
+        }
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        for t in self._threads:
+            t.join(timeout=2)
+
+    def _count(self, event: str) -> None:
+        if self.counters is not None:
+            self.counters.inc_event(event)
+
+    def _gauge(self) -> None:
+        if self.counters is not None:
+            self.counters.set_gauge("queue_depth", float(self.queue.depth()))
+            self.counters.set_gauge("healthy_workers",
+                                    float(self.healthy_count()))
+
+
+class Autoscaler(threading.Thread):
+    """Queue-depth-driven worker-count controller.
+
+    Every `tick_s` it reads the router's depth/in-flight and, after a
+    sustained signal, commits a resized cluster document through the config
+    server's conditional PUT (a lost CAS race just re-reads next tick — the
+    same optimistic-concurrency discipline the training healer uses).  It
+    never touches processes: the supervisor reconciles the document.
+    """
+
+    def __init__(self, client: ConfigClient, router: Router,
+                 min_size: int = 1, max_size: int = 4,
+                 hi_depth: int = 4, up_after: int = 2, down_after: int = 12,
+                 tick_s: float = 0.5, counters=None):
+        super().__init__(daemon=True, name="autoscaler")
+        self.client = client
+        self.router = router
+        self.min_size = min_size
+        self.max_size = max_size
+        self.hi_depth = hi_depth
+        self.up_after = up_after
+        self.down_after = down_after
+        self.tick_s = tick_s
+        self.counters = counters
+        self.events: List[dict] = []
+        self._stop = threading.Event()
+        self._up_streak = 0
+        self._idle_streak = 0
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            self._tick()
+            self._stop.wait(self.tick_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _tick(self) -> None:
+        depth = self.router.queue.depth()
+        busy = self.router.active_requests()
+        # the cheap poll: document version/size via /health, no
+        # deserialization (the endpoint this PR adds to the config server)
+        health = self.client.get_health()
+        if health is None:
+            return
+        size = int(health.get("size", 0))
+        self._up_streak = self._up_streak + 1 if depth >= self.hi_depth else 0
+        # idle = nothing queued, nothing in flight, AND the fleet has served
+        # at least one request — a freshly provisioned fleet waiting for its
+        # first traffic is "warming", not "idle", and must not shed workers
+        idle = depth == 0 and busy == 0 and self.router.completed > 0
+        self._idle_streak = self._idle_streak + 1 if idle else 0
+        if self._up_streak >= self.up_after and size < self.max_size:
+            if self._commit(size + 1, "scale_up", depth):
+                self._up_streak = 0
+        elif self._idle_streak >= self.down_after and size > self.min_size:
+            if self._commit(size - 1, "scale_down", depth):
+                self._idle_streak = 0
+
+    def _commit(self, new_size: int, kind: str, depth: int) -> bool:
+        got = self.client.poll_cluster()
+        if got is None:
+            return False
+        cluster, version = got
+        if cluster.size() == new_size:
+            return True  # someone else got there; signal satisfied
+        try:
+            resized = cluster.resize(new_size)
+        except ValueError as e:
+            log.warning("autoscale %s to %d impossible: %s", kind, new_size, e)
+            return False
+        if not self.client.put_cluster(resized, version=version):
+            return False  # lost the CAS race: re-read next tick
+        log.info("AUTOSCALE %s: %d -> %d workers (queue depth %d, v%d)",
+                 kind, cluster.size(), new_size, depth, version + 1)
+        event = {"kind": kind, "old_size": cluster.size(),
+                 "new_size": new_size, "queue_depth": depth,
+                 "cluster_version": version + 1}
+        self.events.append(event)
+        journal_event(kind, **event)
+        if self.counters is not None:
+            self.counters.inc_event("autoscale_events")
+            self.counters.inc_event(f"autoscale_{kind}")
+        return True
+
+
+def shrink_preserving(cluster: Cluster, dead: PeerID) -> Cluster:
+    """Pure deletion of one worker (order-preserving) — the serving analog
+    of the healer's shrink, kept for operators who want heal-style removal
+    instead of restart-in-place."""
+    from ..plan import PeerList
+
+    return Cluster(runners=cluster.runners,
+                   workers=PeerList(p for p in cluster.workers if p != dead))

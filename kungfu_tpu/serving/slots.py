@@ -1,0 +1,100 @@
+"""KV-cache slot management for continuous batching.
+
+The decode cache is one fixed-shape pytree of [slots, max_len, ...] arrays
+(models/transformer.py decode mode, per-slot cursors).  `SlotManager` is the
+host-side ledger binding batch rows to requests; the jitted helpers below do
+the cache surgery:
+
+  write_slot   graft a freshly prefilled single-request cache (batch row 0 of
+               a [1, max_len, ...] tree) into the big cache at `slot`, cursor
+               set to the request's true (un-padded) length
+  reset_slot   zero a released slot's cursor + overflow flag so a free row's
+               ride-along decode writes restart from row 0 instead of
+               marching toward max_len
+
+Both compile once per cache shape (the shapes never change at runtime — that
+is the no-recompile contract of the fixed-shape slot batch).
+"""
+from __future__ import annotations
+
+import threading
+from functools import partial
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .request import Request
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def write_slot(big, small, slot):
+    """big[slot] = small[0] for every cache leaf (cursor/overflow included —
+    the prefill path already fixed those to (true_len, False))."""
+    return jax.tree.map(
+        lambda b, s: jax.lax.dynamic_update_slice_in_dim(
+            b, s.astype(b.dtype), slot, axis=0
+        ),
+        big, small,
+    )
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def reset_slot(big, slot):
+    """Zero `slot`'s cursor and overflow flag; K/V rows are left in place
+    (never attended: the mask only reads rows at or below the cursor)."""
+
+    def fix(path, leaf):
+        name = getattr(path[-1], "key", None)
+        if name == "idx":
+            return leaf.at[slot].set(0)
+        if name == "overflowed":
+            return leaf.at[slot].set(False)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(fix, big)
+
+
+class SlotManager:
+    """Free-list of batch rows; binds at most one request per slot."""
+
+    def __init__(self, n_slots: int):
+        if n_slots < 1:
+            raise ValueError("need at least one slot")
+        self.n_slots = n_slots
+        self._lock = threading.Lock()
+        self._free: List[int] = list(range(n_slots))
+        self._active: Dict[int, Request] = {}
+
+    def allocate(self, req: Request) -> Optional[int]:
+        with self._lock:
+            if not self._free:
+                return None
+            slot = self._free.pop(0)
+            self._active[slot] = req
+            return slot
+
+    def release(self, slot: int) -> Request:
+        with self._lock:
+            req = self._active.pop(slot)
+            self._free.append(slot)
+            self._free.sort()  # deterministic reuse order (tests rely on it)
+            return req
+
+    def request_at(self, slot: int) -> Optional[Request]:
+        with self._lock:
+            return self._active.get(slot)
+
+    def active(self) -> Dict[int, Request]:
+        with self._lock:
+            return dict(self._active)
+
+    @property
+    def free_count(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def active_count(self) -> int:
+        with self._lock:
+            return len(self._active)

@@ -1,0 +1,382 @@
+"""One serving rank — `python -m kungfu_tpu.serving.worker`.
+
+A worker owns one ServingEngine replica and exposes it over HTTP:
+
+  POST /generate   one Request in, blocks until its Result (the router holds
+                   one connection per in-flight request, so worker-side
+                   concurrency == open connections == busy slots); 503 on
+                   backpressure, 400 on a request that can never fit
+  GET  /healthz    engine stats — the router's health probe
+  GET  /weights    this replica's params as a resilience.buddy snapshot blob
+                   (the sub-second rejoin path: a respawned rank pulls
+                   weights from a live peer instead of re-initializing)
+  POST /warm       warm-state ship from a peer: its in-flight requests'
+                   generated-so-far tokens, held here so the router can
+                   resume them if that peer dies
+  GET  /warm?origin=R   the warm set shipped by rank R (the router reads a
+                   dead rank's buddy to resume its streams mid-output)
+
+Weight resolution at boot climbs a serving flavor of the recovery ladder
+(docs/serving.md): buddy (live peer fetch over HTTP, rejoins only) ->
+file (--weights-file pickle, e.g. exported from a training checkpoint) ->
+seed (deterministic init).  The rung lands in the `rank_rejoined` journal
+event, the acceptance signal of the serve drill.
+
+Chaos: the decode loop calls ChaosInjector.on_serve_tokens after every
+engine iteration, so `crash_serve@tokens=N:rank=R` kills this process
+mid-stream with requests in flight.
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import pickle
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+
+from ..utils import get_logger
+
+log = get_logger("kungfu.serving")
+
+# compact model presets for drills/benches; --model-json overrides fields
+PRESETS: Dict[str, dict] = {
+    "tiny": dict(vocab_size=64, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+                 max_len=96, n_kv_heads=2),
+    "small": dict(vocab_size=256, d_model=128, n_layers=4, n_heads=8,
+                  d_ff=256, max_len=512, n_kv_heads=4),
+}
+
+
+def build_config(preset: str, overrides_json: str = ""):
+    import jax.numpy as jnp
+
+    from ..models.transformer import TransformerConfig
+
+    kw = dict(PRESETS[preset])
+    kw.update(rope=True, attention="full", dtype=jnp.float32, norm="rms",
+              ffn="swiglu")
+    if overrides_json:
+        kw.update(json.loads(overrides_json))
+    return TransformerConfig(**kw)
+
+
+def seed_params(cfg, seed: int = 0):
+    """Deterministic params — identical on every rank for a given seed, so
+    data-parallel replicas agree without any weight exchange."""
+    import jax
+    import jax.numpy as jnp
+    import flax.linen as nn
+
+    from ..models.transformer import TransformerLM
+
+    model = TransformerLM(cfg)
+    probe = jnp.zeros((1, 4), jnp.int32)
+    return nn.meta.unbox(model.init(jax.random.PRNGKey(seed), probe)["params"])
+
+
+def _to_numpy(tree):
+    import jax
+    import numpy as np
+
+    return jax.tree.map(lambda x: np.asarray(x), tree)
+
+
+class WarmStore:
+    """Warm-resume state held FOR peers: {origin_rank: {req_id: item}}.
+    Bounded per origin — the shipping side only ever has `slots` requests in
+    flight, so the bound is belt-and-braces against a looping shipper."""
+
+    def __init__(self, per_origin_cap: int = 64):
+        self._lock = threading.Lock()
+        self._by_origin: Dict[int, Dict[str, dict]] = {}
+        self._cap = per_origin_cap
+
+    def put(self, origin: int, items: List[dict]) -> None:
+        with self._lock:
+            # full replacement: the ship is a snapshot of CURRENT in-flight
+            # work; completed requests must drop out so a resume can't
+            # resurrect them
+            self._by_origin[origin] = {
+                it["id"]: it for it in items[: self._cap]
+            }
+
+    def get(self, origin: int) -> List[dict]:
+        with self._lock:
+            return list(self._by_origin.get(origin, {}).values())
+
+
+class ServingWorker:
+    def __init__(self, args):
+        from ..chaos.inject import injector_from_env
+        from ..monitor.counters import counters_if_enabled
+        from ..monitor.journal import journal_event, set_journal_context
+
+        self.args = args
+        self.rank = args.launch_rank
+        self.incarnation = args.incarnation
+        set_journal_context(rank=self.rank, identity=f"serve-{self.rank}")
+        self.counters = counters_if_enabled()
+        self.injector = injector_from_env()
+        self.warm = WarmStore()
+        self._stop = threading.Event()
+        self._peer_cache: tuple = (0.0, [])  # (fetched_at, urls)
+
+        cfg = build_config(args.preset, args.model_json)
+        t0 = time.monotonic()
+        params, rung = self._resolve_weights(cfg)
+        restore_s = time.monotonic() - t0
+        self.weight_rung = rung
+        if self.incarnation > 0:
+            journal_event("rank_rejoined", rank=self.rank,
+                          incarnation=self.incarnation, recovery_rung=rung,
+                          restore_s=round(restore_s, 3))
+            if self.counters is not None:
+                self.counters.inc_event(f"serve_rejoin_{rung}")
+                self.counters.set_gauge("serve_restore_s", restore_s)
+        log.info("worker rank=%d incarnation=%d weights=%s (%.2fs)",
+                 self.rank, self.incarnation, rung, restore_s)
+
+        from .engine import ServingEngine
+
+        self.engine = ServingEngine(
+            cfg, params, slots=args.slots,
+            queue_capacity=args.queue_capacity, counters=self.counters,
+        )
+        # the blob served on /weights: packed once (params are immutable)
+        from ..resilience.buddy import pack_snapshot
+
+        self._weights_blob = pack_snapshot(
+            step=self.incarnation, offset=0,
+            state={"params": _to_numpy(params)},
+            origin_rank=self.rank, cluster_version=0,
+        ).tobytes()
+
+    # -- weight ladder -------------------------------------------------------------
+
+    def _resolve_weights(self, cfg):
+        from ..resilience.buddy import buddy_enabled
+
+        if self.incarnation > 0 and self.args.config_server and buddy_enabled():
+            got = self._fetch_buddy_weights()
+            if got is not None:
+                return got, "buddy"
+        if self.args.weights_file:
+            try:
+                with open(self.args.weights_file, "rb") as f:
+                    return pickle.load(f), "file"
+            except (OSError, pickle.PickleError) as e:
+                log.warning("weights file unusable (%s); falling to seed", e)
+        return seed_params(cfg, self.args.seed), "seed"
+
+    def _peer_urls(self, max_age_s: float = 2.0) -> List[str]:
+        """Live peers (not self) from the cluster document, ring-buddy
+        first — the same ring-offset preference the training ladder uses.
+        Cached for `max_age_s`: the warm shipper calls this several times a
+        second and the document rarely moves."""
+        from ..elastic.config_client import ConfigClient
+
+        t, urls = self._peer_cache
+        if time.monotonic() - t < max_age_s:
+            return urls
+        try:
+            got = ConfigClient(self.args.config_server,
+                               retries=2, retry_deadline_s=3.0).get_cluster()
+        except OSError:
+            return []
+        if got is None:
+            return []
+        workers, _ = got[0].workers, got[1]
+        self_spec = f"{self.args.host}:{self.args.port}"
+        urls = [f"http://{p.host}:{p.port}" for p in workers
+                if str(p) != self_spec]
+        my_idx = next((i for i, p in enumerate(workers)
+                       if str(p) == self_spec), None)
+        if my_idx is not None and len(workers) > 1:
+            buddies = workers.ring_buddies()
+            b = workers[buddies[my_idx]]
+            burl = f"http://{b.host}:{b.port}"
+            if burl in urls:
+                urls.remove(burl)
+                urls.insert(0, burl)
+        self._peer_cache = (time.monotonic(), urls)
+        return urls
+
+    def _fetch_buddy_weights(self):
+        from ..resilience.buddy import unpack_snapshot
+
+        for url in self._peer_urls():
+            try:
+                with urllib.request.urlopen(
+                    url + "/weights", timeout=self.args.buddy_timeout_s
+                ) as r:
+                    blob = r.read()
+            except OSError as e:
+                log.info("buddy weights from %s failed: %s", url, str(e)[:120])
+                continue
+            import numpy as np
+
+            snap = unpack_snapshot(np.frombuffer(blob, dtype=np.uint8))
+            if snap is not None and "params" in snap.get("state", {}):
+                log.info("weights restored from buddy %s", url)
+                return snap["state"]["params"]
+        return None
+
+    # -- loops ---------------------------------------------------------------------
+
+    def _engine_loop(self) -> None:
+        last_ship = 0.0
+        while not self._stop.is_set():
+            done = self.engine.step()
+            if self.injector is not None:
+                self.injector.on_serve_tokens(self.engine.total_tokens,
+                                              self.rank)
+            now = time.monotonic()
+            if (self.args.config_server
+                    and now - last_ship > self.args.warm_ship_s):
+                last_ship = now
+                self._ship_warm()
+            if not done and not self.engine.slot_mgr.active_count \
+                    and not self.engine.queue.depth():
+                time.sleep(0.002)
+
+    def _ship_warm(self) -> None:
+        """Best-effort POST of in-flight progress to the ring buddy; a dead
+        buddy costs one short timeout, never a decode stall."""
+        items = self.engine.in_flight()
+        urls = self._peer_urls()
+        if not urls:
+            return
+        body = json.dumps({"origin": self.rank, "items": items}).encode()
+        req = urllib.request.Request(
+            urls[0] + "/warm", data=body, method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=1.0):
+                pass
+        except OSError:
+            if self.counters is not None:
+                self.counters.inc_event("warm_ship_failed")
+
+    # -- HTTP ----------------------------------------------------------------------
+
+    def serve(self) -> int:
+        from ..monitor.server import maybe_start_monitor
+
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code: int, body: bytes,
+                      ctype: str = "application/json") -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0].rstrip("/")
+                if path == "/healthz":
+                    stats = dict(outer.engine.stats())
+                    stats.update(ok=True, rank=outer.rank,
+                                 incarnation=outer.incarnation,
+                                 weight_rung=outer.weight_rung)
+                    self._send(200, json.dumps(stats).encode())
+                elif path == "/weights":
+                    self._send(200, outer._weights_blob,
+                               "application/octet-stream")
+                elif path == "/warm":
+                    q = self.path.partition("?")[2]
+                    origin = -1
+                    for part in q.split("&"):
+                        if part.startswith("origin="):
+                            origin = int(part[len("origin="):])
+                    self._send(200, json.dumps(
+                        {"items": outer.warm.get(origin)}).encode())
+                else:
+                    self._send(404, b'{"error": "not found"}')
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", "0"))
+                try:
+                    doc = json.loads(self.rfile.read(n).decode())
+                except ValueError as e:
+                    self._send(400, json.dumps({"error": str(e)}).encode())
+                    return
+                path = self.path.rstrip("/")
+                if path == "/warm":
+                    outer.warm.put(int(doc.get("origin", -1)),
+                                   doc.get("items", []))
+                    self._send(200, b"{}")
+                    return
+                if path != "/generate":
+                    self._send(404, b'{"error": "not found"}')
+                    return
+                from .engine import BackpressureError
+                from .request import Request
+
+                try:
+                    pending = outer.engine.submit(Request.from_json(doc))
+                except BackpressureError as e:
+                    self._send(503, json.dumps({"error": str(e)}).encode())
+                    return
+                except ValueError as e:
+                    self._send(400, json.dumps({"error": str(e)}).encode())
+                    return
+                result = pending.wait(outer.args.request_timeout_s)
+                if result is None:
+                    self._send(504, b'{"error": "request timed out"}')
+                    return
+                self._send(200, json.dumps(result.to_json()).encode())
+
+        httpd = ThreadingHTTPServer((self.args.host, self.args.port), Handler)
+        monitor = maybe_start_monitor(self.args.port, host=self.args.host)
+        loop = threading.Thread(target=self._engine_loop, daemon=True)
+        loop.start()
+        print(f"SERVE_WORKER_READY: rank={self.rank} "
+              f"url=http://{self.args.host}:{self.args.port} "
+              f"rung={self.weight_rung}", flush=True)
+        try:
+            httpd.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            self._stop.set()
+            loop.join(timeout=5)
+            httpd.server_close()
+            if monitor is not None:
+                monitor.close()
+        return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="kungfu_tpu.serving.worker")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--launch-rank", type=int, default=0)
+    ap.add_argument("--incarnation", type=int, default=0)
+    ap.add_argument("--config-server", default="")
+    ap.add_argument("--preset", default="tiny", choices=sorted(PRESETS))
+    ap.add_argument("--model-json", default="",
+                    help="TransformerConfig field overrides as JSON")
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--queue-capacity", type=int, default=64)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--weights-file", default="",
+                    help="pickled params pytree (checkpoint-exported)")
+    ap.add_argument("--warm-ship-s", type=float, default=0.15)
+    ap.add_argument("--buddy-timeout-s", type=float, default=3.0)
+    ap.add_argument("--request-timeout-s", type=float, default=120.0)
+    args = ap.parse_args(argv)
+    return ServingWorker(args).serve()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -54,6 +54,14 @@ echo "== checkpoint integrity: crash-in-save drill (CPU) =="
 # step; the restart must demote it and resume from the verified one
 JAX_PLATFORMS=cpu python -m kungfu_tpu.chaos --ckpt-drill crash_in_save --timeout 240
 
+echo "== serving smoke: rank kill + buddy rejoin + autoscale drill (CPU) =="
+# a 2-rank serving fleet survives a scripted crash_serve kill mid-stream:
+# zero dropped requests (the router re-queues the victim's in-flight work),
+# the victim rejoins from a live peer's weights (journal rank_rejoined with
+# recovery_rung=buddy, sub-second), and queue-depth-driven scale-down then
+# scale-up both commit through the config server (docs/serving.md)
+JAX_PLATFORMS=cpu python -m kungfu_tpu.chaos --serve-drill --timeout 300
+
 echo "== telemetry smoke: fleet aggregation + merged timeline (CPU) =="
 # 2-process run under -telemetry: fleet /metrics must merge both ranks
 # with consistent counter sums, /timeline must parse as valid Chrome trace

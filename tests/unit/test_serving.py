@@ -1,0 +1,428 @@
+"""Elastic inference serving subsystem (kungfu_tpu/serving/).
+
+Fast tier: admission-queue semantics (FIFO, deadlines, backpressure,
+re-queue-to-front), slot ledger, continuous-batching engine parity against
+the full-sequence forward (greedy tokens identical under interleaved
+admissions and slot reuse), warm-resume determinism, int8 KV serving, the
+crash_serve chaos grammar, the config server's /health endpoint, and the
+queue-depth autoscaler against a real config server.  Slow tier (`faults`
++ `slow`): the multi-process CPU drill — a serving rank killed mid-stream,
+zero dropped requests, buddy-weight rejoin, scale-down/up commits.
+"""
+import dataclasses
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from kungfu_tpu.models.transformer import TransformerConfig, TransformerLM, generate
+from kungfu_tpu.serving import (
+    AdmissionQueue,
+    BackpressureError,
+    Request,
+    ServingEngine,
+    SlotManager,
+    default_buckets,
+)
+
+pytestmark = pytest.mark.serving
+
+
+def _cfg(**kw):
+    base = dict(vocab_size=64, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+                max_len=48, rope=True, n_kv_heads=2, attention="full",
+                dtype=jnp.float32)
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    cfg = _cfg()
+    model = TransformerLM(cfg)
+    probe = jnp.zeros((1, 4), jnp.int32)
+    params = nn.meta.unbox(model.init(jax.random.PRNGKey(0), probe)["params"])
+    return cfg, model, params
+
+
+# -- request/queue ---------------------------------------------------------------------
+
+
+class TestAdmissionQueue:
+    def test_fifo_and_depth(self):
+        q = AdmissionQueue(capacity=4)
+        reqs = [Request(prompt=(1, 2), max_new_tokens=1) for _ in range(3)]
+        assert all(q.put(r) for r in reqs)
+        assert q.depth() == 3
+        assert [q.pop() for _ in range(3)] == reqs
+        assert q.pop(timeout_s=0.01) is None
+
+    def test_backpressure_at_capacity(self):
+        q = AdmissionQueue(capacity=2)
+        assert q.put(Request(prompt=(1,), max_new_tokens=1))
+        assert q.put(Request(prompt=(1,), max_new_tokens=1))
+        assert not q.put(Request(prompt=(1,), max_new_tokens=1))
+
+    def test_requeue_jumps_the_line_and_never_drops(self):
+        q = AdmissionQueue(capacity=1)
+        first = Request(prompt=(1,), max_new_tokens=1)
+        assert q.put(first)
+        victim = Request(prompt=(2,), max_new_tokens=1)
+        q.requeue(victim)  # over capacity on purpose: re-queues cannot drop
+        assert q.depth() == 2
+        assert q.pop() is victim
+        assert victim.requeues == 1
+        assert q.pop() is first
+
+    def test_expired_swept_to_rejection_not_wedged(self):
+        q = AdmissionQueue()
+        dead = Request(prompt=(1,), max_new_tokens=1, deadline_s=0.01)
+        live = Request(prompt=(2,), max_new_tokens=1)
+        q.put(dead)
+        q.put(live)
+        time.sleep(0.03)
+        assert q.pop() is live  # the expired one is skipped, not returned
+        swept = q.drain_expired()
+        assert swept == [dead]
+        assert q.drain_expired() == []
+
+
+class TestSlotManager:
+    def test_allocate_release_reuse(self):
+        sm = SlotManager(2)
+        a = Request(prompt=(1,), max_new_tokens=1)
+        b = Request(prompt=(2,), max_new_tokens=1)
+        sa, sb = sm.allocate(a), sm.allocate(b)
+        assert {sa, sb} == {0, 1}
+        assert sm.allocate(Request(prompt=(3,), max_new_tokens=1)) is None
+        assert sm.release(sa) is a
+        assert sm.free_count == 1
+        # deterministic reuse: lowest freed slot first
+        assert sm.allocate(Request(prompt=(4,), max_new_tokens=1)) == sa
+
+
+# -- engine ----------------------------------------------------------------------------
+
+
+class TestEngine:
+    def test_greedy_parity_with_full_forward(self, model_and_params):
+        """Continuous-batched greedy == generate() == naive full-sequence
+        argmax, across interleaved admissions and slot reuse (5 requests
+        over 2 slots)."""
+        cfg, model, params = model_and_params
+        eng = ServingEngine(cfg, params, slots=2, prefill_buckets=(8, 16))
+        rs = np.random.RandomState(0)
+        prompts = [rs.randint(1, 64, (n,)).astype(np.int32)
+                   for n in (5, 7, 3, 9, 4)]
+        pend = [eng.submit(Request(prompt=tuple(p), max_new_tokens=6))
+                for p in prompts]
+        eng.run_until_idle()
+        for p, pd in zip(prompts, pend):
+            assert pd.result.status == "ok"
+            ref = np.asarray(generate(cfg, params, jnp.asarray(p)[None], 6))[0]
+            np.testing.assert_array_equal(np.asarray(pd.result.tokens), ref)
+            # naive reference: recompute the whole sequence every step
+            seq = list(p)
+            for _ in range(6):
+                logits = model.apply({"params": params},
+                                     jnp.asarray(seq)[None])
+                seq.append(int(np.asarray(logits)[0, -1].argmax()))
+            np.testing.assert_array_equal(np.asarray(pd.result.tokens), seq)
+
+    def test_slot_reuse_after_eviction_is_clean(self, model_and_params):
+        """A slot that served a long request then a short one must not leak
+        stale KV rows into the reuse (per-slot cursor reset + masking)."""
+        cfg, _, params = model_and_params
+        eng = ServingEngine(cfg, params, slots=1, prefill_buckets=(8, 16))
+        rs = np.random.RandomState(1)
+        long_p = tuple(rs.randint(1, 64, (14,)))
+        short_p = tuple(rs.randint(1, 64, (3,)))
+        r1 = eng.submit(Request(prompt=long_p, max_new_tokens=8))
+        r2 = eng.submit(Request(prompt=short_p, max_new_tokens=8))
+        eng.run_until_idle()
+        for p, pd in ((long_p, r1), (short_p, r2)):
+            ref = np.asarray(
+                generate(cfg, params, jnp.asarray(p)[None], 8))[0]
+            np.testing.assert_array_equal(np.asarray(pd.result.tokens), ref)
+
+    def test_warm_resume_matches_uninterrupted(self, model_and_params):
+        """prior_tokens (the re-queue warm path) must continue the stream
+        exactly: prompt+prior re-prefilled, only the remainder generated."""
+        cfg, _, params = model_and_params
+        eng = ServingEngine(cfg, params, slots=2, prefill_buckets=(8, 16))
+        prompt = (5, 9, 2, 7)
+        full = eng.submit(Request(prompt=prompt, max_new_tokens=8))
+        eng.run_until_idle()
+        tokens = list(full.result.tokens)
+        prior = tuple(tokens[len(prompt):len(prompt) + 3])  # "died" after 3
+        resumed = eng.submit(Request(prompt=prompt, max_new_tokens=8,
+                                     prior_tokens=prior))
+        eng.run_until_idle()
+        assert list(resumed.result.tokens) == tokens
+
+    def test_deadline_expired_rejected_not_wedged(self, model_and_params):
+        cfg, _, params = model_and_params
+        eng = ServingEngine(cfg, params, slots=1, prefill_buckets=(8,))
+        dead = eng.submit(Request(prompt=(1, 2, 3), max_new_tokens=4,
+                                  deadline_s=0.01))
+        time.sleep(0.05)
+        live = eng.submit(Request(prompt=(1, 2, 3), max_new_tokens=4))
+        eng.run_until_idle()
+        assert dead.result.status == "expired"
+        assert live.result.status == "ok"
+
+    def test_backpressure_and_impossible_requests(self, model_and_params):
+        cfg, _, params = model_and_params
+        eng = ServingEngine(cfg, params, slots=1, queue_capacity=1,
+                            prefill_buckets=(8,))
+        with pytest.raises(ValueError):  # can never fit in max_len
+            eng.submit(Request(prompt=(1,) * 8, max_new_tokens=cfg.max_len))
+        eng.submit(Request(prompt=(1, 2), max_new_tokens=2))
+        with pytest.raises(BackpressureError):
+            eng.submit(Request(prompt=(1, 2), max_new_tokens=2))
+
+    def test_int8_kv_cache_serving(self, model_and_params):
+        """kv_cache_dtype="int8" flows from the model config into the
+        serving cache: int8 + f32 scale leaves, outputs near the fp cache."""
+        cfg, _, params = model_and_params
+        icfg = dataclasses.replace(cfg, kv_cache_dtype="int8")
+        eng = ServingEngine(icfg, params, slots=2, prefill_buckets=(8,))
+        dtypes = {leaf.dtype.name for leaf in jax.tree.leaves(eng.cache)}
+        assert "int8" in dtypes and "float32" in dtypes
+        prompt = (3, 1, 4, 1, 5)
+        pd = eng.submit(Request(prompt=prompt, max_new_tokens=6))
+        eng.run_until_idle()
+        assert pd.result.status == "ok"
+        assert len(pd.result.tokens) == len(prompt) + 6
+
+    def test_counters_telemetry(self, model_and_params):
+        from kungfu_tpu.monitor.counters import Counters
+
+        cfg, _, params = model_and_params
+        c = Counters()
+        eng = ServingEngine(cfg, params, slots=2, prefill_buckets=(8,),
+                            counters=c)
+        eng.submit(Request(prompt=(1, 2, 3), max_new_tokens=4))
+        eng.run_until_idle()
+        hists = c.hist_summaries()
+        assert hists["ttft_ms"][""]["count"] == 1
+        assert hists["tok_latency_ms"][""]["count"] >= 3
+        assert c.events().get("requests_completed") == 1
+        assert "queue_depth" in c.gauges()
+
+    def test_default_buckets_cover_max_len(self):
+        assert default_buckets(96) == (16, 32, 64, 96)
+        assert default_buckets(16) == (16,)
+
+
+# -- chaos grammar ---------------------------------------------------------------------
+
+
+class TestCrashServeFault:
+    def test_parse(self):
+        from kungfu_tpu.chaos.plan import parse_fault_plan
+
+        plan = parse_fault_plan("crash_serve@tokens=24:rank=1")
+        (f,) = plan.serve_faults()
+        assert (f.tokens, f.rank, f.code) == (24, 1, 45)
+        assert not plan.worker_faults()
+
+    def test_parse_rejects_malformed(self):
+        from kungfu_tpu.chaos.plan import parse_fault_plan
+
+        with pytest.raises(ValueError):
+            parse_fault_plan("crash_serve@rank=1")  # missing tokens=
+        with pytest.raises(ValueError):
+            parse_fault_plan("crash_serve@tokens=5:rank=1:code=0")
+
+    def test_injector_fires_once_at_threshold(self):
+        from kungfu_tpu.chaos.inject import ChaosInjector
+        from kungfu_tpu.chaos.plan import parse_fault_plan
+
+        exits = []
+        inj = ChaosInjector(parse_fault_plan("crash_serve@tokens=10:rank=1"),
+                            exit_fn=exits.append)
+        inj.on_serve_tokens(9, rank=1)
+        assert exits == []
+        inj.on_serve_tokens(10, rank=0)  # wrong rank
+        assert exits == []
+        inj.on_serve_tokens(10, rank=1)
+        inj.on_serve_tokens(11, rank=1)
+        assert exits == [45]  # one-shot
+
+
+# -- config server /health -------------------------------------------------------------
+
+
+class TestConfigHealth:
+    def test_health_endpoint_and_client(self):
+        from kungfu_tpu.elastic.config_client import ConfigClient
+        from kungfu_tpu.elastic.config_server import ConfigServer
+        from kungfu_tpu.plan import Cluster, HostList
+
+        cluster = Cluster.from_hostlist(HostList.parse("127.0.0.1:4"), 2)
+        srv = ConfigServer(host="127.0.0.1", port=0, init=cluster).start()
+        try:
+            client = ConfigClient(srv.url)
+            h = client.get_health()
+            assert h == {"ok": True, "version": 0, "size": 2,
+                         "cleared": False}
+            assert client.put_cluster(cluster.resize(3), version=0)
+            h = client.get_health()
+            assert (h["version"], h["size"]) == (1, 3)
+        finally:
+            srv.stop()
+
+    def test_health_served_inside_flap_window(self):
+        from kungfu_tpu.chaos.inject import ServerChaos
+        from kungfu_tpu.chaos.plan import parse_fault_plan
+        from kungfu_tpu.elastic.config_client import ConfigClient
+        from kungfu_tpu.elastic.config_server import ConfigServer
+        from kungfu_tpu.plan import Cluster, HostList
+
+        chaos = ServerChaos(parse_fault_plan("flap@config_server=30s:after=0"))
+        cluster = Cluster.from_hostlist(HostList.parse("127.0.0.1:2"), 2)
+        srv = ConfigServer(host="127.0.0.1", port=0, init=cluster,
+                           chaos=chaos).start()
+        try:
+            client = ConfigClient(srv.url, retries=0, retry_deadline_s=0.5)
+            assert client.poll_cluster() is None  # document plane flapped
+            h = client.get_health()  # liveness still answers
+            assert h is not None and h["ok"]
+        finally:
+            srv.stop()
+
+
+# -- autoscaler ------------------------------------------------------------------------
+
+
+class _StubRouter:
+    """Just enough router surface for the Autoscaler: a queue with depth(),
+    an active-request count, and the served-traffic counter."""
+
+    def __init__(self):
+        self._depth = 0
+        self.busy = 0
+        self.completed = 0
+        self.queue = self
+
+    def depth(self):
+        return self._depth
+
+    def active_requests(self):
+        return self.busy
+
+
+class TestAutoscaler:
+    def _scaler(self, srv, router, **kw):
+        from kungfu_tpu.elastic.config_client import ConfigClient
+        from kungfu_tpu.serving.router import Autoscaler
+
+        kw.setdefault("min_size", 1)
+        kw.setdefault("max_size", 3)
+        kw.setdefault("hi_depth", 4)
+        kw.setdefault("up_after", 2)
+        kw.setdefault("down_after", 2)
+        return Autoscaler(ConfigClient(srv.url), router, **kw)
+
+    def _server(self, np=2):
+        from kungfu_tpu.elastic.config_server import ConfigServer
+        from kungfu_tpu.plan import Cluster, HostList
+
+        cluster = Cluster.from_hostlist(HostList.parse("127.0.0.1:4"), np)
+        return ConfigServer(host="127.0.0.1", port=0, init=cluster).start()
+
+    def test_scale_up_after_sustained_depth(self):
+        srv = self._server()
+        try:
+            router = _StubRouter()
+            router._depth = 5
+            scaler = self._scaler(srv, router)
+            scaler._tick()  # streak 1: no commit yet
+            assert not scaler.events
+            scaler._tick()  # streak 2: commit
+            assert [e["kind"] for e in scaler.events] == ["scale_up"]
+            assert scaler.client.get_health()["size"] == 3
+        finally:
+            srv.stop()
+
+    def test_scale_down_requires_served_traffic(self):
+        srv = self._server()
+        try:
+            router = _StubRouter()
+            scaler = self._scaler(srv, router)
+            for _ in range(5):  # idle but never served: warming, not idle
+                scaler._tick()
+            assert not scaler.events
+            router.completed = 7
+            scaler._tick()
+            scaler._tick()
+            assert [e["kind"] for e in scaler.events] == ["scale_down"]
+            assert scaler.client.get_health()["size"] == 1
+        finally:
+            srv.stop()
+
+    def test_min_size_floor(self):
+        srv = self._server(np=1)
+        try:
+            router = _StubRouter()
+            router.completed = 1
+            scaler = self._scaler(srv, router)
+            for _ in range(6):
+                scaler._tick()
+            assert not scaler.events  # already at the floor
+        finally:
+            srv.stop()
+
+    def test_lost_cas_race_retries(self):
+        srv = self._server()
+        try:
+            router = _StubRouter()
+            router._depth = 9
+            scaler = self._scaler(srv, router, up_after=1)
+            # another writer moves the document between health read and PUT
+            real_poll = scaler.client.poll_cluster
+
+            def racing_poll():
+                got = real_poll()
+                cluster, version = got
+                # report a stale version so the conditional PUT loses
+                return cluster, version - 1
+
+            scaler.client.poll_cluster = racing_poll
+            scaler._tick()
+            assert not scaler.events  # lost the race, no event
+            scaler.client.poll_cluster = real_poll
+            scaler._tick()
+            assert [e["kind"] for e in scaler.events] == ["scale_up"]
+        finally:
+            srv.stop()
+
+
+# -- multi-process drill ---------------------------------------------------------------
+
+
+@pytest.mark.faults
+@pytest.mark.slow
+class TestServeDrill:
+    def test_rank_kill_zero_drops_rejoin_and_autoscale(self):
+        """The end-to-end serving contract on a real 2-rank CPU fleet: a
+        crash_serve kill mid-stream, every request completes (0 dropped),
+        the victim rejoins from buddy weights (journal rank_rejoined with
+        recovery_rung=buddy), and scale-down + scale-up both commit."""
+        from kungfu_tpu.serving.drill import run_serve_drill
+
+        summary = run_serve_drill(np=2, timeout_s=300.0)
+        assert summary["ok"], summary["failures"]
+        assert summary["completed"] == summary["requests"]
+        assert summary["requeued_requests"] >= 1
+        assert summary["rejoin_rung"] == "buddy"
+        assert summary["rejoin_restore_s"] < 1.0  # sub-second weight rejoin
+        counts = summary["journal_event_counts"]
+        assert counts.get("request_requeued", 0) >= 1
+        assert counts.get("scale_down", 0) >= 1
+        assert counts.get("scale_up", 0) >= 1
