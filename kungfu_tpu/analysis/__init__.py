@@ -53,6 +53,7 @@ from .check import (  # noqa: F401
     check,
     check_and_raise,
     check_axes_in_scope,
+    check_collective_plan,
     check_elastic_permutations,
 )
 
@@ -64,5 +65,6 @@ __all__ = [
     "Collective", "CondSite", "Extraction", "OutputLeak", "extract",
     "RULES", "RuleContext", "run_rules",
     "abstractify", "assert_clean", "check", "check_and_raise",
-    "check_axes_in_scope", "check_elastic_permutations",
+    "check_axes_in_scope", "check_collective_plan",
+    "check_elastic_permutations",
 ]

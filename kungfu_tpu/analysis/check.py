@@ -201,6 +201,64 @@ def check_axes_in_scope(
     assert_clean(findings, context=context)
 
 
+def check_collective_plan(graph_pairs, n: int,
+                          what: str = "plan") -> List[Finding]:
+    """Graph-level kf-lint for a collective plan.
+
+    `graph_pairs` is the planner's (reduce_graph, bcast_graph) list (the
+    strategy_graphs shape).  Every pair must describe a legal program:
+    chain/ring rounds must be valid (partial) permutations — the same
+    injectivity XLA's ppermute needs (rule 3) — and trees must be
+    single-rooted, acyclic, and cover every rank, or the lowered collective
+    silently drops ranks.  This is the validity oracle the plan compiler
+    runs on every candidate before it may be installed.
+    """
+    from ..plan.graph import permutation_errors
+    from .findings import RULE_PERMUTATION
+
+    findings: List[Finding] = []
+
+    def err(msg: str) -> None:
+        findings.append(Finding(rule=RULE_PERMUTATION, severity=ERROR,
+                                message=msg))
+
+    for i, (reduce_g, bcast_g) in enumerate(graph_pairs):
+        tag = f"{what}[{i}]" if len(graph_pairs) > 1 else what
+        sized = True
+        for g, role in ((reduce_g, "reduce"), (bcast_g, "bcast")):
+            if len(g) != n:
+                err(f"{tag} {role} graph spans {len(g)} ranks, plan world "
+                    f"is {n}")
+                sized = False
+        if not sized:
+            continue
+        # the bcast orientation must be a covering tree: single root,
+        # acyclic, every rank reachable (chains count — fanout 1)
+        for problem in bcast_g.tree_errors():
+            err(f"{tag} bcast tree: {problem}; edges={bcast_g.edges()}")
+        # chain-shaped rounds (out-degree AND in-degree <= 1 everywhere,
+        # i.e. a genuine ring/pipeline hop) execute as ppermutes: the send
+        # pairs must satisfy the same injectivity XLA's ppermute needs.
+        # Tree rounds legitimately fan in (many children -> one father)
+        # and are covered by the tree check above instead.
+        for g, role in ((reduce_g, "reduce"), (bcast_g, "bcast")):
+            chain = all(len(g.nexts(r)) <= 1 and len(g.prevs(r)) <= 1
+                        for r in range(n))
+            if chain:
+                for problem in permutation_errors(g.edges(), n):
+                    err(f"{tag} {role} round: {problem}; edges={g.edges()}")
+        # the pair must agree: reducing along G and broadcasting along
+        # reverse(G) is the contract every strategy builder follows —
+        # a mismatched pair deadlocks (one side waits on an edge the
+        # other never drives)
+        rev = {(b, a) for a, b in reduce_g.edges()}
+        fwd = set(bcast_g.edges())
+        if rev != fwd:
+            err(f"{tag} reduce/bcast graphs disagree: reversed reduce "
+                f"edges {sorted(rev)} != bcast edges {sorted(fwd)}")
+    return _sorted(findings)
+
+
 def check_elastic_permutations(build_perm, sizes: Sequence[int],
                                what: str = "ppermute") -> List[Finding]:
     """Validate a size-parametric permutation builder over every cluster

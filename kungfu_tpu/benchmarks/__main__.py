@@ -20,7 +20,7 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="kungfu_tpu.benchmarks")
     p.add_argument("--bench", default="all_reduce",
                    choices=["all_reduce", "p2p", "attention", "compression",
-                            "serving"])
+                            "serving", "planner"])
     p.add_argument("--slots", type=int, default=4,
                    help="KV slots for --bench serving")
     p.add_argument("--requests", type=int, default=64,
@@ -70,6 +70,12 @@ def main(argv=None) -> int:
             preset=args.preset, kv_cache_dtype=args.kv_cache_dtype,
             out=args.out,
         )
+        return 0
+
+    if args.bench == "planner":
+        from .planner import bench_planner
+
+        bench_planner(steps=args.steps, out=args.out)
         return 0
 
     if args.bench == "compression":

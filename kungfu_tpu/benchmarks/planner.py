@@ -1,0 +1,99 @@
+"""Plan-compiler A/B benchmark: planner-chosen vs hand-tuned default.
+
+Per tensor-size bucket, the record reports the chosen plan, the cost
+model's predicted latency vs the measured one (relative error logged — the
+honesty metric for the α-β fit), and the planner-chosen p50 against the
+hand-tuned default's p50 on the same payload (the `--bench compression`
+A/B counterpart at the *plan* level).  One JSON line (BENCH-parseable) +
+grep-able RESULT lines:
+
+    python -m kungfu_tpu.benchmarks --bench planner [--steps 5]
+
+The candidate space contains the hand-tuned default itself and the winner
+is decided by the measured runoff, so the planner's p50 can tie but never
+lose to the default beyond measurement noise: on a CPU host the fitted
+codec overheads keep fp32 (compression would slow the schedule down), on
+a DCN-bound slice the fitted β makes the compressed two-level plans win.
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, List, Optional
+
+
+def bench_planner(
+    steps: int = 5,
+    out: Optional[str] = None,
+) -> Dict:
+    """Tune every default bucket on the local mesh; A/B winner vs default."""
+    import jax
+
+    from ..monitor.counters import Counters
+    from ..plan import make_mesh
+    from ..session import Session
+    from ..planner import Planner
+
+    mesh = make_mesh(dp=-1)
+    session = Session(mesh)
+    planner = Planner(session, cache=None, counters=Counters())
+
+    t0 = time.perf_counter()
+    planner.ensure_model(probe=True)
+    fit_ms = (time.perf_counter() - t0) * 1e3
+
+    rows: List[Dict] = []
+    for bucket in planner.buckets:
+        rec = planner.tune(bucket, reps=steps, use_cache=False)
+        planner_ms = rec["measured_ms"]
+        default_ms = rec["default_ms"]
+        row = {
+            "bucket": bucket.id,
+            "payload_bytes": bucket.rep_bytes,
+            "plan": rec["describe"],
+            "predicted_ms": rec["predicted_ms"],
+            "measured_ms": planner_ms,
+            "rel_err": rec["rel_err"],
+            "default_ms": default_ms,
+            "speedup_vs_default": (
+                round(default_ms / planner_ms, 3)
+                if planner_ms and default_ms else None
+            ),
+            "rejected": rec["rejected"],
+        }
+        rows.append(row)
+        print(
+            f"RESULT: bench=planner bucket={bucket.id} "
+            f"payload={bucket.rep_bytes} B plan={rec['describe']} "
+            f"predicted={rec['predicted_ms']} ms "
+            f"measured={planner_ms} ms rel_err={rec['rel_err']} "
+            f"default={default_ms} ms",
+            flush=True,
+        )
+
+    model = planner.model
+    record = {
+        "bench": "planner",
+        "backend": jax.default_backend(),
+        "np": session.size,
+        "fit_ms": round(fit_ms, 1),
+        "model": model.to_json() if model is not None else None,
+        "buckets": rows,
+        # the acceptance headline: across buckets, the planner's measured
+        # p50 never loses to the hand-tuned default's (>= 1.0 == no loss)
+        "worst_speedup_vs_default": min(
+            (r["speedup_vs_default"] for r in rows
+             if r["speedup_vs_default"] is not None),
+            default=None,
+        ),
+        # and the cost model's honesty: worst predicted-vs-measured error
+        "worst_rel_err": max(
+            (r["rel_err"] for r in rows if r["rel_err"] is not None),
+            default=None,
+        ),
+    }
+    print(json.dumps(record), flush=True)
+    if out:
+        with open(out, "w") as f:
+            json.dump(record, f, indent=2)
+    return record

@@ -28,6 +28,7 @@ benchmarks/compression.py (fp32 vs bf16 vs int8 A/B).
 """
 from .config import (
     AxisCompression,
+    AxisConfig,
     CompressionConfig,
     BF16,
     FP8,
@@ -63,7 +64,7 @@ from . import error_feedback
 from .error_feedback import EFState
 
 __all__ = [
-    "AxisCompression", "CompressionConfig",
+    "AxisCompression", "AxisConfig", "CompressionConfig",
     "NONE", "BF16", "INT8", "INT8_SR", "FP8", "TOPK_1PCT", "RANDK_1PCT",
     "register", "registered", "resolve", "resolve_for_axis",
     "validate_axis_keys",

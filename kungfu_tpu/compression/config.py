@@ -104,6 +104,41 @@ AxisCompression = Union[
     None, str, CompressionConfig, Mapping[str, Union[None, str, CompressionConfig]]
 ]
 
+
+@dataclasses.dataclass(frozen=True)
+class AxisConfig:
+    """Frozen per-axis wire-format selection — the *installable* form of the
+    `{axis: config}` mapping.  Unlike a dict it is hashable, so it can key
+    Session's compiled-function caches and ride into jit as a static
+    argument, exactly like a single CompressionConfig: "switch the per-leg
+    wire" means "run the other compiled program".  The planner installs its
+    winning plan's wire dtypes as one of these via `Session.set_compression`.
+    """
+
+    legs: tuple = ()  # ((axis_name, CompressionConfig), ...) sorted by axis
+
+    @classmethod
+    def make(cls, mapping: Mapping) -> "AxisConfig":
+        return cls(legs=tuple(sorted(
+            (str(k), resolve(v)) for k, v in dict(mapping).items()
+        )))
+
+    def get(self, axis: str) -> CompressionConfig:
+        for k, c in self.legs:
+            if k == axis:
+                return c
+        return NONE
+
+    def as_dict(self) -> Dict[str, CompressionConfig]:
+        return dict(self.legs)
+
+    @property
+    def is_compressed(self) -> bool:
+        return any(c.scheme != "none" for _, c in self.legs)
+
+    def describe(self) -> str:
+        return ",".join(f"{k}={c.describe()}" for k, c in self.legs) or "none"
+
 _REGISTRY: Dict[str, CompressionConfig] = {}
 
 

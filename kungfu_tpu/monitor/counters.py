@@ -238,6 +238,67 @@ class Counters:
                 table.clear()
             self._hists.clear()
 
+    def snapshot_json(self) -> Dict:
+        """JSON-serializable snapshot of every accumulator: byte totals,
+        events, gauges, and full histogram state (bucket bounds + counts +
+        sum + count + max).  The planner's offline cost-model fit consumes
+        this — `load_snapshot` reconstructs a Counters from it, so a dumped
+        fleet scrape tunes plans on a machine that never ran the job."""
+        with self._lock:
+            return {
+                "version": 1,
+                "window_s": self._window_s,
+                "egress": {k: w.total for k, w in self._egress.items()},
+                "ingress": {k: w.total for k, w in self._ingress.items()},
+                "logical": {k: w.total for k, w in self._logical.items()},
+                "wire": {k: w.total for k, w in self._wire.items()},
+                "quant_err": dict(self._quant_err),
+                "events": dict(self._events),
+                "gauges": dict(self._gauges),
+                "hists": [
+                    {
+                        "metric": metric, "label": label,
+                        "bounds": list(h.bounds), "counts": list(h.counts),
+                        "sum": h.sum, "count": h.count, "max": h.max,
+                    }
+                    for (metric, label), h in sorted(self._hists.items())
+                ],
+            }
+
+    @classmethod
+    def load_snapshot(cls, snap: Dict) -> "Counters":
+        """Rebuild a Counters from `snapshot_json` output.
+
+        Histograms round-trip exactly (buckets + sums + counts + max);
+        byte totals are restored as one lump sample each, so cumulative
+        totals are exact but windowed *rates* are meaningless on a loaded
+        snapshot — the planner only reads totals and histograms."""
+        c = cls(window_s=float(snap.get("window_s", 5.0)))
+        now = time.monotonic()
+        with c._lock:
+            for field, table in (("egress", c._egress), ("ingress", c._ingress),
+                                 ("logical", c._logical), ("wire", c._wire)):
+                for k, total in (snap.get(field) or {}).items():
+                    c._get(table, k).add(int(total), t=now)
+            c._quant_err.update(snap.get("quant_err") or {})
+            c._events.update(snap.get("events") or {})
+            c._gauges.update(snap.get("gauges") or {})
+            for h in snap.get("hists") or []:
+                hist = Histogram(bounds=tuple(h["bounds"]))
+                counts = [int(x) for x in h["counts"]]
+                if len(counts) != len(hist.counts):
+                    raise ValueError(
+                        f"histogram {h.get('metric')}/{h.get('label')}: "
+                        f"{len(counts)} bucket counts for "
+                        f"{len(hist.counts)} buckets"
+                    )
+                hist.counts = counts
+                hist.sum = float(h["sum"])
+                hist.count = int(h["count"])
+                hist.max = float(h.get("max", 0.0))
+                c._hists[(h["metric"], h.get("label", ""))] = hist
+        return c
+
     def events(self) -> Dict[str, int]:
         with self._lock:
             return dict(self._events)

@@ -106,11 +106,23 @@ class Graph:
 
     def is_valid_tree(self, root: Optional[int] = None) -> bool:
         """Broadcast-tree invariant: every non-root has exactly one prev."""
+        return not self.tree_errors(root)
+
+    def tree_errors(self, root: Optional[int] = None) -> List[str]:
+        """Why this graph is not a valid broadcast tree ([] when it is).
+
+        The same oracle `is_valid_tree` answers as a bool, but with the
+        offending structure named — the planner's validity gate journals
+        these reasons when it rejects a candidate plan.
+        """
+        problems: List[str] = []
         roots = [nd.rank for nd in self.nodes if nd.self_loop]
         if root is not None and roots != [root]:
-            return False
+            problems.append(f"expected single root {root}, found roots {roots}")
+            return problems
         if len(roots) != 1:
-            return False
+            problems.append(f"expected exactly one root, found {roots}")
+            return problems
         r = roots[0]
         seen = {r}
         frontier = [r]
@@ -119,11 +131,18 @@ class Graph:
             for i in frontier:
                 for j in self.nodes[i].nexts:
                     if j in seen:
-                        return False
+                        problems.append(
+                            f"rank {j} is reached twice (edge {i}->{j} "
+                            "re-enters the tree)"
+                        )
+                        return problems
                     seen.add(j)
                     nxt.append(j)
             frontier = nxt
-        return len(seen) == len(self)
+        if len(seen) != len(self):
+            missing = sorted(set(range(len(self))) - seen)
+            problems.append(f"ranks {missing} are unreachable from root {r}")
+        return problems
 
 
 # --- permutation validation (shared with kungfu_tpu.analysis kf-lint) ----------------
@@ -173,32 +192,58 @@ def validate_permutation(
 
 
 # --- generators (reference srcs/go/plan/topology.go) ---------------------------------
+#
+# Every generator validates its own output on construction (is_valid_tree /
+# permutation_errors) and raises with the offending edge list instead of
+# letting a bad graph reach dispatch — a disconnected tree compiles into a
+# collective that silently drops ranks, and the failure then surfaces
+# minutes later as a hang or a wrong gradient.  The known trap: tree-star
+# over a degenerate host grouping (empty host entry, duplicate or
+# out-of-range ranks) used to produce a silently disconnected graph.
+
+
+def _checked_tree(g: Graph, what: str, root: Optional[int] = None) -> Graph:
+    problems = g.tree_errors(root)
+    if problems:
+        raise ValueError(
+            f"{what} generated an invalid broadcast tree: "
+            + "; ".join(problems) + f"; edges={g.edges()}"
+        )
+    return g
+
+
+def _check_positive(n: int, what: str) -> None:
+    if n < 1:
+        raise ValueError(f"{what} needs at least one rank, got n={n}")
 
 
 def gen_tree(n: int) -> Graph:
     """Flat star rooted at 0 (topology.go:17-31): bcast graph 0 -> all."""
+    _check_positive(n, "gen_tree")
     g = Graph(n)
     g.add_edge(0, 0)
     for i in range(1, n):
         g.add_edge(0, i)
-    return g
+    return _checked_tree(g, "gen_tree", root=0)
 
 
 def gen_star_bcast_graph(n: int, root: int = 0) -> Graph:
     """Star rooted at `root` (topology.go:138-147)."""
+    _check_positive(n, "gen_star_bcast_graph")
+    if not (0 <= root < n):
+        raise ValueError(f"gen_star_bcast_graph root {root} not in [0, {n})")
     g = Graph(n)
     g.add_edge(root, root)
     for i in range(n):
         if i != root:
             g.add_edge(root, i)
-    return g
+    return _checked_tree(g, "gen_star_bcast_graph", root=root)
 
 
 def gen_binary_tree(n: int) -> Graph:
     """Binary bcast tree rooted at 0 with heap-index children (topology.go:42-56)."""
+    _check_positive(n, "gen_binary_tree")
     g = Graph(n)
-    if n == 0:
-        return g
     g.add_edge(0, 0)
     for i in range(n):
         l, r = 2 * i + 1, 2 * i + 2
@@ -206,7 +251,7 @@ def gen_binary_tree(n: int) -> Graph:
             g.add_edge(i, l)
         if r < n:
             g.add_edge(i, r)
-    return g
+    return _checked_tree(g, "gen_binary_tree", root=0)
 
 
 def gen_default_reduce_graph(bcast: Graph) -> Graph:
@@ -226,10 +271,16 @@ def gen_binary_tree_star(hosts: Sequence[Sequence[int]]) -> Graph:
     Returns the broadcast graph.
     """
     n = sum(len(h) for h in hosts)
+    _check_positive(n, "gen_binary_tree_star")
+    ranks = sorted(x for h in hosts for x in h)
+    if ranks != list(range(n)):
+        raise ValueError(
+            f"gen_binary_tree_star host grouping {list(map(list, hosts))} "
+            f"does not cover ranks 0..{n - 1} exactly (a duplicate, missing "
+            "or out-of-range rank leaves the tree disconnected)"
+        )
     g = Graph(n)
     masters = [h[0] for h in hosts if h]
-    if not masters:
-        return g
     g.add_edge(masters[0], masters[0])
     for i, m in enumerate(masters):
         l, r = 2 * i + 1, 2 * i + 2
@@ -240,7 +291,7 @@ def gen_binary_tree_star(hosts: Sequence[Sequence[int]]) -> Graph:
     for h in hosts:
         for x in h[1:]:
             g.add_edge(h[0], x)
-    return g
+    return _checked_tree(g, "gen_binary_tree_star", root=masters[0])
 
 
 def gen_multi_binary_tree_star(hosts: Sequence[Sequence[int]]) -> List[Graph]:
@@ -262,6 +313,7 @@ def gen_circular_graph_pair(n: int, shift: int = 0) -> Tuple[Graph, Graph]:
     Reduce graph: chain r0 -> r1 -> ... -> r_{n-1} (root at end, self-loops
     everywhere for aggregation); bcast graph: chain from the root back.
     """
+    _check_positive(n, "gen_circular_graph_pair")
     order = [(shift + i) % n for i in range(n)]
     reduce_g = Graph(n)
     bcast_g = Graph(n)
@@ -273,6 +325,15 @@ def gen_circular_graph_pair(n: int, shift: int = 0) -> Tuple[Graph, Graph]:
     bcast_g.add_edge(root, root)
     for a, b in zip(reversed(order), list(reversed(order))[1:]):
         bcast_g.add_edge(a, b)
+    # a ring round is a (partial) ppermute: validate each chain's send
+    # pairs through the same oracle kf-lint uses for traced ppermutes
+    for g, what in ((reduce_g, "reduce chain"), (bcast_g, "bcast chain")):
+        problems = permutation_errors(g.edges(), n)
+        if problems:
+            raise ValueError(
+                f"gen_circular_graph_pair {what} is not a valid "
+                f"permutation: {'; '.join(problems)}; edges={g.edges()}"
+            )
     return reduce_g, bcast_g
 
 

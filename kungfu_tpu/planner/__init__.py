@@ -1,0 +1,73 @@
+"""Collective plan compiler — cost-model autotuner over
+(algorithm × topology × per-hop wire dtype).
+
+Strategy, topology and wire dtype used to be picked by hand or by fixed
+thresholds (policy.py, the interference monitor); this subsystem compiles
+them.  GC3 (PAPERS.md) showed collective *plans* costed against a link
+model beat any single hand-tuned algorithm across tensor sizes; EQuARX
+showed the per-hop compression choice belongs inside the same search.
+The fleet already measures everything the search needs — per-collective
+latency histograms and bytes-on-wire counters (PR 4) — so the cost model
+is *fitted*, not assumed, and kf-lint (PR 2) is reused as the validity
+oracle so the planner can never install an illegal or deadlocking program.
+
+Layout:
+
+  candidates.py  Plan (frozen/JSON-stable), size buckets, the
+                 algorithm × wire enumeration, topology digests
+  model.py       α-β LinkModel + codec overheads; least-squares fit from
+                 telemetry histograms or a Counters.snapshot_json dump
+  probe.py       microbenchmark seeding links/schemes with no history
+  cost.py        per-algorithm round decomposition pricing each plan
+  validate.py    kf-lint gate (graph oracle + traced-program rule engine)
+  cache.py       persistent JSON plan cache keyed
+                 (world, topology digest, bucket) with stale-key
+                 invalidation on resize
+  core.py        Planner: enumerate -> validate -> cost -> measured
+                 runoff -> Session.set_strategy/set_compression install
+  replan.py      ReplanPolicy: online re-planning on resize /
+                 interference / GNS regime change
+  __main__.py    `python -m kungfu_tpu.planner --smoke` end-to-end drill
+                 (a scripts/check.sh stage) and `--fit-from` offline fits
+
+See docs/planner.md for the search space, cost model, cache format and
+how to read the `plan_selected` journal events.
+"""
+from .candidates import (  # noqa: F401
+    ALGORITHMS,
+    Bucket,
+    ILLEGAL_PROBE,
+    Plan,
+    SCHEMES,
+    bucket_for,
+    default_buckets,
+    enumerate_plans,
+    hosts_for,
+    make_illegal_probe,
+    topology_digest,
+)
+from .model import (  # noqa: F401
+    CostModel,
+    LinkModel,
+    fit_alpha_beta,
+    fit_cost_model,
+    harvest_points,
+    rounds_tree,
+)
+from .cost import predict_ms  # noqa: F401
+from .probe import probe_links  # noqa: F401
+from .validate import plan_findings, validate_plan  # noqa: F401
+from .cache import PlanCache, cache_key, default_cache_path  # noqa: F401
+from .core import Planner  # noqa: F401
+from .replan import ReplanPolicy  # noqa: F401
+
+__all__ = [
+    "ALGORITHMS", "Bucket", "ILLEGAL_PROBE", "Plan", "SCHEMES",
+    "bucket_for", "default_buckets", "enumerate_plans", "hosts_for",
+    "make_illegal_probe", "topology_digest",
+    "CostModel", "LinkModel", "fit_alpha_beta", "fit_cost_model",
+    "harvest_points", "rounds_tree",
+    "predict_ms", "probe_links", "plan_findings", "validate_plan",
+    "PlanCache", "cache_key", "default_cache_path",
+    "Planner", "ReplanPolicy",
+]

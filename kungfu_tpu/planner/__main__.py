@@ -1,0 +1,246 @@
+"""``python -m kungfu_tpu.planner`` — plan-compiler smoke drill + offline fits.
+
+Modes::
+
+    # end-to-end drill on an np-rank CPU fleet (a scripts/check.sh stage):
+    # enumerate -> kf-lint validate (incl. a seeded ILLEGAL candidate that
+    # must be rejected + journaled) -> probe/fit -> cost -> measured
+    # runoff -> install on the live Session -> persist the plan cache.
+    # Exit 0 only if every legal candidate validates, the illegal one is
+    # rejected, the installed winner actually changes the session, and
+    # the cache round-trips.
+    python -m kungfu_tpu.planner --smoke [--np 2] [--cache PATH]
+
+    # second run against the same cache must hit it (restart persistence):
+    python -m kungfu_tpu.planner --smoke --cache PATH --expect-cache-hit
+
+    # offline cost-model fit from a dumped Counters.snapshot_json file:
+    python -m kungfu_tpu.planner --fit-from snapshot.json [--world 8]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+
+def _prepare_backend(np_ranks: int) -> None:
+    """Force a CPU backend with enough virtual devices BEFORE first use."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={np_ranks}"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def _fit_from(path: str, world: int) -> int:
+    with open(path) as f:
+        snap = json.load(f)
+    from ..monitor.counters import Counters
+    from .model import fit_cost_model
+
+    model = fit_cost_model(Counters.load_snapshot(snap), world)
+    print(json.dumps({"world": world, "model": model.to_json()}, indent=2))
+    return 0
+
+
+def _smoke(args) -> int:
+    _prepare_backend(args.np)
+    # the drill must be able to verify its own journal trail
+    owns_journal = not (os.environ.get("KFT_JOURNAL_FILE")
+                        or os.environ.get("KFT_JOURNAL_DIR"))
+    tmp_journal = None
+    if owns_journal:
+        fd, tmp_journal = tempfile.mkstemp(prefix="kft-planner-smoke-",
+                                           suffix=".jsonl")
+        os.close(fd)
+        os.environ["KFT_JOURNAL_FILE"] = tmp_journal
+        from ..monitor.journal import _reset_for_tests
+
+        _reset_for_tests()
+
+    import jax
+    import numpy as np
+
+    from ..monitor.counters import Counters
+    from ..monitor.journal import read_journal
+    from ..plan import Strategy, make_mesh
+    from ..session import Session
+    from .cache import PlanCache
+    from .candidates import make_illegal_probe
+    from .core import Planner
+    from .validate import validate_plan
+
+    devs = jax.devices()
+    if len(devs) < args.np:
+        print(f"ERROR: need {args.np} devices, have {len(devs)}", file=sys.stderr)
+        return 2
+    mesh = make_mesh(dp=args.np, devices=devs[:args.np])
+    session = Session(mesh)
+    counters = Counters()
+    cache_path = args.cache or os.path.join(
+        tempfile.mkdtemp(prefix="kft-plan-cache-"), "plan_cache.json")
+    planner = Planner(session, cache=PlanCache(cache_path), counters=counters)
+
+    failures = []
+
+    # 1. enumeration + validity gate over every bucket's candidate set
+    n_candidates = 0
+    for bucket in planner.buckets:
+        for plan in planner.candidates(bucket):
+            n_candidates += 1
+            problems = validate_plan(plan, planner.hosts)
+            if problems:
+                failures.append(
+                    f"legal candidate {plan.describe()} failed kf-lint: "
+                    f"{problems}")
+    print(f"# enumerated {n_candidates} candidates across "
+          f"{len(planner.buckets)} buckets; all passed the validity gate")
+
+    # 2. the seeded ILLEGAL candidate must be rejected and journaled,
+    #    never ranked
+    bucket0 = planner.buckets[0]
+    illegal = make_illegal_probe(planner.world, bucket0.id)
+    search = planner.search(
+        bucket0, candidates=planner.candidates(bucket0) + [illegal])
+    rejected_plans = [p for p, _ in search["rejected"]]
+    if illegal not in rejected_plans:
+        failures.append("seeded illegal candidate was NOT rejected")
+    if any(p == illegal for p, _ in search["ranked"]):
+        failures.append("seeded illegal candidate entered the ranking")
+
+    # 3. cache state decides the path: hit = reuse, miss = probe+measure
+    cache_hit = all(
+        planner.cache.get_plan(planner.world, planner.digest(), b.id)
+        is not None
+        for b in planner.buckets
+    )
+    before = session.strategy
+    session.set_strategy(Strategy.STAR)  # a known non-winner baseline
+    records = planner.tune_all(install_for_bytes=args.install_bytes,
+                               use_cache=True)
+    hit_count = sum(1 for r in records if r.get("cache_hit"))
+    if cache_hit and hit_count != len(records):
+        failures.append(
+            f"expected all {len(records)} buckets cached, hit {hit_count}")
+    if args.expect_cache_hit and hit_count != len(records):
+        failures.append(
+            f"--expect-cache-hit: only {hit_count}/{len(records)} buckets "
+            "came from the cache")
+
+    # 4. the installed winner must actually change the session
+    target = planner.bucket(args.install_bytes)
+    installed = next(r for r in records if r["bucket"] == target.id)
+    from .candidates import Plan
+
+    winner = Plan.from_json(installed["plan"])
+    if session.strategy is not winner.strategy:
+        failures.append(
+            f"install did not change session strategy: {session.strategy} "
+            f"!= {winner.strategy}")
+    want_comp = session._resolve_compression(winner.compression())
+    if session.compression != want_comp:
+        failures.append(
+            f"install did not set session wire dtype: "
+            f"{session.compression} != {want_comp}")
+
+    # 5. the installed plan must still compute a correct allreduce
+    x = np.random.RandomState(3).randn(session.size, 256).astype(np.float32)
+    got = np.asarray(session.all_reduce(x, name="smoke-check"))[0]
+    want = x.sum(axis=0)
+    rel = float(np.abs(got - want).max() / (np.abs(want).max() + 1e-12))
+    if rel > 0.05:
+        failures.append(f"installed plan computes wrong allreduce: rel={rel}")
+
+    # 6. cache must round-trip through a fresh load (restart persistence)
+    reloaded = PlanCache(cache_path)
+    for b in planner.buckets:
+        if reloaded.get_plan(planner.world, planner.digest(), b.id) is None:
+            failures.append(f"cache round-trip lost bucket {b.id}")
+
+    # 7. the journal must carry the rejection + selection trail
+    from ..monitor.journal import _reset_for_tests as _flush
+
+    journal_path = os.environ.get("KFT_JOURNAL_FILE", "")
+    events = []
+    if journal_path and os.path.exists(journal_path):
+        _flush()  # close the writer so every line is on disk
+        events = [e.get("event") for e in read_journal(journal_path)]
+    if "plan_rejected" not in events:
+        failures.append("no plan_rejected event journaled for the seeded "
+                        "illegal candidate")
+    if "plan_selected" not in events:
+        failures.append("no plan_selected event journaled for the install")
+
+    summary = {
+        "np": args.np,
+        "world": planner.world,
+        "candidates": n_candidates,
+        "rejected_seeded": len(search["rejected"]),
+        "cache_hit": hit_count == len(records),
+        "cache_path": cache_path,
+        "installed": installed["describe"],
+        "predicted_ms": installed.get("predicted_ms"),
+        "measured_ms": installed.get("measured_ms"),
+        "strategy_before": before.name,
+        "strategy_after": session.strategy.name,
+        "wire_after": ("none" if session.compression is None
+                       else session.compression.describe()),
+        "buckets": [
+            {k: r.get(k) for k in ("bucket", "cache_hit", "describe",
+                                   "predicted_ms", "measured_ms",
+                                   "rel_err", "default_ms")}
+            for r in records
+        ],
+        "failures": failures,
+    }
+    print("PLANNER-SMOKE: " + json.dumps(summary))
+    if tmp_journal and not args.keep_journal:
+        try:
+            os.unlink(tmp_journal)
+        except OSError:
+            pass
+    if failures:
+        for f in failures:
+            print(f"FAIL: {f}", file=sys.stderr)
+        return 1
+    print(f"ok: planner smoke passed "
+          f"({'cache hit' if summary['cache_hit'] else 'cold search'})")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="kungfu_tpu.planner")
+    ap.add_argument("--smoke", action="store_true",
+                    help="end-to-end drill on a CPU fleet")
+    ap.add_argument("--np", type=int, default=2,
+                    help="ranks (virtual CPU devices) for --smoke")
+    ap.add_argument("--cache", default=None,
+                    help="plan cache path (default: fresh temp dir)")
+    ap.add_argument("--expect-cache-hit", action="store_true",
+                    help="fail unless every bucket came from the cache")
+    ap.add_argument("--install-bytes", type=int, default=4 << 20,
+                    help="payload whose bucket's winner is installed")
+    ap.add_argument("--keep-journal", action="store_true")
+    ap.add_argument("--fit-from", default=None, metavar="SNAPSHOT_JSON",
+                    help="offline cost-model fit from a Counters snapshot")
+    ap.add_argument("--world", type=int, default=8,
+                    help="world size for --fit-from normalization")
+    args = ap.parse_args(argv)
+
+    if args.fit_from:
+        return _fit_from(args.fit_from, args.world)
+    if args.smoke:
+        return _smoke(args)
+    ap.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,217 @@
+"""Candidate plan enumeration: algorithm × topology × per-hop wire dtype.
+
+A `Plan` is one point in the planner's search space, per tensor-size
+bucket:
+
+  algorithm   ring | binary_tree | tree_star | hierarchical — each maps to
+              a Session `Strategy` (the installable knob) and to reference
+              reduce/bcast graphs (plan.strategy_graphs, host-aware) the
+              validity oracle checks;
+  wire        per-hop dtype: the ("ici", "dcn") legs independently pick a
+              dense wire scheme (none/bf16/int8/fp8 — CompressionConfig
+              registry names).  Single-leg topologies (a flat ring) carry
+              one leg;
+  bucket      the tensor-size band this plan is tuned for — small tensors
+              are latency-bound (α dominates: fewer rounds win), large
+              ones bandwidth-bound (β dominates: chunked rings + wire
+              compression win), so the winner legitimately differs per
+              band and the planner keys its cache on it.
+
+Plans are frozen, JSON round-trippable (the cache format), and installable:
+`plan.compression()` yields exactly what `Session.set_compression` accepts.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..plan import Strategy, strategy_graphs
+from ..plan import graph as G
+
+#: dense wire schemes the per-hop search considers (registry names;
+#: "none" == fp32)
+SCHEMES = ("none", "bf16", "int8", "fp8")
+
+#: the search's algorithm axis -> installable Session strategy.
+#: tree_star and hierarchical both lower to the two-level ici×dcn impl;
+#: they differ in the cross-host routing plan (single-rooted binary tree
+#: over local masters vs rotated multi-root load spreading) and therefore
+#: in cost.
+ALGORITHMS: Dict[str, Strategy] = {
+    "ring": Strategy.RING,
+    "binary_tree": Strategy.BINARY_TREE,
+    "tree_star": Strategy.BINARY_TREE_STAR,
+    "hierarchical": Strategy.MULTI_BINARY_TREE_STAR,
+}
+
+#: hidden algorithm id for the seeded-illegal candidate (never part of
+#: enumerate_plans output; the smoke drill injects it to prove the
+#: validity gate rejects and journals instead of installing)
+ILLEGAL_PROBE = "_illegal_probe"
+
+
+@dataclasses.dataclass(frozen=True)
+class Bucket:
+    """One tensor-size band: (upper bound, representative payload)."""
+
+    id: str
+    upper_bytes: Optional[int]  # None = +Inf
+    rep_bytes: int              # payload used for costing + measurement
+
+    def contains(self, nbytes: int) -> bool:
+        return self.upper_bytes is None or nbytes <= self.upper_bytes
+
+
+def default_buckets() -> Tuple[Bucket, ...]:
+    return (
+        Bucket("small", 256 * 1024, 64 * 1024),
+        Bucket("medium", 8 * 1024 * 1024, 4 * 1024 * 1024),
+        Bucket("large", None, 32 * 1024 * 1024),
+    )
+
+
+def bucket_for(nbytes: int, buckets: Sequence[Bucket]) -> Bucket:
+    for b in buckets:
+        if b.contains(nbytes):
+            return b
+    return buckets[-1]
+
+
+def hosts_for(world: int, host_count: int = 1) -> List[List[int]]:
+    """Host-major rank grouping when no explicit HostList/PeerList is
+    known: `world` ranks spread over `host_count` hosts (the same fill
+    order HostList.gen_peer_list uses)."""
+    host_count = max(1, min(host_count, world))
+    per = math.ceil(world / host_count)
+    return [list(range(i, min(i + per, world))) for i in range(0, world, per)]
+
+
+def topology_digest(hosts: Sequence[Sequence[int]], axes: Sequence[str] = ()) -> str:
+    """Deterministic digest of the host grouping + mesh axis names — the
+    plan cache's staleness key (a resize or a re-meshing changes it)."""
+    desc = json.dumps([list(h) for h in hosts]) + "|" + ",".join(axes)
+    return hashlib.sha256(desc.encode()).hexdigest()[:16]
+
+
+@dataclasses.dataclass(frozen=True)
+class Plan:
+    """One candidate collective plan (frozen, hashable, JSON-stable)."""
+
+    algorithm: str
+    strategy_name: str
+    wire: Tuple[Tuple[str, str], ...]  # ((leg, scheme), ...) sorted by leg
+    bucket: str
+    world: int
+
+    @property
+    def strategy(self) -> Strategy:
+        return Strategy[self.strategy_name]
+
+    def wire_scheme(self, leg: str) -> str:
+        return dict(self.wire).get(leg, "none")
+
+    @property
+    def legs(self) -> Tuple[str, ...]:
+        return tuple(leg for leg, _ in self.wire)
+
+    def compression(self):
+        """What Session.set_compression installs for this plan: None (full
+        precision), a registry name (single leg), or a {leg: scheme} dict
+        (per-leg wire on a hierarchical mesh)."""
+        live = {leg: s for leg, s in self.wire if s != "none"}
+        if not live:
+            return None
+        if len(self.wire) == 1:
+            return next(iter(live.values()))
+        return {leg: s for leg, s in self.wire}
+
+    def graph_pairs(self, hosts: Sequence[Sequence[int]]):
+        """(reduce, bcast) reference graphs for the validity oracle."""
+        if self.algorithm == ILLEGAL_PROBE:
+            return _illegal_graph_pairs(self.world)
+        return strategy_graphs(self.strategy, hosts)
+
+    def describe(self) -> str:
+        wire = ",".join(f"{leg}={s}" for leg, s in self.wire)
+        return f"{self.algorithm}[{wire}]@{self.bucket}"
+
+    def to_json(self) -> dict:
+        return {
+            "algorithm": self.algorithm, "strategy": self.strategy_name,
+            "wire": {leg: s for leg, s in self.wire},
+            "bucket": self.bucket, "world": self.world,
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "Plan":
+        return cls(
+            algorithm=str(d["algorithm"]),
+            strategy_name=str(d["strategy"]),
+            wire=tuple(sorted((str(k), str(v))
+                              for k, v in (d.get("wire") or {}).items())),
+            bucket=str(d["bucket"]), world=int(d["world"]),
+        )
+
+
+def _illegal_graph_pairs(n: int):
+    """A deliberately illegal ring round: two ranks send to the same
+    destination (the duplicate-write ppermute XLA hangs on).  Built by
+    hand — the gen_* generators now refuse to construct it."""
+    g = G.Graph(n)
+    bad = G.Graph(n)
+    for i in range(n):
+        g.nodes[i].self_loop = True
+        g.add_edge(i, (i + 1) % n)
+    # corrupt: rank 0 ALSO drives the edge into rank 1's slot
+    if n >= 3:
+        bad_edges = [(i, (i + 1) % n) for i in range(n - 1)] + [(0, 1)]
+    else:
+        bad_edges = [(0, 1), (0, 1)]
+    for a, b in bad_edges:
+        bad.add_edge(a, b)
+    bad.nodes[0].self_loop = True
+    return [(g, bad)]
+
+
+def make_illegal_probe(world: int, bucket: str) -> Plan:
+    """The seeded-illegal candidate for validity-gate drills."""
+    return Plan(algorithm=ILLEGAL_PROBE, strategy_name="RING",
+                wire=(("ici", "none"),), bucket=bucket, world=world)
+
+
+def enumerate_plans(
+    world: int,
+    hosts: Sequence[Sequence[int]],
+    bucket: Bucket,
+    schemes: Sequence[str] = SCHEMES,
+) -> List[Plan]:
+    """The full candidate set for one bucket.
+
+    Multi-host groupings give the two-level algorithms independent
+    (ici, dcn) wire legs — the EQuARX-motivated cross product — while flat
+    single-leg algorithms enumerate one leg on the link they actually
+    cross (dcn when the ring spans hosts, ici otherwise).
+    """
+    live_hosts = [h for h in hosts if h]
+    multi = len(live_hosts) > 1
+    plans: List[Plan] = []
+    for name, strat in ALGORITHMS.items():
+        if multi and name in ("tree_star", "hierarchical"):
+            for si in schemes:
+                for sd in schemes:
+                    plans.append(Plan(
+                        algorithm=name, strategy_name=strat.name,
+                        wire=(("dcn", sd), ("ici", si)),
+                        bucket=bucket.id, world=world,
+                    ))
+        else:
+            leg = "dcn" if multi else "ici"
+            for s in schemes:
+                plans.append(Plan(
+                    algorithm=name, strategy_name=strat.name,
+                    wire=((leg, s),), bucket=bucket.id, world=world,
+                ))
+    return plans

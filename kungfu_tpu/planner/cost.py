@@ -1,0 +1,92 @@
+"""Plan pricing: α-β schedules per algorithm family.
+
+First-order (GC3-style) round decomposition — every algorithm is priced as
+"rounds × (α_link + β_link · wire_bytes_per_round) + codec work", with the
+wire bytes shrunk by the leg's CompressionConfig.  The constants come from
+the fitted CostModel (planner/model.py), so the *relative* ranking tracks
+the machine the telemetry was measured on; absolute error vs measurement
+is reported by `--bench planner` (predicted vs measured per bucket).
+
+Formulas (n = world, h = hosts, m = largest per-host group, e = elements):
+
+  binary_tree  2·⌈log2 n⌉ rounds of the full payload (reduce up + bcast
+               down; XLA's one-shot psum behaves tree-ish in rounds)
+  ring         2(n−1) rounds of ⌈e/n⌉ (chunked reduce-scatter + all-gather;
+               bandwidth-optimal, α-heavy)
+  tree_star    intra-host star: 2(m−1) sends of ⌈e/m⌉ on ici; cross-host
+               binary tree over local masters: 2·⌈log2 h⌉ rounds of ⌈e/m⌉
+               on dcn
+  hierarchical tree_star with rotated multi-root load spreading: the dcn
+               payload further splits across h graphs
+
+A compressed leg prices its *wire* bytes (CompressionConfig.wire_bytes)
+plus the fitted codec overhead γ·logical_bytes — so on fabrics where the
+codec outweighs the byte saving (CPU drills), compression correctly loses.
+"""
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+from ..compression import resolve
+from .candidates import Plan
+from .model import CostModel, rounds_tree as _rounds_tree
+
+
+def predict_ms(
+    plan: Plan,
+    payload_bytes: int,
+    model: CostModel,
+    hosts: Sequence[Sequence[int]],
+) -> float:
+    """Predicted latency (ms) of one allreduce of `payload_bytes` under
+    `plan`, per the fitted α-β model."""
+    n = max(plan.world, 1)
+    live = [h for h in hosts if h]
+    h = max(len(live), 1)
+    m = max((len(x) for x in live), default=n)
+    elems = max(int(payload_bytes) // 4, 1)
+    if n == 1:
+        return 0.0
+
+    multi = h > 1
+    flat_leg = "dcn" if multi else "ici"
+    total = 0.0
+
+    if plan.algorithm in ("tree_star", "hierarchical") and multi:
+        ici_cfg = resolve(plan.wire_scheme("ici"))
+        dcn_cfg = resolve(plan.wire_scheme("dcn"))
+        shard = math.ceil(elems / max(m, 1))
+        # intra-host star legs: members -> master, then master -> members
+        if m > 1:
+            total += 2 * (m - 1) * model.leg_ms(
+                "ici", ici_cfg.wire_bytes(shard, 4))
+            total += model.codec_ms(ici_cfg.scheme, shard * 4)
+        # cross-host rounds over local masters
+        dcn_elems = shard
+        if plan.algorithm == "hierarchical":
+            # rotated multi-root graphs spread the cross-host payload
+            dcn_elems = math.ceil(shard / h)
+        total += _rounds_tree(h) * model.leg_ms(
+            "dcn", dcn_cfg.wire_bytes(dcn_elems, 4))
+        total += model.codec_ms(dcn_cfg.scheme, shard * 4)
+        return total
+
+    cfg = resolve(plan.wire_scheme(flat_leg))
+    if cfg.scheme != "none":
+        # any compressed flat plan executes as the quantized RS->AG
+        # schedule (Session._build), which is ring-shaped on the wire
+        steps = 2 * (n - 1)
+        total += steps * model.leg_ms(
+            flat_leg, cfg.wire_bytes(math.ceil(elems / n), 4))
+        total += model.codec_ms(cfg.scheme, elems * 4)
+        return total
+    if plan.algorithm == "ring":
+        steps = 2 * (n - 1)
+        total += steps * model.leg_ms(
+            flat_leg, cfg.wire_bytes(math.ceil(elems / n), 4))
+        return total
+    # binary_tree / degenerate tree_star / hierarchical on one host:
+    # one-shot psum priced as tree rounds of the full payload
+    total += _rounds_tree(n) * model.leg_ms(flat_leg, cfg.wire_bytes(elems, 4))
+    return total

@@ -1,0 +1,248 @@
+"""α-β link cost model, fitted from measured telemetry.
+
+GC3's argument (PAPERS.md) is that collective algorithm choice should be
+compiled against a cost model, not hard-coded; the classic model is
+α-β: one link transfer of b bytes costs α + β·b (latency + inverse
+bandwidth).  This module fits those parameters **from the fleet's own
+measurements** instead of assuming constants:
+
+  telemetry   the `collective_latency_ms` histograms + byte counters the
+              Session records on every collective (monitor/counters.py),
+              harvested live from `global_counters()`, from a fleet
+              aggregator's merged scrape, or offline from a
+              `Counters.snapshot_json()` dump;
+  probes      a small microbenchmark (planner/probe.py) that seeds links
+              and wire schemes with no history — labels are
+              `probe:<link>:<scheme>:<bytes>` so harvesting attributes
+              them without side tables;
+  defaults    order-of-magnitude priors used only for links nothing has
+              measured, marked `source="default"` so a consumer can tell
+              a guess from a fit.
+
+The model has two parts:
+
+  links[link]     α (ms) + β (ms/MiB) over the bytes a leg actually moves
+                  (the *wire* bytes — compression wins by shrinking b);
+  codecs[scheme]  γ (ms/MiB of logical payload): the measured compute cost
+                  of a wire scheme's quantize/dequantize work.  On a CPU
+                  mesh γ_int8 dominates (codec work is real, wire is
+                  shared memory) and the planner correctly keeps fp32; on
+                  a DCN-bound fleet β dominates and the planner compresses
+                  — the EQuARX placement decided by measurement, not
+                  folklore.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+MiB = float(1 << 20)
+
+#: harvest label prefix for probe microbenchmark points
+PROBE_PREFIX = "probe:"
+
+#: gauge name prefix under which the probe publishes fitted per-scheme
+#: codec overheads (ms per MiB of logical payload)
+CODEC_GAUGE_PREFIX = "planner_codec_ms_per_mib:"
+
+#: links the model knows how to talk about
+LINKS = ("ici", "dcn")
+
+
+def rounds_tree(k: int) -> int:
+    """Rounds of a tree-schedule allreduce over k peers (reduce+bcast)."""
+    return 2 * max(1, math.ceil(math.log2(max(k, 2))))
+
+
+@dataclasses.dataclass
+class LinkModel:
+    """One link's fitted α-β parameters."""
+
+    alpha_ms: float
+    beta_ms_per_mib: float
+    n_points: int = 0
+    source: str = "default"  # "default" | "probe" | "telemetry" | "mixed"
+
+    def ms(self, nbytes: float) -> float:
+        return self.alpha_ms + self.beta_ms_per_mib * float(nbytes) / MiB
+
+    def to_json(self) -> dict:
+        return {
+            "alpha_ms": round(self.alpha_ms, 6),
+            "beta_ms_per_mib": round(self.beta_ms_per_mib, 6),
+            "n_points": self.n_points, "source": self.source,
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "LinkModel":
+        return cls(alpha_ms=float(d["alpha_ms"]),
+                   beta_ms_per_mib=float(d["beta_ms_per_mib"]),
+                   n_points=int(d.get("n_points", 0)),
+                   source=str(d.get("source", "default")))
+
+
+#: priors for links nothing has measured (order-of-magnitude: ICI is a
+#: few-µs few-hundred-GB/s fabric, DCN is ms-latency tens-of-GB/s)
+DEFAULT_LINKS: Dict[str, LinkModel] = {
+    "ici": LinkModel(alpha_ms=0.02, beta_ms_per_mib=0.01, source="default"),
+    "dcn": LinkModel(alpha_ms=0.5, beta_ms_per_mib=0.4, source="default"),
+}
+
+
+def fit_alpha_beta(points: Sequence[Tuple[float, float]]) -> Tuple[float, float]:
+    """Least-squares α (ms) + β (ms/MiB) over (bytes, ms) points.
+
+    Degenerate inputs degrade gracefully: a single point (or all points at
+    one size) yields α=0, β=ms/size — bandwidth-only, which extrapolates
+    sanely; a negative fitted slope (noise at tiny sizes) clamps to β=0,
+    α=mean latency.
+    """
+    if not points:
+        raise ValueError("cannot fit a link model from zero points")
+    xs = [float(p[0]) / MiB for p in points]
+    ys = [float(p[1]) for p in points]
+    n = len(xs)
+    mx, my = sum(xs) / n, sum(ys) / n
+    sxx = sum((x - mx) ** 2 for x in xs)
+    if sxx <= 1e-18:
+        if mx <= 0:
+            return max(my, 0.0), 0.0
+        return 0.0, max(my / mx, 0.0)
+    beta = sum((x - mx) * (y - my) for x, y in zip(xs, ys)) / sxx
+    alpha = my - beta * mx
+    if beta < 0:
+        return max(my, 0.0), 0.0
+    return max(alpha, 0.0), beta
+
+
+def parse_probe_label(label: str) -> Optional[Tuple[str, str, int]]:
+    """`probe:<link>:<scheme>:<bytes>` -> (link, scheme, per-peer bytes)."""
+    if not label.startswith(PROBE_PREFIX):
+        return None
+    parts = label.split(":")
+    if len(parts) != 4:
+        return None
+    try:
+        return parts[1], parts[2], int(parts[3])
+    except ValueError:
+        return None
+
+
+def harvest_points(
+    counters, world: int, default_link: str = "ici",
+) -> Dict[Tuple[str, str], List[Tuple[float, float, bool]]]:
+    """(link, scheme) -> [(bytes, mean latency ms, is_probe)].
+
+    One point per histogram label: mean latency is `sum/count` (exact —
+    Histogram.sum accumulates raw values, only bucket *placement* is
+    quantized).
+
+    Probe labels carry their own (link, scheme, bytes) attribution and are
+    already **per-round** values (probe.py normalizes by the schedule it
+    pinned).  Every other label is the fleet's live traffic, attributed to
+    `default_link` at scheme "none": bytes-per-call comes from the egress
+    counter divided by call count and world (Session records the stacked
+    array's bytes; the per-peer payload is 1/world of it), and the latency
+    is the raw end-to-end collective time — `fit_cost_model` normalizes it
+    by the default tree schedule's round count.  `counters` is a live
+    Counters or one rebuilt by `Counters.load_snapshot`.
+    """
+    hists = counters.hist_summaries().get("collective_latency_ms", {})
+    egress, _ = counters.totals()
+    out: Dict[Tuple[str, str], List[Tuple[float, float, bool]]] = {}
+    for label, h in hists.items():
+        count = int(h.get("count") or 0)
+        if count <= 0:
+            continue
+        mean_ms = float(h["sum"]) / count
+        probe = parse_probe_label(label)
+        if probe is not None:
+            link, scheme, nbytes = probe
+            out.setdefault((link, scheme), []).append(
+                (float(nbytes), mean_ms, True))
+            continue
+        total = egress.get(label, 0)
+        if total <= 0:
+            continue  # latency with no byte accounting: cannot place on a curve
+        nbytes = total / count / max(world, 1)
+        out.setdefault((default_link, "none"), []).append(
+            (nbytes, mean_ms, False))
+    return out
+
+
+class CostModel:
+    """Fitted link curves + codec overheads; the planner's pricing oracle."""
+
+    def __init__(self, links: Optional[Dict[str, LinkModel]] = None,
+                 codecs: Optional[Dict[str, float]] = None):
+        self.links: Dict[str, LinkModel] = dict(links or {})
+        self.codecs: Dict[str, float] = dict(codecs or {})  # scheme -> γ ms/MiB
+
+    def link(self, name: str) -> LinkModel:
+        m = self.links.get(name)
+        if m is not None:
+            return m
+        return DEFAULT_LINKS.get(name, DEFAULT_LINKS["ici"])
+
+    def leg_ms(self, link: str, wire_bytes: float) -> float:
+        return self.link(link).ms(wire_bytes)
+
+    def codec_ms(self, scheme: str, logical_bytes: float) -> float:
+        return self.codecs.get(scheme, 0.0) * float(logical_bytes) / MiB
+
+    def fitted_links(self) -> Dict[str, str]:
+        """{link: source} for every non-default curve (telemetry/probe)."""
+        return {k: m.source for k, m in self.links.items()
+                if m.source != "default"}
+
+    def to_json(self) -> dict:
+        return {
+            "links": {k: m.to_json() for k, m in self.links.items()},
+            "codecs": {k: round(v, 6) for k, v in self.codecs.items()},
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "CostModel":
+        return cls(
+            links={k: LinkModel.from_json(v)
+                   for k, v in (d.get("links") or {}).items()},
+            codecs={k: float(v) for k, v in (d.get("codecs") or {}).items()},
+        )
+
+
+def fit_cost_model(counters, world: int, default_link: str = "ici") -> CostModel:
+    """Fit the full model from one Counters' harvest.
+
+    Link curves come from scheme-"none" points: probe points are already
+    per-round; fleet-telemetry points are end-to-end collective latencies
+    of the default (tree-schedule) strategy, so they are normalized by
+    `rounds_tree(world)` before entering the same least-squares fit.
+    Codec overheads γ come from the `planner_codec_ms_per_mib:<scheme>`
+    gauges the probe publishes.  Links with no points at all keep the
+    DEFAULT_LINKS prior (source="default" — a consumer can tell a guess
+    from a fit).
+    """
+    points = harvest_points(counters, world, default_link=default_link)
+    r0 = rounds_tree(world)
+    model = CostModel()
+    for link in LINKS:
+        pts = points.get((link, "none"))
+        if not pts:
+            continue
+        normalized = [
+            (b, ms if is_probe else ms / r0, is_probe)
+            for b, ms, is_probe in pts
+        ]
+        alpha, beta = fit_alpha_beta(normalized)
+        probes = sum(1 for p in pts if p[2])
+        source = ("probe" if probes == len(pts)
+                  else "telemetry" if probes == 0 else "mixed")
+        model.links[link] = LinkModel(
+            alpha_ms=alpha, beta_ms_per_mib=beta, n_points=len(pts),
+            source=source,
+        )
+    for name, value in counters.gauges().items():
+        if name.startswith(CODEC_GAUGE_PREFIX):
+            model.codecs[name[len(CODEC_GAUGE_PREFIX):]] = float(value)
+    return model

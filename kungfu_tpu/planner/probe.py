@@ -1,0 +1,129 @@
+"""Probe microbenchmark — seeds cost-model links that have no history.
+
+A fresh fleet has no `collective_latency_ms` history, and no telemetry
+ever covers a wire scheme the fleet has not yet run.  This probe times a
+handful of tiny allreduces per (scheme, size) through the live Session and
+records them into a Counters in the exact shape `model.fit_cost_model`
+consumes:
+
+  link points    scheme-"none" rounds under `probe:<link>:none:<bytes>`
+                 labels.  The probe pins the phased RS->AG schedule
+                 (Strategy.CLIQUE), whose round structure is known —
+                 2(n−1) rounds of ⌈e/n⌉ elements — so each observation is
+                 recorded **per round**: value = latency/rounds, label
+                 bytes = wire bytes per round.  That makes the fitted α-β
+                 a genuine per-leg model the cost formulas can multiply by
+                 any algorithm's round count.
+  codec gauges   for each compressed scheme, the residual of its measured
+                 allreduce over the locally-fitted wire cost at its
+                 (smaller) wire bytes, per MiB of logical payload:
+                 `planner_codec_ms_per_mib:<scheme>` — the measured
+                 quantize/dequantize compute cost, kept separate from the
+                 wire so byte savings are never double counted.
+
+Cost: two payload sizes, a few reps each — sub-second on CPU.
+"""
+from __future__ import annotations
+
+import math
+import statistics
+import time
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..plan import Strategy
+from .model import CODEC_GAUGE_PREFIX, MiB, fit_alpha_beta
+
+DEFAULT_PROBE_SIZES = (16 * 1024, 1024 * 1024)  # per-peer payload bytes
+
+
+def _scheme_available(scheme: str) -> bool:
+    if scheme != "fp8":
+        return True
+    import jax.numpy as jnp
+
+    return getattr(jnp, "float8_e4m3fn", None) is not None
+
+
+def _time_allreduce(session, x, label: str, reps: int, **kw) -> float:
+    """Median wall ms of `reps` blocking allreduces (one warmup call under
+    a separate name so compile time never lands in a fitted point)."""
+    session.all_reduce(x, name=f"{label}:warm", **kw)
+    times = []
+    for _ in range(max(reps, 1)):
+        t0 = time.perf_counter()
+        session.all_reduce(x, name=f"{label}:run", **kw)
+        times.append((time.perf_counter() - t0) * 1e3)
+    return statistics.median(times)
+
+
+def probe_links(
+    session,
+    counters,
+    schemes: Sequence[str] = ("none",),
+    sizes: Sequence[int] = DEFAULT_PROBE_SIZES,
+    reps: int = 3,
+    link: Optional[str] = None,
+) -> int:
+    """Record per-round link points (+ codec gauges) into `counters`.
+
+    `link` defaults to the flat link this session's collectives cross
+    ("dcn" when the session spans hosts, else "ici").  Returns the number
+    of (scheme, size) points recorded; 0 on a single-peer session.
+    """
+    n = session.size
+    if n <= 1:
+        return 0
+    if link is None:
+        link = "dcn" if session.host_count > 1 else "ici"
+    rounds = 2 * (n - 1)  # the pinned RS->AG schedule's round count
+    rng = np.random.RandomState(0)
+    points = 0
+    none_pts = []
+    for size in sizes:
+        elems = max(int(size) // 4, 1)
+        x = session.lift(rng.randn(elems).astype(np.float32))
+        round_bytes = math.ceil(elems / n) * 4
+        label = f"probe:{link}:none:{round_bytes}"
+        ms = _time_allreduce(session, x, label, reps,
+                             strategy=Strategy.CLIQUE, compression="none")
+        ms_round = ms / rounds
+        counters.observe_hist("collective_latency_ms", ms_round, label=label)
+        counters.add_egress(label, int(x.nbytes))
+        none_pts.append((round_bytes, ms_round))
+        points += 1
+    # local α-β over the none points prices the wire part of each
+    # compressed probe; the leftover is the codec's compute cost
+    alpha, beta = fit_alpha_beta(none_pts)
+    for scheme in schemes:
+        if scheme == "none" or not _scheme_available(scheme):
+            continue
+        from ..compression import resolve
+
+        cfg = resolve(scheme)
+        gammas = []
+        for size in sizes:
+            elems = max(int(size) // 4, 1)
+            x = session.lift(rng.randn(elems).astype(np.float32))
+            wire_round = cfg.wire_bytes(math.ceil(elems / n), 4)
+            label = f"probe:{link}:{scheme}:{wire_round}"
+            ms = _time_allreduce(session, x, label, reps, compression=scheme)
+            counters.observe_hist("collective_latency_ms", ms / rounds,
+                                  label=label)
+            counters.add_egress(label, int(x.nbytes))
+            wire_ms = rounds * (alpha + beta * wire_round / MiB)
+            gammas.append(max(ms - wire_ms, 0.0) / (elems * 4 / MiB))
+            points += 1
+        counters.set_gauge(f"{CODEC_GAUGE_PREFIX}{scheme}",
+                           sum(gammas) / len(gammas))
+    return points
+
+
+def probe_point_summary(counters) -> Tuple[int, int]:
+    """(probe labels, total labels) currently in the latency histogram."""
+    from .model import parse_probe_label
+
+    hists = counters.hist_summaries().get("collective_latency_ms", {})
+    probes = sum(1 for lbl in hists if parse_probe_label(lbl))
+    return probes, len(hists)

@@ -1,0 +1,119 @@
+"""ReplanPolicy — online re-planning driven by the training monitors.
+
+A `policy.BasePolicy` subclass that watches the signals the fleet already
+produces and re-runs the plan search when the world changes under the
+installed plan:
+
+  resize        the session's world size changed (elastic shrink/grow) —
+                the old plan was tuned for another topology; stale cache
+                keys are dropped before the re-search;
+  interference  the InterferenceDetector's local throughput vote (the
+                host-side signal; the cluster-majority `check()` keeps its
+                own collective contract) or a truthy `interference` key in
+                the step metrics;
+  gns           the gradient-noise-scale metric crossing its threshold
+                band (same hysteresis shape as CompressionPolicy: replan
+                on regime *change*, not on every step in the regime).
+
+Re-planning runs the full pipeline (probe-refresh -> search -> measured
+runoff -> install -> cache) via `Planner.replan`, so a mid-training
+network degradation shows up in the next fitted model and the plan moves.
+A `cooldown_steps` guard stops trigger storms from thrashing compiled-step
+caches.  Exceptions inside the policy are journaled as `policy_error` by
+PolicyRunner — a crashing replanner is visible in the fleet journal.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ..policy import BasePolicy
+from ..utils import get_logger
+
+log = get_logger("kungfu.planner.replan")
+
+
+class ReplanPolicy(BasePolicy):
+    """Re-run the collective plan search when the monitors say so.
+
+    Args:
+      planner: the Planner bound to the live session.
+      payload_bytes: the gradient payload whose bucket's winner gets
+        installed after a replan (default 4 MiB).
+      gns_threshold: noise-scale level arming the gns trigger (None = off).
+      hysteresis: lower edge of the gns band, as a fraction of threshold.
+      metric: step-metrics key carrying the noise scale.
+      interference: an InterferenceDetector whose local_vote() arms the
+        interference trigger (optional; a truthy "interference" metrics
+        key works too).
+      cooldown_steps: minimum steps between replans.
+    """
+
+    def __init__(self, planner, payload_bytes: int = 4 << 20,
+                 gns_threshold: Optional[float] = None,
+                 hysteresis: float = 0.5, metric: str = "noise_scale",
+                 interference=None, cooldown_steps: int = 20,
+                 reps: int = 3):
+        self.planner = planner
+        self.payload_bytes = int(payload_bytes)
+        self.gns_threshold = gns_threshold
+        self.hysteresis = float(hysteresis)
+        self.metric = metric
+        self.interference = interference
+        self.cooldown_steps = int(cooldown_steps)
+        self.reps = int(reps)
+        self.replans = 0
+        self._step = 0
+        self._since_replan = cooldown_steps  # first trigger may fire at once
+        self._last_world = planner.session.size
+        self._gns_high: Optional[bool] = None
+
+    # -- triggers ---------------------------------------------------------------------
+
+    def _gns_trigger(self, metrics: Optional[Dict[str, Any]]) -> bool:
+        if self.gns_threshold is None or not metrics:
+            return False
+        try:
+            ns = float(metrics[self.metric])
+        except (KeyError, TypeError, ValueError):
+            return False
+        if ns >= self.gns_threshold:
+            regime = True
+        elif ns < self.gns_threshold * self.hysteresis:
+            regime = False
+        else:
+            return False  # inside the band: keep the current regime
+        changed = self._gns_high is not None and regime != self._gns_high
+        self._gns_high = regime
+        return changed
+
+    def trigger_reason(self,
+                       metrics: Optional[Dict[str, Any]]) -> Optional[str]:
+        if self.planner.session.size != self._last_world:
+            return "resize"
+        if metrics and metrics.get("interference"):
+            return "interference"
+        if self.interference is not None and self.interference.local_vote():
+            return "interference"
+        if self._gns_trigger(metrics):
+            return "gns"
+        return None
+
+    # -- policy hooks -----------------------------------------------------------------
+
+    def after_step(self, metrics: Optional[Dict[str, Any]] = None) -> None:
+        self._step += 1
+        self._since_replan += 1
+        reason = self.trigger_reason(metrics)
+        if reason is None:
+            return
+        if reason != "resize" and self._since_replan < self.cooldown_steps:
+            log.info("replan trigger %r suppressed (cooldown %d/%d)",
+                     reason, self._since_replan, self.cooldown_steps)
+            return
+        self._since_replan = 0
+        self._last_world = self.planner.session.size
+        self.replans += 1
+        log.info("replan #%d (reason=%s, step=%d)",
+                 self.replans, reason, self._step)
+        self.planner.replan(reason, install_for_bytes=self.payload_bytes,
+                            reps=self.reps)

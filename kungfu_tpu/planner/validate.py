@@ -1,0 +1,78 @@
+"""Plan validity gate — kf-lint as the planner's oracle.
+
+No plan the search emits may be installed until it passes two checks:
+
+  graph level    `analysis.check_collective_plan` over the plan's
+                 reference (reduce, bcast) graph pairs: ring rounds must
+                 be valid (partial) permutations, trees single-rooted /
+                 acyclic / rank-covering, and each pair internally
+                 consistent.  Pure graph algebra — runs at any world size
+                 with no devices.
+  program level  the *actual compiled program* the plan selects
+                 (Session.program_for) traced and run through the full
+                 kf-lint rule engine (`analysis.check`) — axis validity,
+                 deadlock, ppermute bijection — before first dispatch.
+                 Needs a live Session whose size matches the plan.
+
+A candidate failing either check is rejected and the reason journaled
+(`plan_rejected`); the planner can therefore never schedule an illegal or
+deadlocking program, exactly the guarantee the trace-time hooks give
+hand-written training steps.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from .. import analysis
+from .candidates import Plan
+
+
+def plan_findings(
+    plan: Plan,
+    hosts: Sequence[Sequence[int]],
+    session=None,
+) -> List[analysis.Finding]:
+    """All kf-lint findings for one candidate (graph level, plus program
+    level when a matching live session is given)."""
+    try:
+        pairs = plan.graph_pairs(hosts)
+    except ValueError as e:
+        # the gen_* generators validate on construction now; a refusal IS
+        # the finding
+        return [analysis.Finding(
+            rule=analysis.RULE_PERMUTATION, severity=analysis.ERROR,
+            message=str(e),
+        )]
+    findings = list(analysis.check_collective_plan(
+        pairs, plan.world, what=plan.describe()))
+    if session is not None and not analysis.errors(findings):
+        findings.extend(program_findings(plan, session))
+    return findings
+
+
+def program_findings(plan: Plan, session) -> List[analysis.Finding]:
+    """Trace the compiled program this plan would install and run the full
+    rule engine on it (pure tracing — no dispatch, no devices touched)."""
+    import jax
+    import numpy as np
+
+    fn = session.program_for(
+        "all_reduce", op="sum", strategy=plan.strategy,
+        compression=plan.compression(),
+    )
+    x = jax.ShapeDtypeStruct((session.size, 1024), np.dtype(np.float32))
+    return list(analysis.check(fn, x, mesh=session.mesh))
+
+
+def validate_plan(
+    plan: Plan,
+    hosts: Sequence[Sequence[int]],
+    session=None,
+) -> List[str]:
+    """Error-severity problems with `plan` ([] == installable)."""
+    return [f.message for f in analysis.errors(
+        plan_findings(plan, hosts, session=session))]
+
+
+def reject_reason(problems: Sequence[str]) -> Optional[str]:
+    return "; ".join(problems) if problems else None

@@ -12,6 +12,9 @@ from __future__ import annotations
 from typing import Any, Dict, Optional, Sequence
 
 from . import variables as V
+from .utils import get_logger
+
+log = get_logger("kungfu.policy")
 
 
 class BasePolicy:
@@ -108,6 +111,12 @@ class PolicyRunner:
 
     steps_per_epoch > 0 turns step boundaries into epoch callbacks, the way
     the reference derives epochs from trained-sample counts.
+
+    A raising policy must never kill the train loop, but it must not vanish
+    either: every hook runs through `_call`, which journals a
+    `policy_error` event (hook kind, policy class, step, error) and
+    continues with the remaining policies — so a crashing `ReplanPolicy`
+    is visible in the fleet journal instead of silently disabling itself.
     """
 
     def __init__(self, policies: Sequence[BasePolicy], batch_size: int = 0,
@@ -117,24 +126,40 @@ class PolicyRunner:
         self.steps_per_epoch = steps_per_epoch
         self._step_in_epoch = 0
         self._in_epoch = False
+        self.step = 0
+        self.policy_errors = 0
         # batch_size=0 = unknown yet (fit discovers it from the first batch);
         # never clobber a user-set kungfu_batch_size with 0
         if batch_size:
             V.set_variable(V.BATCH_SIZE, batch_size)
         V.set_variable(V.TRAINED_SAMPLES, V.get_variable(V.TRAINED_SAMPLES, 0.0))
 
+    def _call(self, kind: str, p: BasePolicy, fn, *args) -> None:
+        try:
+            fn(*args)
+        except Exception as e:
+            self.policy_errors += 1
+            log.warning("policy %s.%s raised at step %d: %s",
+                        type(p).__name__, kind, self.step, e)
+            from .monitor.journal import journal_event
+
+            journal_event(
+                "policy_error", kind=kind, policy=type(p).__name__,
+                step=self.step, error=f"{type(e).__name__}: {e}",
+            )
+
     def begin(self) -> None:
         for p in self.policies:
-            p.before_train()
+            self._call("before_train", p, p.before_train)
 
     def before_step(self) -> None:
         if self.steps_per_epoch and not self._in_epoch:
             self._in_epoch = True
             self._step_in_epoch = 0
             for p in self.policies:
-                p.before_epoch()
+                self._call("before_epoch", p, p.before_epoch)
         for p in self.policies:
-            p.before_step()
+            self._call("before_step", p, p.before_step)
 
     def after_step(self, samples: int,
                    metrics: Optional[Dict[str, Any]] = None) -> None:
@@ -142,19 +167,20 @@ class PolicyRunner:
             self.batch_size = samples
             V.set_variable(V.BATCH_SIZE, samples)
         V.global_variables().add(V.TRAINED_SAMPLES, samples)
+        self.step += 1
         for p in self.policies:
-            p.after_step(metrics)
+            self._call("after_step", p, p.after_step, metrics)
         if self.steps_per_epoch:
             self._step_in_epoch += 1
             if self._step_in_epoch >= self.steps_per_epoch:
                 self._in_epoch = False
                 for p in self.policies:
-                    p.after_epoch()
+                    self._call("after_epoch", p, p.after_epoch)
 
     def end(self) -> None:
         if self.steps_per_epoch and self._in_epoch:
             self._in_epoch = False
             for p in self.policies:
-                p.after_epoch()
+                self._call("after_epoch", p, p.after_epoch)
         for p in self.policies:
-            p.after_train()
+            self._call("after_train", p, p.after_train)

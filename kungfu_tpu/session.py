@@ -98,6 +98,12 @@ class Session:
         self._fns: Dict[Any, Callable] = {}
         self._analyze = analyze_enabled(analyze)
         self._analyzed: set = set()
+        # installed default wire format (CompressionConfig or per-leg
+        # AxisConfig); None = full precision.  all_reduce(compression=None)
+        # reads this, so the planner's set_compression changes the wire of
+        # every subsequent default collective — the wire analog of
+        # set_strategy.
+        self.compression = None
         names = self.mesh.axis_names
         self._hierarchical_axes = ("ici", "dcn") if ("ici" in names and "dcn" in names) else None
         self._axes: Tuple[str, ...] = tuple(names)
@@ -136,6 +142,38 @@ class Session:
         log.info("strategy swap: %s -> %s", self.strategy.name, strategy.name)
         journal_event("strategy_switch", old=self.strategy.name, new=strategy.name)
         self.strategy = strategy
+
+    def set_compression(self, compression) -> None:
+        """Install the session-default wire format: a CompressionConfig, a
+        registered name, a {leg: config} mapping ("ici"/"dcn" per-leg wire
+        dtypes on a hierarchical mesh), or None for full precision.  The
+        wire analog of set_strategy — subsequent all_reduce calls that pass
+        no explicit compression run the other compiled program.
+        """
+        from .monitor.journal import journal_event
+
+        new = self._resolve_compression(compression)
+        old = self.compression
+        desc = lambda c: "none" if c is None else c.describe()
+        log.info("wire swap: %s -> %s", desc(old), desc(new))
+        journal_event("compression_switch", old=desc(old), new=desc(new),
+                      source="session")
+        self.compression = new
+
+    def _resolve_compression(self, compression):
+        """Normalize to the hashable installed form: None (= full
+        precision), a CompressionConfig, or a per-leg AxisConfig."""
+        from . import compression as Comp
+
+        if compression is None:
+            return None
+        if isinstance(compression, Comp.AxisConfig):
+            return compression if compression.is_compressed else None
+        if isinstance(compression, dict):
+            ax = Comp.AxisConfig.make(compression)
+            return ax if ax.is_compressed else None
+        cfg = Comp.resolve(compression)
+        return None if cfg.scheme == "none" else cfg
 
     def set_tree(self, forest) -> None:
         """Install an explicit bcast tree (SimpleSetGlobalStrategy analog,
@@ -193,9 +231,20 @@ class Session:
 
         if kind == "all_reduce":
             cfg = kw.get("compression")
-            if cfg is not None and cfg.scheme != "none":
-                from . import compression as Comp
+            from . import compression as Comp
 
+            if isinstance(cfg, Comp.AxisConfig):
+                # per-leg wire dtypes (the planner's installed form):
+                # hierarchical-mesh-only by construction (_effective_wire
+                # flattens it to the single live leg on flat meshes)
+                ici_cfg, dcn_cfg = cfg.get("ici"), cfg.get("dcn")
+
+                def body(x):
+                    return Comp.hierarchical_all_reduce(
+                        jnp.squeeze(x, 0), "ici", "dcn",
+                        ici_config=ici_cfg, dcn_config=dcn_cfg, op=op,
+                    )[None]
+            elif cfg is not None and cfg.scheme != "none":
                 if self._hierarchical_axes is not None:
                     # compress the slow DCN leg only (the EQuARX placement);
                     # ICI stays full precision
@@ -267,9 +316,13 @@ class Session:
             return
         from . import analysis
 
+        from . import compression as Comp
+
         cfg = kw.get("compression")
         comp = None
-        if cfg is not None and getattr(cfg, "scheme", "none") != "none":
+        if isinstance(cfg, Comp.AxisConfig):
+            comp = {leg: c for leg, c in cfg.legs if c.scheme != "none"}
+        elif cfg is not None and getattr(cfg, "scheme", "none") != "none":
             # the compressed leg: DCN on a hierarchical mesh, else the
             # (single) data axis — mirrors _build's placement
             leg = "dcn" if self._hierarchical_axes is not None else self._axes[0]
@@ -307,8 +360,9 @@ class Session:
                 "strategy": (strategy if strategy is not None else self.strategy).name,
                 "bytes": int(nbytes), "dtype": str(jnp.asarray(x).dtype),
             }
-            if cfg is not None and getattr(cfg, "scheme", "none") != "none":
-                span_args["compression"] = cfg.scheme
+            if cfg is not None and getattr(cfg, "scheme", None) != "none":
+                # CompressionConfig and per-leg AxisConfig both describe()
+                span_args["compression"] = cfg.describe()
         t0 = time.perf_counter()
         with stall_detector(name or kind):
             with T.trace_scope(f"collective:{name or kind}", cat="collective",
@@ -338,28 +392,60 @@ class Session:
             from .plan.strategy import strategy_for_tree
 
             strategy = strategy_for_tree(Graph.from_forest_array(list(tree)))
-        cfg = None
-        if compression is not None:
-            from . import compression as Comp
+        from . import compression as Comp
 
-            cfg = Comp.resolve(compression)
+        if compression is None:
+            cfg = self.compression  # session default (set_compression)
+        else:
+            cfg = self._resolve_compression(compression)
+        cfg = self._effective_wire(cfg)
         out = self._run("all_reduce", x, op=op, name=name, strategy=strategy,
                         compression=cfg)
         c = self._byte_counters
         if c is not None and cfg is not None:
-            from . import compression as Comp
-
+            # accounting config: the slow (DCN) leg of a per-leg install,
+            # matching _build's placement on hierarchical meshes
+            acct = cfg.get("dcn") if isinstance(cfg, Comp.AxisConfig) else cfg
             x_arr = jnp.asarray(x)
             elems = int(x_arr.size) // self.size  # per-peer payload
             itemsize = int(jnp.dtype(x_arr.dtype).itemsize)
             # same 2(n-1)/n algorithmic factor for every dense wire format,
             # so the per-leg payload is the fair per-scheme comparison
             c.add_wire(name or "all_reduce", elems * itemsize,
-                       cfg.wire_bytes(elems, itemsize))
-            if cfg.scheme != "none":
-                err = float(np.asarray(Comp.quantization_error(x_arr, cfg)))
+                       acct.wire_bytes(elems, itemsize))
+            if acct.scheme != "none":
+                err = float(np.asarray(Comp.quantization_error(x_arr, acct)))
                 c.record_quant_error(name or "all_reduce", err)
         return out
+
+    def _effective_wire(self, cfg):
+        """Canonicalize an installed/explicit wire config for this mesh:
+        AxisConfig stays per-leg only when the mesh actually has ici+dcn
+        axes; on a flat mesh it flattens to the single live leg (dcn when
+        the session spans hosts, else ici).  Returns None, a non-none
+        CompressionConfig, or an AxisConfig — the forms _build handles."""
+        from . import compression as Comp
+
+        if cfg is None or not isinstance(cfg, Comp.AxisConfig):
+            return cfg
+        if self._hierarchical_axes is not None:
+            return cfg
+        flat = cfg.get("dcn") if self.host_count > 1 else cfg.get("ici")
+        return None if flat.scheme == "none" else flat
+
+    def program_for(self, kind: str = "all_reduce", op: str = "sum",
+                    strategy: Optional[Strategy] = None,
+                    compression=None, **kw) -> Callable:
+        """The compiled program a (strategy, compression) pair selects —
+        without dispatching it.  The plan compiler lints every candidate's
+        program through kf-lint (analysis.check) before the plan may be
+        installed, using exactly the function a post-install collective
+        would run."""
+        impl = self._impl(strategy)
+        if kind == "all_reduce":
+            kw["compression"] = self._effective_wire(
+                self._resolve_compression(compression))
+        return self._compiled(kind, op, impl, **kw)
 
     def _fused_group_fn(self, signature, op: str, impl: Impl) -> Callable:
         """One compiled program reducing EVERY tensor in the list.

@@ -37,6 +37,19 @@ if [ "$fast" = "1" ]; then
     exit 0
 fi
 
+echo "== planner smoke: enumerate -> lint -> cost -> install (2-rank CPU) =="
+# the full plan-compiler pipeline must run end to end: every enumerated
+# candidate passes kf-lint, the seeded illegal one is rejected + journaled,
+# the measured winner installs (strategy + wire dtype change on the live
+# Session), and the plan cache persists — the SECOND run must report a
+# cache hit and skip re-measurement (docs/planner.md)
+plan_cache_dir=$(mktemp -d)
+JAX_PLATFORMS=cpu python -m kungfu_tpu.planner --smoke --np 2 \
+    --cache "$plan_cache_dir/plan_cache.json"
+JAX_PLATFORMS=cpu python -m kungfu_tpu.planner --smoke --np 2 \
+    --cache "$plan_cache_dir/plan_cache.json" --expect-cache-hit
+rm -rf "$plan_cache_dir"
+
 echo "== chaos smoke: scripted crash+heal drill (CPU, buddy-RAM rung) =="
 # --expect-rung buddy: the heal must resync from the peer-redundant
 # in-memory tier (recovery_rung=buddy journaled, zero disk restores)

@@ -1,0 +1,496 @@
+"""Tests for the collective plan compiler (kungfu_tpu.planner).
+
+Covers the subsystem's contract end to end: cost-model fit recovers known
+α-β parameters from synthetic histograms, enumeration covers every
+registered algorithm at n ∈ {2,3,4,8}, every enumerated plan passes
+kf-lint (and a seeded illegal candidate is rejected + journaled, never
+installed), the plan cache round-trips and invalidates stale keys on
+resize, and a 2-rank CPU drill asserts the installed winner actually
+changes the live Session's strategy + wire dtype.
+"""
+import json
+
+import numpy as np
+import pytest
+
+import kungfu_tpu.planner as P
+from kungfu_tpu.monitor.counters import Counters
+from kungfu_tpu.plan import Strategy, make_mesh
+
+pytestmark = pytest.mark.planner
+
+MiB = float(1 << 20)
+
+
+def synth_counters(alpha_ms, beta_ms_per_mib, link="ici",
+                   sizes=(65536, 262144, 1048576), reps=4):
+    """Counters holding probe-labelled points exactly on a known line."""
+    c = Counters()
+    for nbytes in sizes:
+        lbl = f"probe:{link}:none:{nbytes}"
+        ms = alpha_ms + beta_ms_per_mib * nbytes / MiB
+        for _ in range(reps):
+            c.observe_hist("collective_latency_ms", ms, label=lbl)
+            c.add_egress(lbl, nbytes)
+    return c
+
+
+class TestCostModelFit:
+    def test_known_alpha_beta_recovered(self):
+        c = synth_counters(alpha_ms=0.75, beta_ms_per_mib=3.5)
+        m = P.fit_cost_model(c, world=4)
+        lm = m.links["ici"]
+        assert lm.alpha_ms == pytest.approx(0.75, rel=1e-6)
+        assert lm.beta_ms_per_mib == pytest.approx(3.5, rel=1e-6)
+        assert lm.source == "probe" and lm.n_points == 3
+
+    def test_noisy_fit_within_tolerance(self):
+        rng = np.random.RandomState(0)
+        c = Counters()
+        for nbytes in (65536, 262144, 1048576, 4 << 20):
+            lbl = f"probe:ici:none:{nbytes}"
+            for _ in range(16):
+                ms = 0.5 + 2.0 * nbytes / MiB
+                c.observe_hist("collective_latency_ms",
+                               ms * (1 + 0.05 * rng.randn()), label=lbl)
+                c.add_egress(lbl, nbytes)
+        lm = P.fit_cost_model(c, world=4).links["ici"]
+        assert lm.alpha_ms == pytest.approx(0.5, rel=0.35)
+        assert lm.beta_ms_per_mib == pytest.approx(2.0, rel=0.15)
+
+    def test_telemetry_points_normalized_by_tree_rounds(self):
+        # a fleet label (non-probe) records END-TO-END latency; the fit
+        # divides by the default tree schedule's rounds
+        c = Counters()
+        world = 8
+        r0 = P.rounds_tree(world)  # 6
+        for _ in range(5):
+            # per-peer payload 1 MiB -> stacked egress is world x that
+            c.observe_hist("collective_latency_ms", 12.0, label="grad-allreduce")
+            c.add_egress("grad-allreduce", world * (1 << 20))
+        lm = P.fit_cost_model(c, world=world).links["ici"]
+        # single size -> bandwidth-only: beta = (12/r0) ms per MiB
+        assert lm.alpha_ms == 0.0
+        assert lm.beta_ms_per_mib == pytest.approx(12.0 / r0, rel=1e-6)
+        assert lm.source == "telemetry"
+
+    def test_degenerate_fits_clamp(self):
+        assert P.fit_alpha_beta([(1 << 20, 2.0)]) == (0.0, 2.0)
+        # negative slope (noise) clamps to flat alpha
+        a, b = P.fit_alpha_beta([(1 << 20, 3.0), (2 << 20, 1.0)])
+        assert b == 0.0 and a == pytest.approx(2.0)
+        with pytest.raises(ValueError):
+            P.fit_alpha_beta([])
+
+    def test_codec_gauges_become_codecs(self):
+        c = synth_counters(0.1, 1.0)
+        c.set_gauge("planner_codec_ms_per_mib:int8", 4.25)
+        m = P.fit_cost_model(c, world=2)
+        assert m.codecs["int8"] == pytest.approx(4.25)
+        assert m.codec_ms("int8", 2 << 20) == pytest.approx(8.5)
+        assert m.codec_ms("none", 2 << 20) == 0.0
+
+    def test_default_link_prior_marked(self):
+        m = P.fit_cost_model(Counters(), world=4)
+        assert m.link("dcn").source == "default"
+        assert m.fitted_links() == {}
+
+    def test_model_json_roundtrip(self):
+        c = synth_counters(0.3, 1.7)
+        c.set_gauge("planner_codec_ms_per_mib:bf16", 0.9)
+        m = P.fit_cost_model(c, world=4)
+        m2 = P.CostModel.from_json(json.loads(json.dumps(m.to_json())))
+        assert m2.links["ici"].alpha_ms == pytest.approx(
+            m.links["ici"].alpha_ms)
+        assert m2.codecs == pytest.approx(m.codecs)
+
+
+class TestCountersSnapshot:
+    def test_snapshot_roundtrip_exact(self):
+        c = synth_counters(0.5, 2.0)
+        c.inc_event("heals", 3)
+        c.set_gauge("planner_codec_ms_per_mib:int8", 1.5)
+        c.record_quant_error("grads", 0.01)
+        c.add_wire("grads", 4000, 1016)
+        snap = c.snapshot_json()
+        c2 = Counters.load_snapshot(json.loads(json.dumps(snap)))
+        assert c2.snapshot_json() == snap
+        # histograms round-trip to identical percentiles/sums
+        assert (c2.hist_percentile("collective_latency_ms", 0.5,
+                                   label="probe:ici:none:65536")
+                == c.hist_percentile("collective_latency_ms", 0.5,
+                                     label="probe:ici:none:65536"))
+
+    def test_offline_fit_equals_live_fit(self):
+        c = synth_counters(0.25, 4.0)
+        live = P.fit_cost_model(c, world=4)
+        loaded = P.fit_cost_model(
+            Counters.load_snapshot(c.snapshot_json()), world=4)
+        assert loaded.links["ici"].alpha_ms == pytest.approx(
+            live.links["ici"].alpha_ms)
+        assert loaded.links["ici"].beta_ms_per_mib == pytest.approx(
+            live.links["ici"].beta_ms_per_mib)
+
+    def test_bad_snapshot_histogram_rejected(self):
+        snap = synth_counters(0.1, 1.0).snapshot_json()
+        snap["hists"][0]["counts"] = [1, 2, 3]  # wrong bucket arity
+        with pytest.raises(ValueError):
+            Counters.load_snapshot(snap)
+
+
+GROUPINGS = {
+    2: [[0, 1]],
+    3: [[0, 1, 2]],
+    4: [[0, 1], [2, 3]],
+    8: [[0, 1, 2, 3], [4, 5, 6, 7]],
+}
+
+
+class TestEnumeration:
+    @pytest.mark.parametrize("n", [2, 3, 4, 8])
+    def test_covers_all_registered_algorithms(self, n):
+        bucket = P.default_buckets()[0]
+        plans = P.enumerate_plans(n, GROUPINGS[n], bucket)
+        assert {p.algorithm for p in plans} == set(P.ALGORITHMS)
+        # multi-host groupings get the per-leg (ici x dcn) cross product
+        multi = len(GROUPINGS[n]) > 1
+        if multi:
+            wires = {p.wire for p in plans if p.algorithm == "tree_star"}
+            assert len(wires) == len(P.SCHEMES) ** 2
+        else:
+            assert all(len(p.wire) == 1 for p in plans)
+
+    @pytest.mark.parametrize("n", [2, 3, 4, 8])
+    def test_every_enumerated_plan_passes_kf_lint(self, n):
+        bucket = P.default_buckets()[1]
+        for plan in P.enumerate_plans(n, GROUPINGS[n], bucket):
+            assert P.validate_plan(plan, GROUPINGS[n]) == [], plan.describe()
+
+    def test_plan_json_roundtrip(self):
+        bucket = P.default_buckets()[0]
+        for plan in P.enumerate_plans(4, GROUPINGS[4], bucket):
+            assert P.Plan.from_json(
+                json.loads(json.dumps(plan.to_json()))) == plan
+
+    def test_bucket_selection(self):
+        buckets = P.default_buckets()
+        assert P.bucket_for(1024, buckets).id == "small"
+        assert P.bucket_for(1 << 20, buckets).id == "medium"
+        assert P.bucket_for(1 << 30, buckets).id == "large"
+
+    def test_predict_orders_wire_savings_on_slow_links(self):
+        # β-dominated DCN link, zero codec cost: compression must price
+        # cheaper; with a huge codec cost it must price dearer
+        m = P.CostModel(links={"dcn": P.LinkModel(0.1, 50.0, source="probe"),
+                               "ici": P.LinkModel(0.01, 0.5, source="probe")})
+        hosts = GROUPINGS[8]
+        bucket = P.default_buckets()[2]
+        mk = lambda si, sd: P.Plan(
+            algorithm="tree_star", strategy_name="BINARY_TREE_STAR",
+            wire=(("dcn", sd), ("ici", si)), bucket=bucket.id, world=8)
+        free_codec = P.predict_ms(mk("none", "int8"), bucket.rep_bytes, m, hosts)
+        fp32 = P.predict_ms(mk("none", "none"), bucket.rep_bytes, m, hosts)
+        assert free_codec < fp32
+        m.codecs["int8"] = 1e6
+        assert P.predict_ms(mk("none", "int8"), bucket.rep_bytes, m,
+                            hosts) > fp32
+
+
+class TestValidityGate:
+    def test_illegal_probe_rejected(self):
+        ill = P.make_illegal_probe(4, "small")
+        problems = P.validate_plan(ill, GROUPINGS[4])
+        assert problems and "reached twice" in "".join(problems)
+
+    def test_check_collective_plan_catches_bad_pairs(self):
+        from kungfu_tpu import analysis
+        from kungfu_tpu.plan.graph import Graph
+
+        g = Graph(3)
+        g.add_edge(0, 0)
+        g.add_edge(0, 1)  # rank 2 unreachable
+        findings = analysis.check_collective_plan([(g.reverse(), g)], 3)
+        assert any("unreachable" in f.message for f in findings)
+        assert all(f.rule == analysis.RULE_PERMUTATION for f in findings)
+
+    def test_world_size_mismatch_flagged(self):
+        from kungfu_tpu import analysis
+        from kungfu_tpu.plan.graph import gen_tree, gen_default_reduce_graph
+
+        b = gen_tree(4)
+        findings = analysis.check_collective_plan(
+            [(gen_default_reduce_graph(b), b)], 8)
+        assert findings and "plan world is 8" in findings[0].message
+
+
+class TestGraphGeneratorValidation:
+    def test_tree_star_rejects_duplicate_ranks(self):
+        from kungfu_tpu.plan.graph import gen_binary_tree_star
+
+        with pytest.raises(ValueError, match="does not cover ranks"):
+            gen_binary_tree_star([[0, 1], [1]])
+
+    def test_tree_star_rejects_out_of_range_ranks(self):
+        from kungfu_tpu.plan.graph import gen_binary_tree_star
+
+        with pytest.raises(ValueError, match="does not cover ranks"):
+            gen_binary_tree_star([[0, 3]])
+
+    def test_tree_star_single_worker_host_ok(self):
+        from kungfu_tpu.plan.graph import gen_binary_tree_star
+
+        g = gen_binary_tree_star([[0], [1], [2]])
+        assert g.is_valid_tree(root=0)
+
+    def test_star_rejects_bad_root(self):
+        from kungfu_tpu.plan.graph import gen_star_bcast_graph
+
+        with pytest.raises(ValueError, match="root"):
+            gen_star_bcast_graph(4, root=7)
+
+    def test_generators_reject_empty_world(self):
+        from kungfu_tpu.plan import graph as G
+
+        for fn in (G.gen_tree, G.gen_binary_tree,
+                   G.gen_circular_graph_pair):
+            with pytest.raises(ValueError):
+                fn(0)
+
+    def test_tree_errors_names_offender(self):
+        from kungfu_tpu.plan.graph import Graph
+
+        g = Graph(3)
+        g.add_edge(0, 0)
+        g.add_edge(0, 1)
+        g.add_edge(1, 0)  # cycle back into the root
+        errs = g.tree_errors()
+        assert errs and "reached twice" in errs[0]
+
+
+class TestPlanCache:
+    def entry_plan(self, world=4, bucket="medium"):
+        return P.Plan(algorithm="ring", strategy_name="RING",
+                      wire=(("ici", "int8"),), bucket=bucket, world=world)
+
+    def test_roundtrip_across_reload(self, tmp_path):
+        path = str(tmp_path / "cache.json")
+        c = P.PlanCache(path)
+        plan = self.entry_plan()
+        c.put(4, "abcd", "medium", plan, predicted_ms=1.5, measured_ms=1.2)
+        c2 = P.PlanCache(path)
+        assert c2.get_plan(4, "abcd", "medium") == plan
+        e = c2.get(4, "abcd", "medium")
+        assert e["predicted_ms"] == 1.5 and e["measured_ms"] == 1.2
+
+    def test_stale_key_invalidation_on_resize(self, tmp_path):
+        path = str(tmp_path / "cache.json")
+        c = P.PlanCache(path)
+        c.put(4, "aaaa", "small", self.entry_plan(4, "small"))
+        c.put(4, "aaaa", "medium", self.entry_plan(4, "medium"))
+        c.put(2, "bbbb", "small", self.entry_plan(2, "small"))
+        # resize to world=2/digest bbbb: the world-4 entries are stale
+        assert c.invalidate_stale(2, "bbbb") == 2
+        assert c.get_plan(4, "aaaa", "small") is None
+        assert c.get_plan(2, "bbbb", "small") is not None
+        # persisted: a reload sees the post-invalidation state
+        assert len(P.PlanCache(path)) == 1
+
+    def test_corrupt_cache_treated_as_empty(self, tmp_path):
+        path = tmp_path / "cache.json"
+        path.write_text("{not json")
+        c = P.PlanCache(str(path))
+        assert len(c) == 0 and c.load_error is not None
+
+    def test_miss_returns_none(self, tmp_path):
+        c = P.PlanCache(str(tmp_path / "cache.json"))
+        assert c.get_plan(4, "none", "small") is None
+
+
+class TestPlannerDrill:
+    """2-rank CPU drill: the full pipeline against a live Session."""
+
+    @pytest.fixture()
+    def session(self):
+        import jax
+        from kungfu_tpu.session import Session
+
+        mesh = make_mesh(dp=2, devices=jax.devices("cpu")[:2])
+        return Session(mesh)
+
+    def test_installed_winner_changes_session(self, session, tmp_path,
+                                              monkeypatch):
+        from kungfu_tpu.monitor import journal as J
+
+        jpath = str(tmp_path / "journal.jsonl")
+        monkeypatch.setenv(J.JOURNAL_FILE_ENV, jpath)
+        J._reset_for_tests()
+        try:
+            planner = P.Planner(
+                session, cache=str(tmp_path / "cache.json"),
+                counters=Counters())
+            session.set_strategy(Strategy.STAR)
+            bucket = planner.bucket(1 << 20)
+            rec = planner.tune(bucket, reps=2, install=True)
+            winner = P.Plan.from_json(rec["plan"])
+            # the drill's acceptance: strategy AND wire dtype actually moved
+            assert session.strategy is winner.strategy
+            want = session._resolve_compression(winner.compression())
+            assert session.compression == want
+            assert rec["measured_ms"] is not None
+            # the winner must still reduce correctly
+            x = np.random.RandomState(0).randn(2, 128).astype(np.float32)
+            got = np.asarray(session.all_reduce(x, name="drill"))[0]
+            np.testing.assert_allclose(got, x.sum(0), rtol=0.05, atol=1e-4)
+            events = [e["event"] for e in J.read_journal(jpath)]
+            assert "plan_selected" in events
+        finally:
+            J._reset_for_tests()
+
+    def test_cache_hit_skips_measurement(self, session, tmp_path):
+        planner = P.Planner(session, cache=str(tmp_path / "c.json"),
+                            counters=Counters())
+        bucket = planner.bucket(1024)
+        cold = planner.tune(bucket, reps=2)
+        assert cold["cache_hit"] is False and cold["measured"] > 0
+        # a fresh planner over the same cache file = a restarted process
+        planner2 = P.Planner(session, cache=str(tmp_path / "c.json"),
+                             counters=Counters())
+        hit = planner2.tune(bucket, reps=2)
+        assert hit["cache_hit"] is True and hit["measured"] == 0
+        assert hit["describe"] == cold["describe"]
+
+    def test_illegal_candidate_never_installed(self, session, tmp_path,
+                                               monkeypatch):
+        from kungfu_tpu.monitor import journal as J
+
+        jpath = str(tmp_path / "journal.jsonl")
+        monkeypatch.setenv(J.JOURNAL_FILE_ENV, jpath)
+        J._reset_for_tests()
+        try:
+            planner = P.Planner(session, cache=None, counters=Counters())
+            bucket = planner.buckets[0]
+            ill = P.make_illegal_probe(planner.world, bucket.id)
+            res = planner.search(
+                bucket, candidates=planner.candidates(bucket) + [ill])
+            assert ill in [p for p, _ in res["rejected"]]
+            assert ill not in [p for p, _ in res["ranked"]]
+            events = J.read_journal(jpath)
+            rej = [e for e in events if e["event"] == "plan_rejected"]
+            assert rej and "reached twice" in rej[0]["reason"]
+        finally:
+            J._reset_for_tests()
+
+    def test_program_lint_gate_on_live_session(self, session):
+        # program-level kf-lint of a legal plan traces clean
+        plan = P.enumerate_plans(2, [[0, 1]], P.default_buckets()[0])[0]
+        assert P.validate_plan(plan, [[0, 1]], session=session) == []
+
+    def test_probe_seeds_and_fits(self, session):
+        c = Counters()
+        n = P.probe_links(session, c, schemes=("none", "int8"), reps=1)
+        assert n >= 3
+        m = P.fit_cost_model(c, world=session.size)
+        assert m.links["ici"].source == "probe"
+        assert "int8" in m.codecs
+
+    def test_on_resize_invalidates_cache(self, session, tmp_path):
+        planner = P.Planner(session, cache=str(tmp_path / "c.json"),
+                            counters=Counters())
+        planner.cache.put(99, "stale", "small",
+                          P.Plan(algorithm="ring", strategy_name="RING",
+                                 wire=(("ici", "none"),), bucket="small",
+                                 world=99))
+        assert planner.on_resize() == 1
+        assert len(planner.cache) == 0
+
+
+class FakePlanner:
+    def __init__(self, size=2):
+        self.session = type("S", (), {"size": size})()
+        self.calls = []
+
+    def replan(self, reason, install_for_bytes=0, reps=0):
+        self.calls.append(reason)
+
+
+class TestReplanPolicy:
+    def test_resize_trigger(self):
+        fp = FakePlanner(size=4)
+        pol = P.ReplanPolicy(fp, cooldown_steps=0)
+        pol.after_step({})
+        assert fp.calls == []
+        fp.session.size = 3  # elastic shrink
+        pol.after_step({})
+        assert fp.calls == ["resize"]
+
+    def test_gns_regime_change_trigger(self):
+        fp = FakePlanner()
+        pol = P.ReplanPolicy(fp, gns_threshold=100.0, cooldown_steps=0)
+        pol.after_step({"noise_scale": 10.0})   # establishes low regime
+        pol.after_step({"noise_scale": 20.0})   # still low: no replan
+        assert fp.calls == []
+        pol.after_step({"noise_scale": 500.0})  # regime flip
+        assert fp.calls == ["gns"]
+        pol.after_step({"noise_scale": 90.0})   # inside band: hold
+        assert fp.calls == ["gns"]
+        pol.after_step({"noise_scale": 10.0})   # below band: flip back
+        assert fp.calls == ["gns", "gns"]
+
+    def test_interference_metric_trigger_and_cooldown(self):
+        fp = FakePlanner()
+        pol = P.ReplanPolicy(fp, cooldown_steps=3)
+        pol.after_step({"interference": True})
+        assert fp.calls == ["interference"]
+        pol.after_step({"interference": True})  # inside cooldown
+        assert fp.calls == ["interference"]
+        pol.after_step({})
+        pol.after_step({"interference": True})  # cooldown elapsed
+        assert fp.calls == ["interference", "interference"]
+
+    def test_interference_detector_local_vote(self):
+        class Det:
+            def local_vote(self):
+                return True
+
+        fp = FakePlanner()
+        pol = P.ReplanPolicy(fp, interference=Det(), cooldown_steps=0)
+        pol.after_step({})
+        assert fp.calls == ["interference"]
+
+
+class TestPolicyErrorJournaling:
+    def test_raising_policy_journaled_and_survived(self, tmp_path,
+                                                   monkeypatch):
+        from kungfu_tpu.monitor import journal as J
+        from kungfu_tpu.policy import BasePolicy, PolicyRunner
+
+        jpath = str(tmp_path / "journal.jsonl")
+        monkeypatch.setenv(J.JOURNAL_FILE_ENV, jpath)
+        J._reset_for_tests()
+        try:
+            class Boom(BasePolicy):
+                def after_step(self, metrics=None):
+                    raise RuntimeError("kaboom")
+
+            class Counts(BasePolicy):
+                seen = 0
+
+                def after_step(self, metrics=None):
+                    Counts.seen += 1
+
+            r = PolicyRunner([Boom(), Counts()], batch_size=4)
+            r.before_step()
+            r.after_step(4)
+            r.after_step(4)
+            # the raising policy never starved its successors
+            assert Counts.seen == 2
+            assert r.policy_errors == 2
+            events = J.read_journal(jpath)
+            errs = [e for e in events if e["event"] == "policy_error"]
+            assert len(errs) == 2
+            assert errs[0]["policy"] == "Boom"
+            assert errs[0]["kind"] == "after_step"
+            assert errs[0]["step"] == 1 and errs[1]["step"] == 2
+            assert "kaboom" in errs[0]["error"]
+        finally:
+            J._reset_for_tests()
