@@ -727,6 +727,56 @@ def _measure_serving():
     return section or None
 
 
+def _measure_step_attribution():
+    """The BENCH json's "step_attribution" section: per-phase p50 fractions
+    (compute / data-wait / collective-wait) and straggler-detection latency
+    from a LIVE run — the straggler-observatory drill (kungfu_tpu.chaos
+    --straggler-drill) on a 3-rank CPU fleet.  Runs through the
+    measurement-resilient runner (kungfu_tpu.benchmarks.runner): probed
+    before it starts, requeued on failure, and stamped `measured_this_run`
+    honestly rather than silently omitted.  Opt out with
+    KFT_BENCH_SKIP_ATTRIBUTION=1."""
+    if os.environ.get("KFT_BENCH_SKIP_ATTRIBUTION"):
+        return None
+
+    import tempfile
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    try:
+        from kungfu_tpu.benchmarks import runner as bench_runner
+
+        with tempfile.NamedTemporaryFile(suffix=".json") as f:
+            rec = bench_runner.run_section(
+                bench_runner.Section(
+                    name="step_attribution",
+                    argv=[sys.executable, "-m", "kungfu_tpu.chaos",
+                          "--straggler-drill", "--timeout", "180",
+                          "--json", f.name],
+                    out_json=f.name, timeout_s=260.0, cwd=repo,
+                    # the drill is CPU-by-construction: probe CPU so a
+                    # wedged tunnel cannot block a host-only measurement
+                    env={"JAX_PLATFORMS": "cpu"},
+                ),
+                probe_timeout_s=60.0, retries=1, interval_s=2.0,
+            )
+    except Exception:  # never let the drill probe sink the headline
+        return None
+    if not rec.get("measured_this_run"):
+        return {"measured_this_run": False, "error": rec.get("error")}
+    att = rec.get("step_attribution") or {}
+    return {
+        "measured_this_run": True,
+        "compute_frac_p50": att.get("compute_frac_p50"),
+        "data_frac_p50": att.get("data_frac_p50"),
+        "collective_wait_frac_p50": att.get("collective_wait_frac_p50"),
+        "flagged_rank": rec.get("flagged_rank"),
+        "time_to_flag_s": rec.get("time_to_flag_s"),
+        "stall_deadline_s": rec.get("stall_deadline_s"),
+        "false_positives": rec.get("false_positives"),
+        "worker_slow_events": rec.get("worker_slow_events"),
+    }
+
+
 def _measure_planner():
     """The BENCH json's "planner" section: the collective plan compiler's
     per-bucket A/B (kungfu_tpu.planner) — chosen plan, predicted vs
@@ -883,6 +933,7 @@ def main():
     mttr_buddy_s, mttr_disk_s, journal_events = _measure_mttr_s()
     serving = _measure_serving()
     planner = _measure_planner()
+    step_attribution = _measure_step_attribution()
     lat_pcts = best.get("step_latency_pcts") or {}
 
     # comparative context (VERDICT r4 missing #1): the recorded
@@ -966,6 +1017,13 @@ def main():
                 # cost-model honesty) and the planner-vs-hand-tuned p50
                 # A/B; >= 1.0 worst speedup == the planner never loses
                 "planner": planner,
+                # straggler observatory (docs/observability.md): per-phase
+                # p50 step fractions (compute/data-wait/collective-wait)
+                # from a live 3-rank drill, plus slow-rank detection
+                # latency vs the stall deadline that used to be the only
+                # judge — run through the probed/requeueing bench runner,
+                # so measured_this_run is stamped honestly per section
+                "step_attribution": step_attribution,
                 "input_pipeline": input_pipeline,
                 "sweep": [
                     {

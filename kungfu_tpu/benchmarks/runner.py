@@ -1,0 +1,253 @@
+"""Measurement-resilient bench runner (ROADMAP 5b).
+
+Two committed BENCH rounds shipped with `measured_this_run: false` because
+the TPU tunnel wedged mid-record and nothing retried.  `scripts/tpu_retry.py`
+grew the survival pattern — probe the backend with a short-timeout,
+tree-killable subprocess; run jobs only while the probe passes; requeue
+failures to the back of the queue with a bounded budget — but it lived
+outside the library where only a babysat shell loop could use it.  This
+module folds the pattern into `kungfu_tpu/benchmarks` proper:
+
+  probe_backend   the PROBE_OK sentinel probe: a trivial jit dispatch in a
+                  throwaway subprocess that must prove a TPU-CLASS device
+                  answered (CPU counts only when explicitly requested), so
+                  a fast axon failure silently falling back to CPU can
+                  never drain a queue of on-chip benchmarks on the host.
+  Section         one bench section: a callable returning its record, or an
+                  argv whose JSON record is read from `out_json` (or the
+                  last JSON line of stdout).
+  run_sections    the queue loop: probe before EVERY section, journal
+                  `bench_probe_failed` on a dead backend, requeue failures
+                  to the back (`bench_requeued`) under a per-section
+                  attempt budget, and stamp `measured_this_run` honestly
+                  into every record — True only when the section actually
+                  ran to completion THIS invocation.
+
+`python -m kungfu_tpu.benchmarks.runner --queue jobs.txt --out results.json`
+is the unattended entrypoint (the tpu_retry.py contract, with journaled
+events and a machine-readable result file); `bench.py` uses `run_section`
+for its drill-backed BENCH sections.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from collections import deque
+from typing import Callable, Dict, Optional, Sequence
+
+from ..monitor.journal import journal_event
+from ..utils import get_logger
+
+log = get_logger("kungfu.bench.runner")
+
+# The child decides platform health and prints a sentinel (single source of
+# truth — same convention as bench.py's probe and scripts/tpu_retry.py):
+# TPU-class platform => OK; CPU => OK only when the operator EXPLICITLY
+# requested cpu (KFT_PLATFORM/JAX_PLATFORMS=cpu).
+PROBE_SRC = (
+    "import os, jax, jax.numpy as jnp; "
+    "want_cpu = (os.environ.get('KFT_PLATFORM') == 'cpu' "
+    "or os.environ.get('JAX_PLATFORMS') == 'cpu'); "
+    "want_cpu and jax.config.update('jax_platforms', 'cpu'); "
+    "plat = jax.devices()[0].platform; "
+    "x = float(jnp.sum(jnp.ones((8, 8)) * 31.0).block_until_ready()); "
+    "ok = x == 1984.0 and (plat in ('tpu', 'axon') or "
+    "(plat == 'cpu' and want_cpu)); "
+    "print('PROBE_OK' if ok else f'PROBE_FALLBACK {plat}')"
+)
+
+
+def _kill_tree(p: subprocess.Popen) -> None:
+    """SIGKILL the probe/section session; never block past a short reap —
+    an unkillable D-state child is abandoned rather than freezing the
+    queue (the tpu_retry.py lesson: run()'s post-kill communicate() once
+    stalled the whole loop for 18 minutes)."""
+    try:
+        os.killpg(p.pid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError, OSError):
+        p.kill()
+    try:
+        p.wait(timeout=10)
+    except subprocess.TimeoutExpired:  # pragma: no cover - unkillable child
+        log.warning("child %d unkillable (abandoned)", p.pid)
+
+
+def probe_backend(timeout_s: float = 90.0,
+                  env: Optional[Dict[str, str]] = None) -> Optional[str]:
+    """None when a trivial dispatch completes on an acceptable platform
+    within `timeout_s`; else the reason the backend is unusable."""
+    full_env = dict(os.environ)
+    if env:
+        full_env.update(env)
+    p = subprocess.Popen(
+        [sys.executable, "-c", PROBE_SRC],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env=full_env, start_new_session=True,
+    )
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline and p.poll() is None:
+        time.sleep(0.2)
+    if p.poll() is None:
+        _kill_tree(p)
+        return f"probe timed out after {timeout_s:.0f}s (backend wedged)"
+    out = p.stdout.read() if p.stdout is not None else ""
+    if p.returncode != 0:
+        return f"probe exited {p.returncode}"
+    if "PROBE_OK" in out:
+        return None
+    if "PROBE_FALLBACK" in out:
+        return ("backend fell back to an unrequested platform "
+                f"({out.strip().split()[-1]})")
+    return "probe printed no sentinel"
+
+
+@dataclasses.dataclass
+class Section:
+    """One bench section the runner can probe-gate and retry.
+
+    Either `fn` (returns the record dict, or None = failed) or `argv` (a
+    subprocess; its record is read from `out_json` after a zero exit, else
+    parsed from the last JSON line of stdout)."""
+
+    name: str
+    argv: Optional[Sequence[str]] = None
+    fn: Optional[Callable[[], Optional[dict]]] = None
+    out_json: str = ""
+    timeout_s: float = 600.0
+    env: Optional[Dict[str, str]] = None  # extra env for argv AND its probe
+    cwd: str = ""
+
+
+def _execute(section: Section) -> Optional[dict]:
+    """Run one section once; returns its record or raises on failure."""
+    if section.fn is not None:
+        return section.fn()
+    assert section.argv is not None, f"section {section.name}: no fn or argv"
+    env = dict(os.environ)
+    if section.env:
+        env.update(section.env)
+    p = subprocess.Popen(
+        list(section.argv), stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, env=env, cwd=section.cwd or None, start_new_session=True,
+    )
+    try:
+        out, _ = p.communicate(timeout=section.timeout_s)
+    except subprocess.TimeoutExpired:
+        _kill_tree(p)
+        raise RuntimeError(f"timed out after {section.timeout_s:.0f}s") from None
+    if p.returncode != 0:
+        tail = (out or "").strip()[-400:]
+        raise RuntimeError(f"exited {p.returncode}: {tail}")
+    if section.out_json:
+        with open(section.out_json) as f:
+            return json.load(f)
+    for line in reversed((out or "").splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+    raise RuntimeError("no JSON record in section output")
+
+
+def run_sections(sections: Sequence[Section], probe_timeout_s: float = 90.0,
+                 retries: int = 2, interval_s: float = 5.0,
+                 probe: Callable[..., Optional[str]] = probe_backend,
+                 sleep: Callable[[float], None] = time.sleep) -> Dict[str, dict]:
+    """Probe-gated queue over `sections`; every record is stamped with an
+    honest `measured_this_run`.
+
+    Each pop probes the backend first (with the section's env, so CPU-only
+    drills never block on a wedged tunnel).  A failed probe or section run
+    journals (`bench_probe_failed` / `bench_requeued`) and moves the
+    section to the BACK of the queue — the backend gets `interval_s` to
+    recover while other sections take their turn — until its attempt
+    budget (`retries` + 1) is spent, at which point the section records
+    `measured_this_run: False` with the last error (`bench_section_failed`)
+    instead of silently vanishing from the BENCH json."""
+    queue = deque(sections)
+    attempts: Dict[str, int] = {}
+    results: Dict[str, dict] = {}
+    while queue:
+        s = queue.popleft()
+        attempts[s.name] = attempts.get(s.name, 0) + 1
+        fail: Optional[str] = None
+        rec: Optional[dict] = None
+        err = probe(probe_timeout_s, env=s.env)
+        if err is not None:
+            fail = f"probe: {err}"
+            journal_event("bench_probe_failed", section=s.name,
+                          attempt=attempts[s.name], error=err)
+            log.warning("section %s: %s", s.name, fail)
+        else:
+            try:
+                rec = _execute(s)
+                if rec is None:
+                    fail = "section returned no record"
+            except Exception as e:  # noqa: BLE001 - requeued, never fatal
+                fail = f"{type(e).__name__}: {e}"
+        if rec is not None and fail is None:
+            rec = dict(rec)
+            rec["measured_this_run"] = True
+            results[s.name] = rec
+            continue
+        if attempts[s.name] <= retries:
+            journal_event("bench_requeued", section=s.name,
+                          attempt=attempts[s.name], error=fail)
+            queue.append(s)
+            sleep(interval_s)
+        else:
+            journal_event("bench_section_failed", section=s.name,
+                          attempts=attempts[s.name], error=fail)
+            log.error("section %s failed for good: %s", s.name, fail)
+            results[s.name] = {"measured_this_run": False, "error": fail}
+    return results
+
+
+def run_section(section: Section, **kw) -> dict:
+    """One-section convenience wrapper around `run_sections`."""
+    return run_sections([section], **kw)[section.name]
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="kungfu_tpu.benchmarks.runner")
+    ap.add_argument("--queue", required=True,
+                    help="file with one shell command per line (#/blank "
+                         "skipped); each must print a JSON record line")
+    ap.add_argument("--out", default="", help="write {section: record} here")
+    ap.add_argument("--probe-timeout", type=float, default=90.0)
+    ap.add_argument("--job-timeout", type=float, default=1800.0)
+    ap.add_argument("--retries", type=int, default=3)
+    ap.add_argument("--interval", type=float, default=120.0,
+                    help="seconds between attempts while the backend is down")
+    args = ap.parse_args(argv)
+
+    with open(args.queue) as f:
+        cmds = [ln.strip() for ln in f
+                if ln.strip() and not ln.strip().startswith("#")]
+    sections = [
+        Section(name=f"job{i}: {cmd[:60]}", argv=["/bin/sh", "-c", cmd],
+                timeout_s=args.job_timeout)
+        for i, cmd in enumerate(cmds)
+    ]
+    results = run_sections(sections, probe_timeout_s=args.probe_timeout,
+                           retries=args.retries, interval_s=args.interval)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(results, f, indent=2)
+    measured = sum(1 for r in results.values() if r.get("measured_this_run"))
+    print(f"# runner: {measured}/{len(results)} sections measured this run",
+          flush=True)
+    return 0 if measured == len(results) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

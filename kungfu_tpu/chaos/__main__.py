@@ -195,6 +195,177 @@ def run_ckpt_drill(kind: str, timeout_s: float = 240.0) -> int:
     return 0
 
 
+def run_straggler_drill(np_: int = 3, slow_ms: float = 4000.0,
+                        slow_steps: int = 6, slow_at: int = 8,
+                        heartbeat_timeout: float = 3.0,
+                        timeout_s: float = 240.0) -> dict:
+    """Straggler-observatory drill: inject `slow@` into one rank of a
+    telemetry-armed fleet and prove the detector fingers exactly that rank
+    — with zero false positives on the clean ranks — while the healer's
+    graded judgment journals it `worker_slow` instead of killing it.
+
+    The injected per-step sleep (default 4 s) exceeds the heartbeat timeout
+    (3 s), so under the old binary alive/hung judgment the healer would
+    have stall-killed a merely-slow rank; the drill asserts the job instead
+    finishes at FULL size, the journal shows `straggler_suspected` with the
+    victim's rank (and `worker_slow`, and no `stall_kill`/`worker_failure`),
+    and the fleet `/stragglers` report attributes per-rank compute /
+    data-wait / collective-wait with the victim carrying the max compute
+    share.  Detection latency (`chaos_slow` -> `straggler_suspected` wall
+    gap) must beat the stall deadline that would have killed it.
+    """
+    import math
+    import statistics
+    import threading
+    import time as _time
+    import urllib.request
+
+    victim = np_ - 1
+    plan = f"slow@step={slow_at}:rank={victim}:ms={int(slow_ms)}:steps={slow_steps}"
+    parse_fault_plan(plan)
+    total = 32 * np_ * (slow_at + slow_steps + 24)
+    telem = tempfile.mkdtemp(prefix="kft-straggler-drill-")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env[FAULT_PLAN_ENV] = plan
+    env["KFT_JOURNAL_DIR"] = telem
+    env["KFT_TRACE_DUMP_DIR"] = telem
+    stall_deadline_s = float(env.get("KFT_STALL_DEADLINE_S", "") or 120.0)
+    cmd = [
+        sys.executable, "-m", "kungfu_tpu.run", "-w", "-heal", "-telemetry",
+        "-np", str(np_), "-platform", "cpu", "-port", "0",
+        "-heartbeat-timeout", str(heartbeat_timeout),
+        "-timeout", str(int(timeout_s)),
+        "--", sys.executable, "-m", "kungfu_tpu.testing.fake_adaptive_trainer",
+        "--total-samples", str(total), "--batch-size", "32",
+    ]
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True, bufsize=1)
+    lines: list = []
+    url_box: dict = {}
+
+    def pump():
+        assert proc.stdout is not None
+        for line in proc.stdout:
+            lines.append(line)
+            if line.startswith("TELEMETRY_URL:"):
+                url_box["url"] = line.split(":", 1)[1].strip()
+
+    threading.Thread(target=pump, daemon=True).start()
+
+    seen_suspected: set = set()
+    flag_report: dict = {}
+    deadline = _time.monotonic() + timeout_s + 30
+    while proc.poll() is None and _time.monotonic() < deadline:
+        url = url_box.get("url")
+        if url:
+            try:
+                with urllib.request.urlopen(f"{url}/stragglers", timeout=10) as r:
+                    rep = json.loads(r.read().decode())
+            except (OSError, ValueError):
+                rep = None
+            if rep:
+                suspected = set(rep.get("suspected") or ())
+                seen_suspected |= suspected
+                if victim in suspected:
+                    flag_report = rep
+        _time.sleep(0.5)
+    try:
+        rc = proc.wait(timeout=60)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        rc = -9
+
+    out = "".join(lines)
+    results = re.findall(
+        r"RESULT: fake-adaptive trained=(\d+) resizes=\d+ final_size=(\d+)", out)
+    events = _journal_events(telem)
+    by_kind: dict = {}
+    for e in events:
+        by_kind.setdefault(e.get("event", "?"), []).append(e)
+
+    failures: list = []
+    if rc != 0:
+        failures.append(f"launcher exited {rc}")
+    if len(results) != np_:
+        failures.append(f"{len(results)}/{np_} worker RESULT lines")
+    for trained, size in results:
+        if int(trained) < total:
+            failures.append(f"worker trained {trained} < {total}")
+        if int(size) != np_:
+            failures.append(f"final_size {size} != {np_}: a rank was killed")
+    if by_kind.get("stall_kill"):
+        failures.append("healer stall-killed a worker (graded judgment failed)")
+    if by_kind.get("worker_failure"):
+        failures.append("worker_failure journaled: the slow rank died")
+    if not by_kind.get("worker_slow"):
+        failures.append("no worker_slow journal event: the healer never "
+                        "exercised the slow-but-alive judgment")
+    suspected_events = by_kind.get("straggler_suspected", [])
+    sus_ranks = {e.get("rank") for e in suspected_events}
+    if victim not in sus_ranks:
+        failures.append(f"no straggler_suspected journal event for rank {victim}"
+                        f" (saw ranks {sorted(sus_ranks)})")
+    false_pos = sorted((seen_suspected | sus_ranks) - {victim, None})
+    if false_pos:
+        failures.append(f"false positives on clean ranks: {false_pos}")
+
+    # detection latency: slow-window entry -> suspicion, vs the deadline
+    # that would have killed the rank under the binary judgment
+    time_to_flag = None
+    slow_ev = by_kind.get("chaos_slow", [])
+    if slow_ev and suspected_events:
+        t0 = min(e["t_wall"] for e in slow_ev)
+        t1 = min(e["t_wall"] for e in suspected_events
+                 if e.get("rank") == victim)
+        time_to_flag = round(t1 - t0, 2)
+        if time_to_flag >= stall_deadline_s:
+            failures.append(f"detected in {time_to_flag}s, past the "
+                            f"{stall_deadline_s}s stall deadline")
+    elif not failures:
+        failures.append("cannot measure detection latency "
+                        "(missing chaos_slow/straggler_suspected stamps)")
+
+    # per-rank attribution from the report that flagged the victim
+    attribution: dict = {}
+    fracs: dict = {}
+    for r, st in (flag_report.get("ranks") or {}).items():
+        att = st.get("attribution")
+        if att:
+            fracs[int(r)] = att
+    if len(fracs) == np_:
+        for phase in ("compute_frac", "data_frac", "collective_wait_frac"):
+            attribution[f"{phase}_p50"] = round(
+                statistics.median(a[phase] for a in fracs.values()), 4)
+        attribution["per_rank"] = {str(r): fracs[r] for r in sorted(fracs)}
+        if fracs[victim]["compute_frac"] < max(
+                a["compute_frac"] for a in fracs.values()) - 1e-9:
+            failures.append("victim does not carry the max compute share "
+                            f"({fracs})")
+    else:
+        failures.append(f"attribution incomplete: {len(fracs)}/{np_} ranks "
+                        "in the flagging /stragglers report")
+
+    ttf_ok = time_to_flag is not None and math.isfinite(time_to_flag)
+    return {
+        "ok": not failures,
+        "failures": failures,
+        "np": np_,
+        "victim": victim,
+        "plan": plan,
+        "flagged_rank": victim if victim in sus_ranks else None,
+        "time_to_flag_s": time_to_flag if ttf_ok else None,
+        "stall_deadline_s": stall_deadline_s,
+        "false_positives": false_pos,
+        "worker_slow_events": len(by_kind.get("worker_slow", [])),
+        "step_attribution": attribution,
+        "report": flag_report,
+        "journal_counts": {k: len(v) for k, v in sorted(by_kind.items())},
+        "output_tail": out[-3000:] if failures else "",
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="kungfu_tpu.chaos")
     ap.add_argument("--plan", default="crash@step=7:rank=2")
@@ -218,6 +389,18 @@ def main(argv=None) -> int:
                     default="",
                     help="run a checkpoint-integrity drill instead of the "
                          "crash+heal smoke")
+    ap.add_argument("--straggler-drill", action="store_true",
+                    help="run the straggler-observatory drill instead: "
+                         "inject slow@ into one rank of a telemetry fleet, "
+                         "assert the /stragglers detector fingers exactly "
+                         "that rank (zero false positives) before the stall "
+                         "deadline, and that the healer graded it "
+                         "worker_slow instead of killing it "
+                         "(docs/observability.md)")
+    ap.add_argument("--straggler-ms", type=float, default=4000.0,
+                    help="per-step slowdown injected into the victim rank")
+    ap.add_argument("--straggler-steps", type=int, default=6,
+                    help="length of the injected slow window, in steps")
     ap.add_argument("--serve-drill", action="store_true",
                     help="run the serving drill instead: kill a serving "
                          "rank mid-stream, assert zero dropped requests + "
@@ -233,6 +416,33 @@ def main(argv=None) -> int:
     ap.add_argument("--json", default="",
                     help="serve drill: also write the metrics dict here")
     args = ap.parse_args(argv)
+
+    if args.straggler_drill:
+        summary = run_straggler_drill(
+            np_=args.np, slow_ms=args.straggler_ms,
+            slow_steps=args.straggler_steps, timeout_s=args.timeout,
+        )
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(summary, f, indent=2)
+        if not summary["ok"]:
+            print("STRAGGLER DRILL FAILED: " + "; ".join(summary["failures"]),
+                  file=sys.stderr)
+            if summary.get("output_tail"):
+                print("--- output tail ---\n" + summary["output_tail"],
+                      file=sys.stderr)
+            return 1
+        att = summary["step_attribution"]
+        print("STRAGGLER DRILL OK: "
+              f"rank {summary['flagged_rank']} fingered in "
+              f"{summary['time_to_flag_s']}s (stall deadline "
+              f"{summary['stall_deadline_s']:.0f}s), 0 false positives, "
+              f"healer graded slow-not-dead "
+              f"({summary['worker_slow_events']} worker_slow, 0 kills), "
+              f"p50 fractions compute/data/wait = "
+              f"{att.get('compute_frac_p50')}/{att.get('data_frac_p50')}/"
+              f"{att.get('collective_wait_frac_p50')}")
+        return 0
 
     if args.serve_drill:
         from ..serving.drill import run_serve_drill

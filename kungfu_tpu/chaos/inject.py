@@ -45,6 +45,7 @@ class ChaosInjector:
         self._exit = exit_fn
         self._sleep = sleep_fn
         self._fired: Set[Fault] = set()  # one-shot kinds already triggered
+        self._slow_announced: Set[Fault] = set()  # slow windows journaled
 
     def on_step(self, step: int, rank: int, ckpt_dir: str = "") -> None:
         """Fire any fault scheduled for this (step, rank).  Crash and hang
@@ -81,6 +82,14 @@ class ChaosInjector:
                     while True:  # heartbeat goes stale; the healer kills us
                         self._sleep(3600.0)
             elif f.kind == "slow":
+                if f not in self._slow_announced:
+                    # journaled once per window so a drill can measure
+                    # slow-onset -> straggler_suspected detection latency
+                    self._slow_announced.add(f)
+                    log.warning("CHAOS: slow window entered at step %d rank %d"
+                                " (%.0f ms/step)", step, rank, f.ms)
+                    self._journal("chaos_slow", step, rank, ms=f.ms,
+                                  steps=f.steps)
                 self._sleep(f.ms / 1e3)
 
     def on_serve_tokens(self, total_tokens: int, rank: int) -> None:

@@ -587,6 +587,10 @@ def run_elastic(
         document (so survivors/healer see a *planned* detach, not a death),
         DETACHED announce, clean exit."""
         log.warning("preemption: final checkpoint + detach at step %d", step)
+        # flush the span ring FIRST: even if the checkpoint wait eats the
+        # whole grace window and we are SIGKILLed, the post-mortem timeline
+        # keeps this rank's lane (the atexit dump would never run)
+        tracing.flush_dump("preempt")
         flush_completed = None
         if ckpt is not None:
             # the flush wait is DEADLINE-BOUNDED: a hung async writer must
@@ -772,6 +776,10 @@ def run_elastic(
             # latency/rate distributions measured against the dead world
             # would pollute the healed one's throughput + interference vote
             c.reset_for_reinit()
+        if anomaly is not None:
+            # the healed (smaller) world's step time is legitimately
+            # different — judging it against the old baseline would alarm
+            anomaly.reset()
         tracing.record_span("heal", m_detect, cat="heal", args={
             "version": version, "old_size": old_size, "new_size": peer.size,
             "reason": type(cause).__name__,
@@ -893,6 +901,8 @@ def run_elastic(
                     _rebuild_buddy(seed=True)
                     resizes += 1
                     resize_events.append(ev)
+                    if anomaly is not None:
+                        anomaly.reset()  # new world, new step-time baseline
                     tracing.record_span("resize", m_resize0, cat="elastic",
                                         args={"version": version,
                                               "old_size": ev["old_size"],
@@ -901,7 +911,7 @@ def run_elastic(
                 else:  # unreachable given digest consensus; log if it ever is
                     log.warning("agreed version %d but no matching doc cached", version)
 
-        with tracing.trace_scope("step:data", cat="train"):
+        with tracing.trace_scope("step:data", cat="train", args={"step": step}):
             batch = trainer.shard_batch(next(data))
         if _first_step_after_resize or _pending_heal is not None:
             import jax
@@ -972,6 +982,15 @@ def run_elastic(
     from ..monitor.counters import counters_if_enabled
 
     step_counters = counters_if_enabled()
+    # anomaly watchdog (monitor.straggler): online step-time regression
+    # detection against a rolling baseline — journaled anomaly_regression /
+    # anomaly_cleared + anomaly_step_ratio/anomaly_active gauges.  Reset on
+    # every resize/heal (the new world's step time is a new baseline).
+    anomaly = None
+    if step_counters is not None:
+        from ..monitor.straggler import AnomalyWatchdog
+
+        anomaly = AnomalyWatchdog(counters=step_counters)
     while offset < cfg.total_samples:
         m_step0 = time.monotonic()
         step_before = step
@@ -989,9 +1008,9 @@ def run_elastic(
             tracing.record_span("step", m_step0, cat="train",
                                 args={"step": step_before})
             if step_counters is not None:
-                step_counters.observe_hist(
-                    "step_latency_ms", (time.monotonic() - m_step0) * 1e3
-                )
+                dt_ms = (time.monotonic() - m_step0) * 1e3
+                step_counters.observe_hist("step_latency_ms", dt_ms)
+                anomaly.observe(dt_ms)
 
     if _prev_sigterm is not None:
         signal.signal(signal.SIGTERM, _prev_sigterm)

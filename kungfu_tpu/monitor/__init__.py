@@ -29,3 +29,9 @@ from .fleet import (  # noqa: F401
     parse_prometheus,
     targets_from_workers,
 )
+from .straggler import (  # noqa: F401
+    AnomalyWatchdog,
+    LinkHotspot,
+    StragglerDetector,
+    StragglerMonitor,
+)

@@ -15,17 +15,26 @@ single endpoint over the whole job:
   /timeline  every worker's /trace buffer merged into ONE Chrome trace,
              each rank in its own process lane (pid = rank).
   /ranks     JSON scrape status per rank (reachable, error, url).
+  /stragglers  the straggler observatory's merged report (monitor.straggler):
+             per-rank compute/data-wait/collective-wait attribution, arrival
+             skew + suspicion flags, DCN/ICI hotspot, input starvation.
 
-Scrapes happen on demand per request — the aggregator holds no state
-between requests beyond the scrape-error counter, so a healed/resized
-cluster is picked up by the next request via `targets_fn`.
+Scrapes fan out in PARALLEL with a per-target timeout, so one wedged worker
+costs one timeout — not a timeout per wedged rank serialized — and can never
+stall the merged endpoints for the whole fleet.  Scrapes happen on demand
+per request; the aggregator holds no state between requests beyond the
+scrape-error counter and the straggler observatory's rolling windows (those
+are the point: /stragglers needs history), so a healed/resized cluster is
+picked up by the next request via `targets_fn`.
 """
 from __future__ import annotations
 
 import json
 import re
 import threading
+import time
 import urllib.request
+from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Sequence, Tuple
 
@@ -198,6 +207,12 @@ class FleetAggregator:
         self.targets_fn = targets_fn
         self.timeout_s = timeout_s
         self._scrape_errors = 0
+        # persistent fan-out pool: per-request pools would pay thread spawn
+        # per scrape AND block shutdown on a wedged fetch; result(timeout=)
+        # below bounds the caller, urlopen's socket timeout bounds the thread
+        self._pool = ThreadPoolExecutor(max_workers=16,
+                                        thread_name_prefix="kft-scrape")
+        self._straggler = None  # monitor.straggler.StragglerMonitor, lazy
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -212,6 +227,9 @@ class FleetAggregator:
                         ctype = "application/json"
                     elif path == "/ranks":
                         body = json.dumps(outer.rank_status()).encode()
+                        ctype = "application/json"
+                    elif path == "/stragglers":
+                        body = json.dumps(outer.straggler_report()).encode()
                         ctype = "application/json"
                     else:
                         self.send_response(404)
@@ -247,15 +265,24 @@ class FleetAggregator:
             return r.read().decode()
 
     def scrape(self, path: str = "/metrics") -> Tuple[Dict[int, str], Dict[int, str]]:
-        """({rank: body}, {rank: error}) for one fan-out scrape."""
+        """({rank: body}, {rank: error}) for one fan-out scrape.
+
+        All targets are fetched concurrently under one shared deadline: the
+        whole scrape costs ~one `timeout_s` even when several workers are
+        wedged, instead of a timeout per wedged rank serialized."""
         bodies: Dict[int, str] = {}
         errors: Dict[int, str] = {}
-        for rank, base in self.targets_fn():
+        futs = [(rank, self._pool.submit(self._fetch, base + path))
+                for rank, base in self.targets_fn()]
+        deadline = time.monotonic() + self.timeout_s + 0.5
+        for rank, fut in futs:
             try:
-                bodies[rank] = self._fetch(base + path)
-            except OSError as e:
+                bodies[rank] = fut.result(
+                    timeout=max(0.05, deadline - time.monotonic()))
+            except Exception as e:  # noqa: BLE001 - OSError/TimeoutError/...
                 self._scrape_errors += 1
-                errors[rank] = str(e)
+                errors[rank] = str(e) or type(e).__name__
+                fut.cancel()  # frees the slot if the fetch never started
         return bodies, errors
 
     def merged_metrics(self) -> str:
@@ -283,6 +310,31 @@ class FleetAggregator:
                 continue
         return merge_chrome_traces(traces)
 
+    def straggler_report(self) -> Dict[str, Any]:
+        """One straggler-observatory update + report (docs/observability.md).
+
+        Each request scrapes every rank's /trace (incremental — the monitor
+        high-water-marks what it has already consumed) and /metrics (for the
+        link-labelled latency histograms), feeds the rolling detector, and
+        returns the merged per-rank attribution + suspicion report.  Poll it
+        periodically: rolling statistics need more than one observation."""
+        from .straggler import StragglerMonitor
+
+        if self._straggler is None:
+            self._straggler = StragglerMonitor()
+        mon = self._straggler
+        expected = {rank for rank, _ in self.targets_fn()}
+        traces, terrs = self.scrape("/trace")
+        for rank in sorted(traces):
+            try:
+                mon.consume_chrome(rank, json.loads(traces[rank]))
+            except ValueError:
+                terrs[rank] = "invalid trace JSON"
+        metrics, _ = self.scrape("/metrics")
+        for rank, text in metrics.items():
+            mon.consume_metrics(rank, text)
+        return mon.report(ranks_expected=expected, scrape_errors=terrs)
+
     def rank_status(self) -> Dict[str, Any]:
         targets = self.targets_fn()
         bodies, errors = self.scrape("/metrics")
@@ -309,3 +361,4 @@ class FleetAggregator:
         self._srv.server_close()
         if self._thread.is_alive():
             self._thread.join(timeout=5)
+        self._pool.shutdown(wait=False)

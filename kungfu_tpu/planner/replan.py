@@ -13,7 +13,12 @@ installed plan:
                 the step metrics;
   gns           the gradient-noise-scale metric crossing its threshold
                 band (same hysteresis shape as CompressionPolicy: replan
-                on regime *change*, not on every step in the regime).
+                on regime *change*, not on every step in the regime);
+  straggler     the straggler observatory flagged a slow rank or hot link
+                (a truthy `straggler` key in the step metrics, or a
+                `straggler_fn` such as `StragglerPolicy.any_flagged`) —
+                the graded response's re-plan rung: route collectives
+                around the degradation before the healer has to act.
 
 Re-planning runs the full pipeline (probe-refresh -> search -> measured
 runoff -> install -> cache) via `Planner.replan`, so a mid-training
@@ -45,20 +50,24 @@ class ReplanPolicy(BasePolicy):
       interference: an InterferenceDetector whose local_vote() arms the
         interference trigger (optional; a truthy "interference" metrics
         key works too).
+      straggler_fn: zero-arg callable; truthy arms the straggler trigger
+        (e.g. `StragglerPolicy.any_flagged`; a truthy "straggler" metrics
+        key works too).
       cooldown_steps: minimum steps between replans.
     """
 
     def __init__(self, planner, payload_bytes: int = 4 << 20,
                  gns_threshold: Optional[float] = None,
                  hysteresis: float = 0.5, metric: str = "noise_scale",
-                 interference=None, cooldown_steps: int = 20,
-                 reps: int = 3):
+                 interference=None, straggler_fn=None,
+                 cooldown_steps: int = 20, reps: int = 3):
         self.planner = planner
         self.payload_bytes = int(payload_bytes)
         self.gns_threshold = gns_threshold
         self.hysteresis = float(hysteresis)
         self.metric = metric
         self.interference = interference
+        self.straggler_fn = straggler_fn
         self.cooldown_steps = int(cooldown_steps)
         self.reps = int(reps)
         self.replans = 0
@@ -94,6 +103,10 @@ class ReplanPolicy(BasePolicy):
             return "interference"
         if self.interference is not None and self.interference.local_vote():
             return "interference"
+        if metrics and metrics.get("straggler"):
+            return "straggler"
+        if self.straggler_fn is not None and self.straggler_fn():
+            return "straggler"
         if self._gns_trigger(metrics):
             return "gns"
         return None

@@ -106,6 +106,105 @@ class CompressionPolicy(BasePolicy):
             self.switch(target)
 
 
+class StragglerPolicy(BasePolicy):
+    """Graded slow-rank response driven by the straggler observatory.
+
+    The detector (kungfu_tpu.monitor.straggler) only *observes*; this policy
+    feeds its signal back into adaptation, graded so the cheap response runs
+    first and nothing escalates on a blip:
+
+      grade 0  suspicion: the fleet detector journals `straggler_suspected`
+               and exposes gauges — no training impact, this policy just
+               tracks `flagged_ranks` (readable via `any_flagged`, e.g. as
+               `ReplanPolicy(straggler_fn=policy.any_flagged)`).
+      grade 1  sustained straggler (`sustain` consecutive polls): call the
+               `replan` callback with reason "straggler" — typically
+               `lambda reason: planner.replan(reason)` so the plan compiler
+               routes collectives around the hot link/rank.  Journaled as
+               `straggler_response`, cooldown-guarded.
+      grade 2  input starvation: call `on_starvation(ranks)` on the
+               transition (grow loader threads, re-shard the input, page
+               the operator) — starvation is a host problem no collective
+               re-plan can fix.
+
+    The healer holds the *last* rung: `kungfu-run -heal` now distinguishes
+    slow-but-alive from hung (journal `worker_slow` vs `stall_kill`,
+    docs/fault_tolerance.md), so a rank this policy is still reasoning
+    about is not summarily killed.
+
+    Args:
+      report_fn: zero-arg callable returning a /stragglers report dict —
+        e.g. ``lambda: monitor.straggler.fetch_report(url)`` against the
+        fleet aggregator, or a local `StragglerMonitor.report` bound method.
+      replan: callable(reason) for the grade-1 response (optional).
+      on_starvation: callable(ranks) for the grade-2 response (optional).
+      poll_every: steps between report polls (a fleet HTTP fetch is not a
+        per-step cost).
+      sustain: consecutive flagged polls before grade 1 fires.
+      cooldown_steps: minimum steps between grade-1 responses.
+    """
+
+    def __init__(self, report_fn, replan=None, on_starvation=None,
+                 poll_every: int = 10, sustain: int = 3,
+                 cooldown_steps: int = 100):
+        self.report_fn = report_fn
+        self.replan = replan
+        self.on_starvation = on_starvation
+        self.poll_every = max(1, int(poll_every))
+        self.sustain = int(sustain)
+        self.cooldown_steps = int(cooldown_steps)
+        self.flagged_ranks: set = set()
+        self.starved_ranks: set = set()
+        self.responses = 0
+        self._sustained: Dict[int, int] = {}
+        self._since_response = self.cooldown_steps
+        self._step = 0
+
+    def any_flagged(self) -> bool:
+        """Truthy when any rank is currently suspected — the ready-made
+        `straggler_fn` for `kungfu_tpu.planner.ReplanPolicy`."""
+        return bool(self.flagged_ranks)
+
+    def after_step(self, metrics: Optional[Dict[str, Any]] = None) -> None:
+        self._step += 1
+        self._since_response += 1
+        if self._step % self.poll_every:
+            return
+        try:
+            report = self.report_fn()
+        except OSError as e:
+            # an unreachable aggregator must not degrade training; anything
+            # non-IO propagates so PolicyRunner journals a policy_error
+            log.warning("straggler report fetch failed: %s", e)
+            return
+        if not isinstance(report, dict):
+            return
+        suspected = {int(r) for r in report.get("suspected") or ()}
+        self.flagged_ranks = suspected
+        for r in list(self._sustained):
+            if r not in suspected:
+                del self._sustained[r]
+        for r in suspected:
+            self._sustained[r] = self._sustained.get(r, 0) + 1
+        sustained = sorted(r for r, c in self._sustained.items()
+                           if c >= self.sustain)
+        if (sustained and self.replan is not None
+                and self._since_response >= self.cooldown_steps):
+            self._since_response = 0
+            self.responses += 1
+            from .monitor.journal import journal_event
+
+            journal_event("straggler_response", grade="replan",
+                          ranks=sustained, step=self._step)
+            log.warning("straggler response #%d: replan around rank(s) %s",
+                        self.responses, sustained)
+            self.replan("straggler")
+        starved = {int(r) for r in report.get("input_starved") or ()}
+        if starved - self.starved_ranks and self.on_starvation is not None:
+            self.on_starvation(sorted(starved))
+        self.starved_ranks = starved
+
+
 class PolicyRunner:
     """Drives policies and the named progress variables (policy_hook.py:8-80).
 

@@ -197,6 +197,11 @@ class WatchRunner:
         self._last_rc = 0
         self._healed_to_zero = False
         self._hb_amnesty_until = 0.0  # no staleness kills before this time
+        # graded stall judgment (docs/fault_tolerance.md): peer -> (mtime
+        # when first seen past the timeout, monotonic time of that sight).
+        # A stale-but-ADVANCING heartbeat is slow-but-alive, not hung.
+        self._stale_seen: Dict[PeerID, tuple] = {}
+        self._slow_journaled_at: Dict[PeerID, float] = {}
 
     def _spawn(self, peer: PeerID, cluster: Cluster, version: int) -> None:
         chip = self.pool.get() if self.pool else -1
@@ -216,6 +221,8 @@ class WatchRunner:
 
     def _kill(self, peer: PeerID) -> None:
         r = self.current.pop(peer, None)
+        self._stale_seen.pop(peer, None)
+        self._slow_journaled_at.pop(peer, None)
         if r is not None:
             r.terminate()
             if self.pool:
@@ -237,16 +244,25 @@ class WatchRunner:
             self._healed_to_zero = False  # an operator/regrow PUT revived the job
 
     def _stalest_worker(self):
-        """(age, peer, runner) for the most-stale running worker past the
-        heartbeat timeout, or None.
+        """(age, peer, runner) for the most-stale *frozen* worker, or None.
 
         A hung rank wedges its peers too (they block in the collective
         waiting for it), but THEIR stall watchdogs keep their heartbeat
         files fresh — only the truly wedged worker (no monitored op running,
-        chaos `hang@...`) goes stale.  The healer still kills only ONE
-        worker per sweep, stalest first, and then grants an amnesty window:
-        killing the hung rank frees the others into recovery, and they must
-        get a full timeout to rendezvous before staleness is re-judged.
+        chaos `hang@...`) goes stale.
+
+        The judgment is GRADED, not binary alive/hung: a heartbeat past the
+        timeout whose mtime is still ADVANCING between sweeps belongs to a
+        slow-but-alive worker — journaled `worker_slow` (the straggler
+        observatory's business, and the detector fingers it long before
+        this path triggers) and never killed.  Only a heartbeat frozen at
+        the SAME mtime for a further full timeout is judged hung — so a
+        genuinely frozen worker dies at ~2x the timeout, and a rank that is
+        merely 10x slower than its peers survives to be diagnosed.  The
+        healer still kills only ONE worker per sweep, stalest first, and
+        then grants an amnesty window: killing the hung rank frees the
+        others into recovery, and they must get a full timeout to
+        rendezvous before staleness is re-judged.
         """
         if not (self.heal and self.heartbeat_timeout_s > 0):
             return None
@@ -260,10 +276,33 @@ class WatchRunner:
             if not hb:
                 continue
             try:
-                age = time.time() - os.path.getmtime(hb)
+                mtime = os.path.getmtime(hb)
             except OSError:
                 continue  # pre-touched at spawn; missing means already healed
-            if age > self.heartbeat_timeout_s and (worst is None or age > worst[0]):
+            age = time.time() - mtime
+            if age <= self.heartbeat_timeout_s:
+                self._stale_seen.pop(peer, None)
+                continue
+            seen = self._stale_seen.get(peer)
+            if seen is None or seen[0] != mtime:
+                # stale, but the heartbeat moved since the last judgment:
+                # slow-but-alive — record the new mtime and give it a full
+                # further timeout to advance again before calling it hung
+                self._stale_seen[peer] = (mtime, time.monotonic())
+                now = time.monotonic()
+                if now - self._slow_journaled_at.get(peer, -1e9) > self.heartbeat_timeout_s:
+                    self._slow_journaled_at[peer] = now
+                    log.warning("worker %s heartbeat stale %.1fs but advancing"
+                                " — slow-but-alive, not killing", peer, age)
+                    global_counters().inc_event("workers_slow")
+                    journal_event("worker_slow", peer=str(peer),
+                                  age_s=round(age, 1),
+                                  timeout_s=self.heartbeat_timeout_s)
+                continue
+            frozen_for = time.monotonic() - seen[1]
+            if frozen_for < self.heartbeat_timeout_s:
+                continue  # same mtime, but not frozen long enough yet
+            if worst is None or age > worst[0]:
                 worst = (age, peer, r)
         return worst
 

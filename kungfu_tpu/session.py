@@ -352,13 +352,18 @@ class Session:
         span_args = None
         if T.enabled():
             # per-collective latency attribution (the fused-op papers'
-            # motivating view): op + impl/strategy + payload on every span
+            # motivating view): op + impl/strategy + payload on every span,
+            # plus the pre-collective ARRIVAL stamp — fleet-side merging of
+            # t_arrive across ranks yields per-rank arrival skew per
+            # collective, separating "this rank computes slowly" from "this
+            # rank waits on a slow peer or link" (monitor.straggler)
             cfg = kw.get("compression")
             span_args = {
                 "kind": kind, "op": op,
                 "impl": self._impl(strategy).name,
                 "strategy": (strategy if strategy is not None else self.strategy).name,
                 "bytes": int(nbytes), "dtype": str(jnp.asarray(x).dtype),
+                "t_arrive": round(T.job_now(), 6),
             }
             if cfg is not None and getattr(cfg, "scheme", None) != "none":
                 # CompressionConfig and per-leg AxisConfig both describe()
@@ -512,7 +517,8 @@ class Session:
         span = T.trace_scope(
             f"collective:{gname}", cat="collective",
             args={"kind": "group_all_reduce", "op": op, "impl": impl.name,
-                  "tensors": len(xs), "fuse": bool(fuse)} if T.enabled() else None,
+                  "tensors": len(xs), "fuse": bool(fuse),
+                  "t_arrive": round(T.job_now(), 6)} if T.enabled() else None,
         )
         with stall_detector(gname), span:
             if fuse and len(xs) > 1:

@@ -44,7 +44,9 @@ log = get_logger("kungfu.trace")
 ENABLE_ENV = "KFT_CONFIG_ENABLE_TRACE"
 BUFFER_CAPACITY_ENV = "KFT_TRACE_BUFFER"  # ring capacity, spans
 DUMP_DIR_ENV = "KFT_TRACE_DUMP_DIR"  # dump the buffer here at process exit
+FLUSH_EVERY_ENV = "KFT_TRACE_FLUSH_S"  # incremental flush period (0 = off)
 DEFAULT_CAPACITY = 8192
+DEFAULT_FLUSH_S = 10.0
 
 # wall/monotonic anchor pair, stamped once at import (reference
 # _utils.py:33-50: the launcher stamps KFT_JOB_START; each worker stamps its
@@ -193,19 +195,66 @@ def _dump_identity() -> str:
     return f"pid{os.getpid()}"
 
 
-def _dump_at_exit() -> None:  # pragma: no cover - exercised in subprocess drills
+def flush_dump(reason: str = "manual") -> Optional[str]:
+    """Write the span ring to KFT_TRACE_DUMP_DIR *now*, atomically.
+
+    Crash durability: the exit-time dump never runs for a rank that dies by
+    SIGKILL or `os._exit` (stall kill, chaos crash, OOM), so its lane used
+    to vanish from post-mortem timelines.  The periodic flush thread (and
+    the SIGTERM/preemption path) call this instead — tmp-file + rename, so
+    a kill mid-write leaves the previous complete dump, never a torn one.
+    Returns the written path, or None (not configured / empty / IO error —
+    a flush must never take the process down)."""
     d = os.environ.get(DUMP_DIR_ENV)
     buf = _global_buffer
     if not d or buf is None or len(buf) == 0:
-        return
+        return None
     try:
         os.makedirs(d, exist_ok=True)
         path = os.path.join(d, f"trace-{_dump_identity()}.json")
-        with open(path, "w") as f:
+        tmp = f"{path}.tmp{os.getpid()}"
+        with open(tmp, "w") as f:
             json.dump(export_chrome_trace(buf, process_name=_dump_identity()), f)
-        log.info("trace buffer dumped to %s (%d spans)", path, len(buf))
+        os.replace(tmp, path)
+        log.info("trace buffer flushed to %s (%d spans, %s)",
+                 path, len(buf), reason)
+        return path
     except OSError as e:
-        log.warning("trace dump failed: %s", e)
+        log.warning("trace flush (%s) failed: %s", reason, e)
+        return None
+
+
+def _dump_at_exit() -> None:  # pragma: no cover - exercised in subprocess drills
+    flush_dump("exit")
+
+
+def _flush_interval_s() -> float:
+    try:
+        v = os.environ.get(FLUSH_EVERY_ENV, "")
+        return max(0.0, float(v)) if v else DEFAULT_FLUSH_S
+    except ValueError:
+        return DEFAULT_FLUSH_S
+
+
+_flush_thread: Optional[threading.Thread] = None
+
+
+def _start_flush_thread() -> None:
+    """Daemon flusher so a crashed rank's lane is at most one interval
+    stale in the dump dir.  Started once, only when a dump dir is set."""
+    global _flush_thread
+    interval = _flush_interval_s()
+    if interval <= 0 or _flush_thread is not None:
+        return
+
+    def loop() -> None:  # pragma: no cover - timing loop; flush_dump is tested
+        while True:
+            time.sleep(interval)
+            flush_dump("periodic")
+
+    _flush_thread = threading.Thread(target=loop, daemon=True,
+                                     name="kft-trace-flush")
+    _flush_thread.start()
 
 
 def global_trace_buffer() -> TraceBuffer:
@@ -219,6 +268,7 @@ def global_trace_buffer() -> TraceBuffer:
                     import atexit
 
                     atexit.register(_dump_at_exit)
+                    _start_flush_thread()
     return _global_buffer
 
 

@@ -75,6 +75,14 @@ echo "== serving smoke: rank kill + buddy rejoin + autoscale drill (CPU) =="
 # scale-up both commit through the config server (docs/serving.md)
 JAX_PLATFORMS=cpu python -m kungfu_tpu.chaos --serve-drill --timeout 300
 
+echo "== straggler drill: slow rank fingered, not killed (CPU) =="
+# a slow@-injected rank (per-step sleep > heartbeat timeout) must be
+# flagged by the fleet /stragglers detector (journal straggler_suspected
+# with the right rank, zero false positives on clean ranks) BEFORE the
+# stall deadline, while the healer's graded judgment journals worker_slow
+# instead of killing it — the job finishes at full size
+JAX_PLATFORMS=cpu python -m kungfu_tpu.chaos --straggler-drill --timeout 240
+
 echo "== telemetry smoke: fleet aggregation + merged timeline (CPU) =="
 # 2-process run under -telemetry: fleet /metrics must merge both ranks
 # with consistent counter sums, /timeline must parse as valid Chrome trace

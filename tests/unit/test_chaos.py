@@ -118,6 +118,26 @@ class TestChaosInjector:
             inj.on_step(step, 0)
         assert sleeps == [0.03, 0.03]
 
+    def test_slow_window_journaled_once(self, tmp_path, monkeypatch):
+        """Slow-window entry stamps ONE chaos_slow event (the straggler
+        drill's detection-latency anchor), then keeps sleeping silently."""
+        from kungfu_tpu.monitor import journal as J
+
+        jpath = str(tmp_path / "j.jsonl")
+        monkeypatch.setenv(J.JOURNAL_FILE_ENV, jpath)
+        J._reset_for_tests()
+        try:
+            inj, _, sleeps = self._injector("slow@step=2:rank=0:ms=40:steps=3")
+            for step in range(6):
+                inj.on_step(step, 0)
+            assert len(sleeps) == 3
+            events = [e for e in J.read_journal(jpath)
+                      if e["event"] == "chaos_slow"]
+            assert len(events) == 1
+            assert events[0]["step"] == 2 and events[0]["ms"] == 40.0
+        finally:
+            J._reset_for_tests()
+
 
 class TestServerChaos:
     def test_deterministic_outage_window(self):
@@ -386,6 +406,13 @@ class TestHealer:
             runner.current = {
                 peers[0]: _fake_runner(fresh), peers[1]: _fake_runner(stale)
             }
+            # graded judgment: the FIRST stale sighting only records the
+            # mtime (slow-but-alive until proven frozen) — no kill yet
+            assert runner._stalest_worker() is None
+            assert peers[1] in runner._stale_seen
+            # same mtime, frozen past a further full timeout: now hung
+            m = os.path.getmtime(stale)
+            runner._stale_seen[peers[1]] = (m, time.monotonic() - 6.0)
             got = runner._stalest_worker()
             assert got is not None and got[1] == peers[1]
             # amnesty suppresses staleness judgements entirely
@@ -393,6 +420,44 @@ class TestHealer:
             assert runner._stalest_worker() is None
         finally:
             srv.stop()
+
+    def test_slow_but_alive_worker_not_killed(self, tmp_path, monkeypatch):
+        """A stale heartbeat whose mtime ADVANCES between sweeps is a slow
+        worker, not a hung one: journaled worker_slow, never a kill
+        candidate — the straggler observatory's graded-stall contract."""
+        from kungfu_tpu.monitor import journal as J
+
+        jpath = str(tmp_path / "journal.jsonl")
+        monkeypatch.setenv(J.JOURNAL_FILE_ENV, jpath)
+        J._reset_for_tests()
+        srv, client = self._server(2)
+        try:
+            runner = _watch_runner(client, heartbeat_timeout_s=5.0)
+            hb = str(tmp_path / "hb")
+            with open(hb, "w"):
+                pass
+            old = time.time() - 8
+            os.utime(hb, (old, old))
+            peers = tuple(_cluster(2).workers)
+            runner.current = {peers[0]: _fake_runner(hb)}
+            assert runner._stalest_worker() is None  # first sighting
+            # the slow worker makes progress: mtime advances but stays
+            # past the timeout — still stale, still alive
+            old = time.time() - 7
+            os.utime(hb, (old, old))
+            assert runner._stalest_worker() is None
+            assert runner._stalest_worker() is None  # progress resets freeze
+            events = [e["event"] for e in J.read_journal(jpath)]
+            assert "worker_slow" in events
+            # recovery clears the stale bookkeeping entirely
+            with open(hb, "w"):
+                pass
+            os.utime(hb, None)
+            assert runner._stalest_worker() is None
+            assert peers[0] not in runner._stale_seen
+        finally:
+            srv.stop()
+            J._reset_for_tests()
 
     def test_no_heartbeat_config_means_no_staleness(self):
         srv, client = self._server(2)
