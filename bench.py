@@ -777,6 +777,49 @@ def _measure_step_attribution():
     }
 
 
+def _measure_pallas():
+    """The BENCH json's "pallas_collectives" section (ROADMAP item 1's
+    success metric): the xla-vs-pallas-vs-pallas_fused `step_ms` /
+    `collective_latency_ms` p50 A/B and the FSDP-transformer
+    `overlap_bucket_bytes` sweep, measured by `--bench pallas` through the
+    measurement-resilient runner — probed before it starts, requeued on
+    failure, stamped with an honest `measured_this_run`, and each A/B row
+    stamped with the EFFECTIVE impl (off-TPU the pallas arms report the
+    engaged fallback, never a fake kernel number).  Opt out with
+    KFT_BENCH_SKIP_PALLAS=1."""
+    if os.environ.get("KFT_BENCH_SKIP_PALLAS"):
+        return None
+
+    import tempfile
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    try:
+        from kungfu_tpu.benchmarks import runner as bench_runner
+
+        with tempfile.NamedTemporaryFile(suffix=".json") as f:
+            rec = bench_runner.run_section(
+                bench_runner.Section(
+                    name="pallas_collectives",
+                    argv=[sys.executable, "-m", "kungfu_tpu.benchmarks",
+                          "--bench", "pallas", "--size", "262144",
+                          "--steps", "6", "--out", f.name],
+                    out_json=f.name, timeout_s=420.0, cwd=repo,
+                ),
+                probe_timeout_s=60.0, retries=1, interval_s=2.0,
+            )
+    except Exception:  # never let the A/B probe sink the headline
+        return None
+    if not rec.get("measured_this_run"):
+        return {"measured_this_run": False, "error": rec.get("error")}
+    return {
+        "measured_this_run": True,
+        "impl_ab": rec.get("impl_ab"),
+        "overlap_bucket_bytes": rec.get("overlap_bucket_bytes"),
+        "pallas_speedup_vs_xla": rec.get("pallas_speedup_vs_xla"),
+        "pallas_fallback_engaged": rec.get("pallas_fallback_engaged"),
+    }
+
+
 def _measure_planner():
     """The BENCH json's "planner" section: the collective plan compiler's
     per-bucket A/B (kungfu_tpu.planner) — chosen plan, predicted vs
@@ -933,6 +976,7 @@ def main():
     mttr_buddy_s, mttr_disk_s, journal_events = _measure_mttr_s()
     serving = _measure_serving()
     planner = _measure_planner()
+    pallas = _measure_pallas()
     step_attribution = _measure_step_attribution()
     lat_pcts = best.get("step_latency_pcts") or {}
 
@@ -1017,6 +1061,12 @@ def main():
                 # cost-model honesty) and the planner-vs-hand-tuned p50
                 # A/B; >= 1.0 worst speedup == the planner never loses
                 "planner": planner,
+                # hand-scheduled Pallas ring collectives (docs/pallas.md):
+                # xla vs pallas vs pallas_fused step_ms p50 A/B (each row
+                # stamped with the EFFECTIVE impl — off-TPU the pallas
+                # arms honestly report the engaged fallback) and the
+                # FSDP-transformer bucket_bytes overlap sweep
+                "pallas_collectives": pallas,
                 # straggler observatory (docs/observability.md): per-phase
                 # p50 step fractions (compute/data-wait/collective-wait)
                 # from a live 3-rank drill, plus slow-rank detection

@@ -47,6 +47,10 @@ def main():
     ap.add_argument("--compress", default=None,
                     help="dp-leg gradient wire format: int8 | int8-sr | fp8 "
                          "| bf16 (default: uncompressed)")
+    ap.add_argument("--bucket-bytes", type=int, default=0,
+                    help="bucket the dp-leg gradient sync: one collective "
+                         "per size bucket instead of one fused block "
+                         "(docs/pallas.md; 0 = single fused tree)")
     args = ap.parse_args()
 
     from kungfu_tpu.env import apply_platform_override
@@ -95,7 +99,8 @@ def main():
               f"({compress.compression_ratio(1 << 20):.2f}x fewer bytes)")
 
     trainer = FSDPTrainer(loss_fn, optax.adam(1e-3), mesh=mesh,
-                          compression=compress)
+                          compression=compress,
+                          bucket_bytes=args.bucket_bytes or None)
     state = trainer.init(params)
 
     # every param/moment leaf is chunked (n_fsdp, chunk) and sharded on dim 0

@@ -457,6 +457,16 @@ def builtin_programs() -> List[Program]:
         Program("session-group-fused", ("session", "bench"),
                 _b_session_group(),
                 "fused group allreduce (benchmark scaling arm)"),
+        Program("session-pallas-ring", ("session",),
+                _b_session("PALLAS_RING", {"dp": 8}, 1),
+                "hand-scheduled Pallas DMA ring (lints the program the "
+                "strategy selects here: the kernels on TPU, the lax-ring "
+                "fallback off it)"),
+        Program("session-pallas-ring-fused", ("session", "compression"),
+                _b_session("PALLAS_RING_FUSED", {"dp": 8}, 1,
+                           compression="int8"),
+                "Pallas ring with the int8 codec fused into the kernel "
+                "body (three-op XLA schedule off-TPU)"),
         # parallel schedules
         Program("pipeline-gpipe", ("parallel",), _b_pipeline(1),
                 "GPipe schedule over the pp ring"),

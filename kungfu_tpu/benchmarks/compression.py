@@ -110,12 +110,19 @@ def bench_compression(
 
     fp32 = next((r for r in results if r["scheme"] == "fp32"), None)
     int8 = next((r for r in results if r["scheme"] == "int8"), None)
+    # engine A/B: the same wire formats moved by the lax lowerings vs the
+    # hand-scheduled Pallas ring kernels (xla | pallas | pallas_fused),
+    # with honest effective-impl stamps when the off-TPU fallback engages
+    from .pallas import _bench_impl_ab
+
+    impl_ab = _bench_impl_ab(min(size, 1 << 20), steps, warmup)
     record = {
         "bench": "compression_allreduce",
         "backend": jax.default_backend(),
         "np": n,
         "elements": size,
         "results": results,
+        "impl_ab": impl_ab,
         # the headline the BENCH json keys on: int8 moves >= 3x fewer bytes
         "int8_vs_fp32_wire_ratio": (
             round(fp32["wire_bytes"] / int8["wire_bytes"], 3)

@@ -1,0 +1,191 @@
+"""Pallas-vs-XLA collective A/B + bucketed-overlap sweep (ROADMAP item 1).
+
+Two measurements the BENCH json keys on:
+
+  impl A/B   `step_ms` / `collective_latency_ms` p50 of one allreduce at a
+             fixed payload for xla (the lax ring), pallas (the
+             hand-scheduled DMA ring) and pallas_fused (in-kernel int8
+             codec).  Every row carries the EFFECTIVE impl that executed:
+             off-TPU the pallas rows honestly report the engaged fallback
+             ("xla") instead of pretending the kernels ran — on a TPU
+             slice the same bench becomes the real kernel-vs-XLA number.
+  overlap    a real FSDP-transformer train step swept over the dp-leg
+             `bucket_bytes` knob (fsdp.py): step_ms p50 per bucket size vs
+             the single fused tree (bucket_bytes=0).  On the CPU host this
+             measures the bucketing overhead floor; on TPU the overlap
+             win.
+
+    python -m kungfu_tpu.benchmarks --bench pallas [--size 1048576]
+"""
+from __future__ import annotations
+
+import json
+import statistics
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+DEFAULT_BUCKET_SWEEP = (0, 256 << 10, 1 << 20, 4 << 20)
+
+
+def _p50(times_ms: List[float]) -> float:
+    return statistics.median(times_ms)
+
+
+def _time_session_allreduce(sess, x, name: str, steps: int, warmup: int,
+                            **kw) -> List[float]:
+    for i in range(warmup):
+        sess.all_reduce(x, name=f"{name}:warm{i}", **kw)
+    times = []
+    for _ in range(steps):
+        t0 = time.perf_counter()
+        sess.all_reduce(x, name=name, **kw)
+        times.append((time.perf_counter() - t0) * 1e3)
+    return times
+
+
+def _bench_impl_ab(size: int, steps: int, warmup: int) -> List[Dict]:
+    import os
+
+    # arm the byte/latency counters so collective_latency_ms p50 lands in
+    # the record next to the wall-clock p50 (the PR-4 A/B instrumentation)
+    os.environ.setdefault("KFT_CONFIG_ENABLE_MONITORING", "1")
+    from ..monitor.counters import global_counters
+    from ..ops import pallas_collectives as PC
+    from ..plan import Strategy, make_mesh
+    from ..session import Session
+
+    arms = (
+        ("xla", Strategy.RING, None),
+        ("pallas", Strategy.PALLAS_RING, None),
+        ("pallas_fused", Strategy.PALLAS_RING_FUSED, "int8"),
+    )
+    mesh = make_mesh(dp=-1)
+    n = mesh.shape["dp"]
+    rng = np.random.RandomState(0)
+    v = rng.randn(size).astype(np.float32)
+    rows: List[Dict] = []
+    for impl, strategy, wire in arms:
+        sess = Session(mesh, strategy=strategy)
+        if wire is not None:
+            sess.set_compression(wire)
+        x = sess.lift(v)
+        label = f"pallas-ab:{impl}"
+        times = _time_session_allreduce(sess, x, label, steps, warmup)
+        effective = "xla" if impl == "xla" else PC.effective_impl(impl)
+        c = global_counters()
+        lat_p50 = c.hist_percentile("collective_latency_ms", 0.5, label=label)
+        rows.append({
+            "impl": impl,
+            "effective_impl": effective,
+            "fallback_engaged": impl != "xla" and effective == "xla",
+            "step_ms_p50": round(_p50(times), 3),
+            "collective_latency_ms_p50": (
+                round(lat_p50, 3) if lat_p50 is not None else None),
+            "elements": size,
+            "np": n,
+        })
+        print(
+            f"RESULT: bench=pallas arm={impl} effective={effective} np={n} "
+            f"payload={size * 4} B step_p50={rows[-1]['step_ms_p50']} ms",
+            flush=True,
+        )
+    return rows
+
+
+def _bench_overlap_sweep(bucket_sweep: Sequence[int], steps: int,
+                         warmup: int) -> List[Dict]:
+    """FSDP-transformer step_ms over the dp-leg bucket_bytes knob."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import Mesh
+
+    from ..fsdp import FSDPTrainer
+    from ..models.transformer import TransformerConfig, TransformerLM, lm_loss
+
+    devs = jax.devices()
+    if len(devs) >= 4:
+        dp, fsdp = 2, len(devs) // 2
+    else:
+        dp, fsdp = 1, len(devs)
+    if dp < 2:
+        # no dp axis -> no dp leg to bucket; the sweep is meaningless
+        return []
+    mesh = Mesh(np.array(devs[: dp * fsdp]).reshape(dp, fsdp), ("dp", "fsdp"))
+    cfg = TransformerConfig(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                            d_ff=256, max_len=32, dtype=jnp.float32)
+    model = TransformerLM(cfg)
+
+    def loss_fn(params, tokens):
+        return lm_loss(model.apply({"params": params}, tokens), tokens)
+
+    import flax.linen as nn
+
+    tokens0 = jnp.zeros((1, 32), jnp.int32)
+    params = nn.meta.unbox(
+        model.init(jax.random.PRNGKey(0), tokens0)["params"])
+    world = dp * fsdp
+    tokens = np.random.RandomState(0).randint(
+        0, cfg.vocab_size, size=(2 * world, 32)).astype(np.int32)
+
+    rows: List[Dict] = []
+    for bb in bucket_sweep:
+        trainer = FSDPTrainer(loss_fn, optax.adam(1e-3), mesh=mesh,
+                              bucket_bytes=bb or None)
+        state = trainer.init(params)
+        batch = trainer.shard_batch(tokens)
+        for _ in range(warmup):
+            state, _ = trainer.train_step(state, batch)
+        times = []
+        for _ in range(steps):
+            t0 = time.perf_counter()
+            state, m = trainer.train_step(state, batch)
+            jax.tree.map(lambda l: l.block_until_ready(),
+                         m["loss"])
+            times.append((time.perf_counter() - t0) * 1e3)
+        rows.append({
+            "bucket_bytes": int(bb),
+            "step_ms_p50": round(_p50(times), 3),
+            "dp": dp, "fsdp": fsdp,
+        })
+        print(
+            f"RESULT: bench=pallas sweep=overlap_bucket_bytes "
+            f"bucket_bytes={bb} step_p50={rows[-1]['step_ms_p50']} ms",
+            flush=True,
+        )
+    return rows
+
+
+def bench_pallas(
+    size: int = 1 << 20,
+    steps: int = 10,
+    warmup: int = 2,
+    bucket_sweep: Sequence[int] = DEFAULT_BUCKET_SWEEP,
+    out: Optional[str] = None,
+) -> Dict:
+    import jax
+
+    impl_ab = _bench_impl_ab(size, steps, warmup)
+    overlap = _bench_overlap_sweep(bucket_sweep, max(steps // 2, 3), warmup)
+    xla = next((r for r in impl_ab if r["impl"] == "xla"), None)
+    pal = next((r for r in impl_ab if r["impl"] == "pallas"), None)
+    record = {
+        "bench": "pallas_collectives",
+        "backend": jax.default_backend(),
+        "np": impl_ab[0]["np"] if impl_ab else None,
+        "impl_ab": impl_ab,
+        "overlap_bucket_bytes": overlap,
+        # the headline ratio; > 1.0 means the pallas path won.  Off-TPU the
+        # pallas arm is the engaged fallback, so ~1.0 is the honest answer
+        "pallas_speedup_vs_xla": (
+            round(xla["step_ms_p50"] / pal["step_ms_p50"], 3)
+            if xla and pal and pal["step_ms_p50"] > 0 else None),
+        "pallas_fallback_engaged": bool(pal and pal["fallback_engaged"]),
+    }
+    print(json.dumps(record), flush=True)
+    if out:
+        with open(out, "w") as f:
+            json.dump(record, f, indent=2)
+    return record

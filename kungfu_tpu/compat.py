@@ -16,6 +16,11 @@ of sprouting its own try/except.
   pcast       `lax.pcast` marks values varying across an axis for the new
               varying-manual-axes (vma) type system; old JAX has no vma
               types, so the cast is the identity there.
+
+It also hosts the runtime gate for the Pallas ring kernels (`pallas_mode`):
+compiled on TPU, interpreter under KFT_PALLAS=interpret (the CPU test
+path), and "off" everywhere else so callers fall back to the lax.*
+lowerings.
 """
 from __future__ import annotations
 
@@ -72,6 +77,37 @@ def axis_size(axis_name: AxisName) -> int:
             n *= _one_axis_size(a)
         return n
     return _one_axis_size(axis_name)
+
+
+def pallas_mode(interpret=None) -> str:
+    """How a Pallas collective kernel should run here: "compiled" |
+    "interpret" | "off".
+
+    The gate the hand-scheduled ring kernels (ops/pallas_collectives.py)
+    consult before building a pallas_call:
+
+      interpret=True   force the Pallas interpreter — the tier-1-testable
+                       path: kernel *semantics* (DMA schedule, in-kernel
+                       codec) run on CPU against the XLA lowerings.
+      interpret=False  force a compiled kernel (TPU only; caller's promise).
+      None             TPU backend -> "compiled"; otherwise KFT_PALLAS=
+                       interpret (or KFT_PALLAS_INTERPRET=1) -> "interpret",
+                       else "off" — callers fall back to the lax.* lowering,
+                       so every training path stays green off-TPU without
+                       paying the interpreter's per-op cost.
+    """
+    import os
+
+    if interpret is True:
+        return "interpret"
+    if interpret is False:
+        return "compiled"
+    if jax.default_backend() == "tpu":
+        return "compiled"
+    env = os.environ.get("KFT_PALLAS", "")
+    if env == "interpret" or os.environ.get("KFT_PALLAS_INTERPRET") == "1":
+        return "interpret"
+    return "off"
 
 
 def pcast(x, axis_name: AxisName, to: str = "varying"):

@@ -74,6 +74,16 @@ class FSDPTrainer:
              hop while fsdp rides ICI — so this compresses exactly the slow
              leg and leaves the reduce_scatter/all_gather fsdp traffic in
              full precision.  Ignored when the mesh has no dp axis.
+      bucket_bytes: chunk the dp-leg gradient reduction into size-bucketed
+             groups (optimizers/sync.py's packing), one collective per
+             bucket over a flat buffer, instead of the per-leaf stream
+             XLA's combiner fuses into a single block behind the last
+             gradient — independent buckets are what the latency-hiding
+             scheduler / Pallas ring kernels can overlap with the rest of
+             the step.  Element-wise (uncompressed) reduction is
+             numerically identical bucketed or not; a quantized dp wire
+             re-aligns its block boundaries to the bucket buffer (within
+             the documented error bound).  Ignored without a dp axis.
       analyze: arm the kf-lint trace-time hook (kungfu_tpu.analysis): the
              compiled step is statically checked at its first train_step,
              raising AnalysisError before dispatch on error-severity
@@ -89,6 +99,7 @@ class FSDPTrainer:
         donate: bool = True,
         compression=None,
         analyze: Optional[bool] = None,
+        bucket_bytes: Optional[int] = None,
     ):
         from . import compression as _compression_mod
         from .utils.envflag import analyze_enabled
@@ -105,6 +116,7 @@ class FSDPTrainer:
         self.compression = (
             _compression_mod.resolve(compression) if compression is not None else None
         )
+        self.bucket_bytes = int(bucket_bytes) if bucket_bytes else None
         self._donate = donate
         self.loss_fn = loss_fn
         self.tx = tx
@@ -194,13 +206,29 @@ class FSDPTrainer:
             )
 
         def dp_mean(g):
-            if not self.has_dp:
-                return g
             if self.compression is not None:
                 from . import compression as Comp
 
                 return Comp.all_reduce(g, "dp", self.compression, op="mean")
             return lax.pmean(g, "dp")
+
+        def dp_reduce(grads):
+            """Cross-replica mean of the (already reduce_scattered) chunk
+            grads: per-leaf by default, one collective per size bucket
+            with bucket_bytes — the dp-leg overlap knob."""
+            if not self.has_dp:
+                return grads
+            if not self.bucket_bytes:
+                return jax.tree.map(dp_mean, grads)
+            from .optimizers.sync import (
+                _bucketed_reduce, _pack_buckets, _record_bucket_layout,
+            )
+
+            leaves, treedef = jax.tree.flatten(grads)
+            buckets = _pack_buckets(leaves, self.bucket_bytes)
+            _record_bucket_layout(leaves, buckets)
+            return jax.tree.unflatten(treedef, _bucketed_reduce(
+                leaves, buckets, lambda flat, _bi: dp_mean(flat)))
 
         def step(params, opt_state, batch):
             chunks = jax.tree.map(lambda c: jnp.squeeze(c, 0), params)
@@ -211,7 +239,7 @@ class FSDPTrainer:
 
             f = jax.checkpoint(compute_loss) if self.remat else compute_loss
             loss, grads = jax.value_and_grad(f)(chunks, batch)
-            grads = jax.tree.map(lambda g: dp_mean(g / n_shard), grads)
+            grads = dp_reduce(jax.tree.map(lambda g: g / n_shard, grads))
             updates, opt_state = self.tx.update(grads, opt_state, chunks)
             chunks = optax.apply_updates(chunks, updates)
             loss = lax.pmean(loss, self.data_axes)

@@ -196,6 +196,17 @@ class Counters:
         with self._lock:
             self._events[key] = self._events.get(key, 0) + n
 
+    def record_collective_impl(self, impl: str) -> None:
+        """Count one dispatched collective by the engine that moved its
+        bytes: "xla" | "pallas" | "pallas_fused" (fallback-aware — the
+        Session records what actually executed).  Exposed as
+        kungfu_events_total{event="collective_impl_<impl>"} so a fleet
+        scrape attributes traffic between the XLA lowerings and the
+        hand-scheduled Pallas ring kernels for free; the per-bucket
+        `collective_overlap` histogram (observe_hist) carries the
+        bucketed gradient-sync layout next to it."""
+        self.inc_event(f"collective_impl_{impl}")
+
     def set_gauge(self, key: str, value: float) -> None:
         """Record the last observed value of a named gauge (e.g. heal MTTR)."""
         with self._lock:

@@ -5,12 +5,30 @@ The reference wraps a TF optimizer and splices collective ops into
 apply_gradients; here each algorithm is an `optax.GradientTransformation`
 meant to run *inside* a shard_map/pjit train step with a data-parallel mesh
 axis in scope — the collectives compile into the step program, so there is
-no scheduler, no op ordering problem, and XLA overlaps them with compute
-(replacing the entire NCCL scheduler, srcs/cpp/src/nccl/scheduler.cpp).
+no scheduler and no op ordering problem (replacing the entire NCCL
+scheduler, srcs/cpp/src/nccl/scheduler.cpp).
+
+The real scheduling story (an earlier docstring claimed "XLA overlaps them
+with compute" unconditionally — it does not): the per-leaf tree-map below
+emits one collective per gradient leaf and XLA's all-reduce *combiner*
+merges them into essentially ONE fused block scheduled after the last
+gradient is produced — all communication serializes behind the end of
+backprop.  `bucket_bytes` changes that: the gradient pytree is chunked
+into size-bucketed groups (leaves packed in traversal order, per dtype)
+and each bucket is reduced by its OWN collective over one flat buffer.
+Independent collectives are exactly what XLA's latency-hiding scheduler
+needs to hoist a bucket's AllReduce over compute that doesn't depend on
+it — the fused computation-collective-ops placement (arXiv 2305.06942) —
+and what the Pallas ring kernels (ops/pallas_collectives.py) need to
+stream bucket k's DMA while bucket k+1 is still being produced.  Bucketed
+and unbucketed reductions are numerically identical for the default pmean
+path (element-wise mean is layout-independent); bucket layouts land in
+the `collective_overlap` telemetry histogram at trace time.
 
 Composition follows optax convention:
 
-    tx = synchronous_sgd(optax.sgd(0.1), axis_name="dp")
+    tx = synchronous_sgd(optax.sgd(0.1), axis_name="dp",
+                         bucket_bytes=4 << 20)
     # inside shard_map over mesh axis "dp":
     updates, state = tx.update(local_grads, state, params)
 """
@@ -43,7 +61,8 @@ def _mean_reducer(axis_name: AxisName, impl: str):
 
     The runtime-strategy analog inside the compiled step (the Session handles
     host-level ops; this handles the in-step gradient path): "pmean" lets
-    XLA pick, "rs_ag"/"ring" force the phased/ring schedules, and
+    XLA pick, "rs_ag"/"ring" force the phased/ring schedules, "pallas_ring"
+    the hand-scheduled Pallas DMA ring (lax-ring fallback off-TPU), and
     "hierarchical" needs axis_name == (dcn, ici) — ici reduce-scatter, dcn
     psum, ici all-gather (ops/collective.py:115-135).
     """
@@ -62,11 +81,73 @@ def _mean_reducer(axis_name: AxisName, impl: str):
         return lambda g: C.hierarchical_all_reduce(g, ici, dcn) / world()
     if impl == "rs_ag":
         return lambda g: C.rs_ag_all_reduce(g, axis_name) / world()
-    if impl == "ring":
+    if impl in ("ring", "pallas_ring"):
         if isinstance(axis_name, (tuple, list)):
             raise ValueError("ring reduction needs a single axis")
+        if impl == "pallas_ring":
+            from ..ops import pallas_collectives as PC
+
+            return lambda g: PC.ring_all_reduce(g, axis_name, op="mean")
         return lambda g: C.ring_all_reduce(g, axis_name) / world()
     raise ValueError(f"unknown reduce impl {impl!r}")
+
+
+def _pack_buckets(leaves, bucket_bytes: int):
+    """Greedy in-traversal-order packing of leaf indices into size buckets.
+
+    A bucket holds consecutive same-dtype leaves totalling at most
+    `bucket_bytes` (one oversized leaf gets its own bucket) — preserving
+    order keeps bucketed/unbucketed reductions element-aligned.
+    """
+    buckets, cur, cur_bytes, cur_dtype = [], [], 0, None
+    for i, g in enumerate(leaves):
+        b = int(g.size) * jnp.dtype(g.dtype).itemsize
+        if cur and (g.dtype != cur_dtype or cur_bytes + b > bucket_bytes):
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += b
+        cur_dtype = g.dtype
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+def _bucketed_reduce(leaves, buckets, reduce_flat):
+    """Apply `reduce_flat(flat_1d, bucket_index)` over each bucket's
+    concatenated leaves; single-leaf buckets skip the concat/split copies.
+    Returns the reduced leaves in original order."""
+    out = [None] * len(leaves)
+    for bi, idxs in enumerate(buckets):
+        if len(idxs) == 1:
+            g = leaves[idxs[0]]
+            out[idxs[0]] = reduce_flat(g.reshape(-1), bi).reshape(g.shape)
+            continue
+        flat = jnp.concatenate([leaves[i].reshape(-1) for i in idxs])
+        red = reduce_flat(flat, bi)
+        off = 0
+        for i in idxs:
+            sz = int(leaves[i].size)
+            out[i] = red[off:off + sz].reshape(leaves[i].shape)
+            off += sz
+    return out
+
+
+def _record_bucket_layout(leaves, buckets) -> None:
+    """Trace-time telemetry: per-bucket payload MiB into the
+    `collective_overlap` histogram + a bucket-count gauge, so the PR-4
+    scrape shows the gradient-sync layout the compiled step runs with
+    (runs once per trace — host side effects do not retrace)."""
+    from ..monitor.counters import counters_if_enabled
+
+    c = counters_if_enabled()
+    if c is None:
+        return
+    c.set_gauge("grad_sync_buckets", len(buckets))
+    for idxs in buckets:
+        mib = sum(int(leaves[i].size) * jnp.dtype(leaves[i].dtype).itemsize
+                  for i in idxs) / float(1 << 20)
+        c.observe_hist("collective_overlap", mib, label="grad_sync_mib")
 
 
 def all_reduce_gradients(
@@ -75,6 +156,7 @@ def all_reduce_gradients(
     compression: Comp.AxisCompression = None,
     seed: int = 0,
     analyze: Optional[bool] = None,
+    bucket_bytes: Optional[int] = None,
 ) -> optax.GradientTransformation:
     """Gradient-averaging transform: the core of S-SGD (sync_sgd.py:81-112).
 
@@ -90,6 +172,19 @@ def all_reduce_gradients(
     leg.  Quantized configs with error_feedback=True keep an EF residual
     pytree in the transform state (error_feedback.py), so compression error
     re-enters the next step's gradients instead of being lost.
+
+    `bucket_bytes` chunks the gradient pytree into size-bucketed groups
+    (consecutive same-dtype leaves, at most bucket_bytes each) and reduces
+    each bucket with its OWN collective over one flat buffer, instead of
+    one per-leaf collective stream that XLA's combiner fuses into a single
+    block behind the last gradient.  Independent per-bucket collectives
+    are what the latency-hiding scheduler / Pallas DMA kernels can overlap
+    with the rest of the step (module docstring has the full scheduling
+    story).  Element-wise reductions (pmean, the default) are numerically
+    IDENTICAL bucketed or not; chunked schedules (ring/rs_ag) and block-
+    quantized wires re-align their chunk/block boundaries to the bucket
+    buffer, which reorders fp32 adds / block scales within the documented
+    error bounds.  None (default) keeps the single fused tree.
 
     `analyze` (or KUNGFU_ANALYZE=1) arms the kf-lint trace-time hook: at
     every trace of the update the declared axes are checked against the
@@ -119,12 +214,19 @@ def all_reduce_gradients(
         def update_fn(updates, state, params=None):
             del params
             _lint_scope()
+            if bucket_bytes:
+                leaves, treedef = jax.tree.flatten(updates)
+                buckets = _pack_buckets(leaves, int(bucket_bytes))
+                _record_bucket_layout(leaves, buckets)
+                reduced = _bucketed_reduce(
+                    leaves, buckets, lambda flat, _bi: reducer(flat))
+                return jax.tree.unflatten(treedef, reduced), state
             return jax.tree.map(reducer, updates), state
 
         return optax.GradientTransformation(init_fn, update_fn)
 
     return _compressed_all_reduce_gradients(axis_name, impl, compression,
-                                            seed, _lint_scope)
+                                            seed, _lint_scope, bucket_bytes)
 
 
 class CompressedGradState(NamedTuple):
@@ -156,6 +258,17 @@ def _compressed_reducer(axis_name: AxisName, impl: str,
     # flat axis (or axis tuple): one wire format for the whole reduction
     cfg = Comp.resolve_for_axis(compression, axis_name)
 
+    if impl == "pallas_ring" and not isinstance(axis_name, (tuple, list)):
+        from ..ops import pallas_collectives as PC
+
+        def reduce_leaf(g, key):
+            # codec fused into the ring kernel; PC falls back to the
+            # three-op XLA schedule (with the key) where it can't run
+            return PC.fused_ring_all_reduce(g, axis_name, cfg, op="mean",
+                                            key=key)
+
+        return reduce_leaf, cfg
+
     def reduce_leaf(g, key):
         return Comp.all_reduce(g, axis_name, cfg, op="mean", key=key)
 
@@ -164,7 +277,7 @@ def _compressed_reducer(axis_name: AxisName, impl: str,
 
 def _compressed_all_reduce_gradients(
     axis_name: AxisName, impl: str, compression: Comp.AxisCompression,
-    seed: int, lint_scope=lambda: None
+    seed: int, lint_scope=lambda: None, bucket_bytes: Optional[int] = None
 ) -> optax.GradientTransformation:
     reduce_leaf, local_cfg = _compressed_reducer(axis_name, impl, compression)
     use_ef = local_cfg.error_feedback and local_cfg.scheme != "none"
@@ -183,10 +296,18 @@ def _compressed_all_reduce_gradients(
             Comp.error_feedback.correct(updates, state.ef) if use_ef else updates
         )
         leaves, treedef = jax.tree.flatten(corrected)
-        keys = jax.random.split(sub, len(leaves) + 1)
-        reduced = jax.tree.unflatten(
-            treedef, [reduce_leaf(g, k) for g, k in zip(leaves, keys)]
-        )
+        if bucket_bytes:
+            buckets = _pack_buckets(leaves, int(bucket_bytes))
+            _record_bucket_layout(leaves, buckets)
+            keys = jax.random.split(sub, len(buckets) + 1)
+            reduced = jax.tree.unflatten(treedef, _bucketed_reduce(
+                leaves, buckets,
+                lambda flat, bi: reduce_leaf(flat, keys[bi])))
+        else:
+            keys = jax.random.split(sub, len(leaves) + 1)
+            reduced = jax.tree.unflatten(
+                treedef, [reduce_leaf(g, k) for g, k in zip(leaves, keys)]
+            )
         # keep the inner optimizer's expected dtype
         reduced = jax.tree.map(
             lambda r, u: r.astype(jnp.asarray(u).dtype), reduced, updates
@@ -207,19 +328,21 @@ def synchronous_sgd(
     impl: str = "pmean",
     compression: Comp.AxisCompression = None,
     analyze: Optional[bool] = None,
+    bucket_bytes: Optional[int] = None,
 ) -> optax.GradientTransformation:
     """SynchronousSGDOptimizer: average grads across the mesh, then `inner`.
 
     Reference semantics (optimizers/sync_sgd.py:15-112, Horovod-equivalent):
     every worker applies the same averaged gradient, so parameters stay
     bitwise identical across replicas.  `compression` selects the gradient
-    wire format (see all_reduce_gradients) — the reduced result is still
-    identical on every replica, so the invariant survives quantization.
+    wire format and `bucket_bytes` the bucketed-overlap sync layout (see
+    all_reduce_gradients) — the reduced result is still identical on every
+    replica, so the invariant survives quantization and bucketing.
     `analyze` (or KUNGFU_ANALYZE=1) arms the kf-lint trace-time checks.
     """
     return optax.chain(
         all_reduce_gradients(axis_name, impl=impl, compression=compression,
-                             analyze=analyze),
+                             analyze=analyze, bucket_bytes=bucket_bytes),
         inner,
     )
 

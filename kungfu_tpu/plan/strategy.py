@@ -14,6 +14,10 @@ collective is compiled, so "strategy" becomes *which lowering* we ask for:
   BINARY_TREE_STAR / MULTI_BINARY_TREE_STAR
                                   -> hierarchical two-level (ici axis then dcn
                                      axis), the GenBinaryTreeStar analog
+  PALLAS_RING / PALLAS_RING_FUSED -> hand-scheduled Pallas DMA ring kernels
+                                     (ops/pallas_collectives.py), the FUSED
+                                     variant with the int8/fp8 codec inside
+                                     the kernel; lax-ring fallback off-TPU
   AUTO                            -> single host: psum; multi host: hierarchical
                                      (reference strategy.go:165-174)
 
@@ -38,6 +42,11 @@ class Strategy(enum.Enum):
     BINARY_TREE = "BINARY_TREE"
     BINARY_TREE_STAR = "BINARY_TREE_STAR"  # reference default
     MULTI_BINARY_TREE_STAR = "MULTI_BINARY_TREE_STAR"
+    # hand-scheduled Pallas DMA ring kernels (ops/pallas_collectives.py);
+    # off-TPU they fall back to the lax ring, so installing them is always
+    # safe — the planner's measured runoff decides when they win
+    PALLAS_RING = "PALLAS_RING"
+    PALLAS_RING_FUSED = "PALLAS_RING_FUSED"  # in-kernel int8/fp8 codec
     AUTO = "AUTO"
 
     @classmethod
@@ -64,6 +73,8 @@ class Impl(enum.Enum):
     RS_AG = "reduce_scatter_all_gather"  # phased, bandwidth-optimal
     RING = "ring_ppermute"           # explicit ring, chunked
     HIERARCHICAL = "hierarchical"    # per-host then cross-host (ici x dcn)
+    PALLAS_RING = "pallas_ring"      # Pallas DMA ring (xla-ring fallback)
+    PALLAS_RING_FUSED = "pallas_ring_fused"  # + in-kernel codec
 
 
 _IMPL_OF = {
@@ -75,6 +86,8 @@ _IMPL_OF = {
     Strategy.RING: Impl.RING,
     Strategy.BINARY_TREE_STAR: Impl.HIERARCHICAL,
     Strategy.MULTI_BINARY_TREE_STAR: Impl.HIERARCHICAL,
+    Strategy.PALLAS_RING: Impl.PALLAS_RING,
+    Strategy.PALLAS_RING_FUSED: Impl.PALLAS_RING_FUSED,
 }
 
 
@@ -118,7 +131,9 @@ def strategy_graphs(
         ]
     if s is Strategy.CLIQUE:
         return G.gen_clique_graph_pairs(n)
-    if s is Strategy.RING:
+    if s in (Strategy.RING, Strategy.PALLAS_RING, Strategy.PALLAS_RING_FUSED):
+        # the Pallas kernels execute exactly the circular-pair routing, so
+        # they share RING's reference graphs for digests and kf-lint
         return [G.gen_circular_graph_pair(n, shift=k) for k in range(min(n, 4))]
     raise ValueError(f"unhandled strategy {s}")
 

@@ -3,10 +3,16 @@
 A `Plan` is one point in the planner's search space, per tensor-size
 bucket:
 
-  algorithm   ring | binary_tree | tree_star | hierarchical — each maps to
-              a Session `Strategy` (the installable knob) and to reference
-              reduce/bcast graphs (plan.strategy_graphs, host-aware) the
-              validity oracle checks;
+  algorithm   ring | binary_tree | tree_star | hierarchical | pallas_ring
+              | pallas_ring_fused — each maps to a Session `Strategy` (the
+              installable knob) and to reference reduce/bcast graphs
+              (plan.strategy_graphs, host-aware) the validity oracle
+              checks.  The pallas algorithms are the hand-scheduled DMA
+              ring kernels (ops/pallas_collectives.py): pallas_ring moves
+              full-precision chunks, pallas_ring_fused runs the int8/fp8
+              codec inside the kernel, and both fall back to the lax ring
+              off-TPU — so they are safe candidates everywhere and the
+              measured runoff (not a hand flag) decides when they install;
   wire        per-hop dtype: the ("ici", "dcn") legs independently pick a
               dense wire scheme (none/bf16/int8/fp8 — CompressionConfig
               registry names).  Single-leg topologies (a flat ring) carry
@@ -45,7 +51,13 @@ ALGORITHMS: Dict[str, Strategy] = {
     "binary_tree": Strategy.BINARY_TREE,
     "tree_star": Strategy.BINARY_TREE_STAR,
     "hierarchical": Strategy.MULTI_BINARY_TREE_STAR,
+    "pallas_ring": Strategy.PALLAS_RING,
+    "pallas_ring_fused": Strategy.PALLAS_RING_FUSED,
 }
+
+#: wire schemes the fused-codec kernel can express (pallas_ring_fused
+#: enumerates exactly these; bf16/none belong to plain pallas_ring)
+PALLAS_FUSED_SCHEMES = ("int8", "fp8")
 
 #: hidden algorithm id for the seeded-illegal candidate (never part of
 #: enumerate_plans output; the smoke drill injects it to prove the
@@ -199,7 +211,26 @@ def enumerate_plans(
     multi = len(live_hosts) > 1
     plans: List[Plan] = []
     for name, strat in ALGORITHMS.items():
-        if multi and name in ("tree_star", "hierarchical"):
+        if name in ("pallas_ring", "pallas_ring_fused"):
+            # flat-ring kernels: one leg on the link the ring crosses.
+            # pallas_ring is the full-precision (or bf16-cast) kernel;
+            # pallas_ring_fused carries exactly the in-kernel codec wires
+            leg = "dcn" if multi else "ici"
+            if name == "pallas_ring":
+                for s in ("none", "bf16"):
+                    if s in schemes:
+                        plans.append(Plan(
+                            algorithm=name, strategy_name=strat.name,
+                            wire=((leg, s),), bucket=bucket.id, world=world,
+                        ))
+            else:
+                for s in PALLAS_FUSED_SCHEMES:
+                    if s in schemes:
+                        plans.append(Plan(
+                            algorithm=name, strategy_name=strat.name,
+                            wire=((leg, s),), bucket=bucket.id, world=world,
+                        ))
+        elif multi and name in ("tree_star", "hierarchical"):
             for si in schemes:
                 for sd in schemes:
                     plans.append(Plan(

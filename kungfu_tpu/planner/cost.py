@@ -18,6 +18,16 @@ Formulas (n = world, h = hosts, m = largest per-host group, e = elements):
                on dcn
   hierarchical tree_star with rotated multi-root load spreading: the dcn
                payload further splits across h graphs
+  pallas_ring  the ring schedule hand-scheduled as one Pallas kernel pair:
+               the double-buffered DMA pipeline hides per-hop launch
+               latency, so α is paid ONCE per kernel instead of per round
+               — the α-discount that makes the pallas plans win exactly
+               where rings lose today (latency-bound buckets); β still
+               multiplies every round's wire bytes
+  pallas_ring_fused
+               pallas_ring over int8/fp8 codes + scales, with the codec
+               fused into the kernel (γ·logical once — same codec work,
+               none of the three-op XLA launch overhead)
 
 A compressed leg prices its *wire* bytes (CompressionConfig.wire_bytes)
 plus the fitted codec overhead γ·logical_bytes — so on fabrics where the
@@ -30,7 +40,7 @@ from typing import Sequence
 
 from ..compression import resolve
 from .candidates import Plan
-from .model import CostModel, rounds_tree as _rounds_tree
+from .model import MiB, CostModel, rounds_tree as _rounds_tree
 
 
 def predict_ms(
@@ -73,6 +83,15 @@ def predict_ms(
         return total
 
     cfg = resolve(plan.wire_scheme(flat_leg))
+    if plan.algorithm in ("pallas_ring", "pallas_ring_fused"):
+        steps = 2 * (n - 1)
+        link = model.link(flat_leg)
+        round_wire = cfg.wire_bytes(math.ceil(elems / n), 4)
+        # one kernel launch pays α once; the per-hop DMAs pipeline
+        total = link.alpha_ms + steps * link.beta_ms_per_mib * round_wire / MiB
+        if cfg.scheme != "none":
+            total += model.codec_ms(cfg.scheme, elems * 4)
+        return total
     if cfg.scheme != "none":
         # any compressed flat plan executes as the quantized RS->AG
         # schedule (Session._build), which is ring-shaped on the wire

@@ -193,9 +193,25 @@ class Session:
         impl = impl_of(s, self.host_count)
         if impl is Impl.HIERARCHICAL and self._hierarchical_axes is None:
             impl = Impl.RS_AG  # no ici/dcn split on this mesh
-        if impl is Impl.RING and len(self._axes) != 1:
+        if impl in (Impl.RING, Impl.PALLAS_RING, Impl.PALLAS_RING_FUSED) \
+                and len(self._axes) != 1:
             impl = Impl.RS_AG  # explicit ring needs a single data axis
         return impl
+
+    @staticmethod
+    def _impl_tag(impl: Impl, cfg=None) -> str:
+        """The collective_impl telemetry tag for spans + counters:
+        "pallas" / "pallas_fused" when the Pallas kernels will actually
+        run (compiled on TPU or forced interpreter), "xla" otherwise —
+        including when a pallas strategy is installed but the off-TPU
+        fallback engages, so A/B attribution never lies."""
+        if impl not in (Impl.PALLAS_RING, Impl.PALLAS_RING_FUSED):
+            return "xla"
+        from .ops import pallas_collectives as PC
+
+        fused = (impl is Impl.PALLAS_RING_FUSED
+                 and cfg is not None and getattr(cfg, "is_quantized", False))
+        return PC.effective_impl("pallas_fused" if fused else "pallas")
 
     # -- compiled collective builders -------------------------------------------------
 
@@ -216,6 +232,10 @@ class Session:
                 return C.hierarchical_all_reduce(y, "ici", "dcn", op)
             if impl is Impl.RING:
                 return C.ring_all_reduce(y, axes[0], op)
+            if impl in (Impl.PALLAS_RING, Impl.PALLAS_RING_FUSED):
+                from .ops import pallas_collectives as PC
+
+                return PC.ring_all_reduce(y, axes[0], op)
             if impl is Impl.RS_AG:
                 return C.rs_ag_all_reduce(y, axis, op)
             return C.all_reduce(y, axis, op)
@@ -245,7 +265,20 @@ class Session:
                         ici_config=ici_cfg, dcn_config=dcn_cfg, op=op,
                     )[None]
             elif cfg is not None and cfg.scheme != "none":
-                if self._hierarchical_axes is not None:
+                if impl in (Impl.PALLAS_RING, Impl.PALLAS_RING_FUSED):
+                    # compressed wire on a pallas ring: codec fused into
+                    # the kernel body (falls back to the three-op XLA
+                    # schedule off-TPU or for configs the kernel can't
+                    # express — sparse/stochastic/oversized)
+                    from .ops import pallas_collectives as PC
+
+                    axis_ = axes[0]
+
+                    def body(x):
+                        return PC.fused_ring_all_reduce(
+                            jnp.squeeze(x, 0), axis_, cfg, op=op
+                        )[None]
+                elif self._hierarchical_axes is not None:
                     # compress the slow DCN leg only (the EQuARX placement);
                     # ICI stays full precision
                     def body(x):
@@ -290,7 +323,11 @@ class Session:
         else:
             raise ValueError(kind)
 
-        return jax.jit(shard_map(body, self.mesh, in_specs=spec, out_specs=spec))
+        # pallas_call has no replication rule: those programs opt out of
+        # the rep/vma check (kf-lint still covers the fallback lowering)
+        check = False if impl in (Impl.PALLAS_RING, Impl.PALLAS_RING_FUSED) else None
+        return jax.jit(shard_map(body, self.mesh, in_specs=spec,
+                                 out_specs=spec, check_vma=check))
 
     # -- public collective API (reference session/{allreduce,allgather,session}.go) ---
 
@@ -349,6 +386,7 @@ class Session:
         from .utils import trace as T
 
         nbytes = jnp.asarray(x).nbytes
+        impl_tag = self._impl_tag(self._impl(strategy), kw.get("compression"))
         span_args = None
         if T.enabled():
             # per-collective latency attribution (the fused-op papers'
@@ -361,6 +399,10 @@ class Session:
             span_args = {
                 "kind": kind, "op": op,
                 "impl": self._impl(strategy).name,
+                # which engine actually moves the bytes: "xla" |
+                # "pallas" | "pallas_fused" (fallback-aware), the A/B
+                # attribution key for the pallas-vs-xla runoffs
+                "collective_impl": impl_tag,
                 "strategy": (strategy if strategy is not None else self.strategy).name,
                 "bytes": int(nbytes), "dtype": str(jnp.asarray(x).dtype),
                 "t_arrive": round(T.job_now(), 6),
@@ -380,6 +422,7 @@ class Session:
         if c is not None:
             c.add_egress(name or kind, nbytes)
             c.observe_hist("collective_latency_ms", dt * 1e3, label=name or kind)
+            c.record_collective_impl(impl_tag)
         return out
 
     def all_reduce(self, x, op: str = "sum", name: str = "", strategy=None,
@@ -472,12 +515,35 @@ class Session:
             return tuple(reduce_impl(jnp.squeeze(y, 0))[None] for y in ys)
 
         specs = tuple(spec for _ in signature)
-        fn = jax.jit(shard_map(body, self.mesh, in_specs=specs, out_specs=specs))
+        check = False if impl in (Impl.PALLAS_RING, Impl.PALLAS_RING_FUSED) else None
+        fn = jax.jit(shard_map(body, self.mesh, in_specs=specs,
+                               out_specs=specs, check_vma=check))
         self._fns[key] = fn
         return fn
 
+    @staticmethod
+    def pack_buckets(nbytes_list: Sequence[int],
+                     bucket_bytes: int) -> List[List[int]]:
+        """Greedy in-order packing of tensor indices into size buckets of
+        at most `bucket_bytes` (a tensor larger than the cap gets its own
+        bucket).  Order is preserved so bucketed and unbucketed reductions
+        see identical per-tensor layouts."""
+        buckets: List[List[int]] = []
+        cur: List[int] = []
+        cur_bytes = 0
+        for i, b in enumerate(nbytes_list):
+            if cur and cur_bytes + int(b) > bucket_bytes:
+                buckets.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(i)
+            cur_bytes += int(b)
+        if cur:
+            buckets.append(cur)
+        return buckets
+
     def group_all_reduce(self, xs: Sequence, op: str = "sum", name: str = "",
-                         fuse: bool = True, strategy: Optional[Strategy] = None):
+                         fuse: bool = True, strategy: Optional[Strategy] = None,
+                         bucket_bytes: Optional[int] = None):
         """Reduce a tensor list in one sync window.
 
         fuse=True (default): the whole list is reduced by ONE compiled
@@ -497,6 +563,17 @@ class Session:
         efficiency inversion at np=8 was each arm self-normalizing by its
         own np=2 baseline (per-tensor's inflated by ~161 per-dispatch
         overheads that amortize with np), not a crossover in this path.
+
+        bucket_bytes (with fuse=True): chunk the list into size-bucketed
+        groups (pack_buckets) and dispatch one fused program per bucket,
+        enqueueing ALL buckets before blocking on any — so a bucket's
+        collective can progress while later buckets are still being
+        dispatched, and on TPU the runtime can overlap transfer tails.
+        Each bucket's dispatch-to-ready latency lands in the
+        `collective_overlap` histogram (label = group name), the free A/B
+        instrumentation for the overlap-vs-fused-block comparison; the
+        outer span still carries one t_arrive so the straggler monitor's
+        per-collective skew matching keeps working unchanged.
 
         fuse=False: dispatch every tensor's collective separately, then sync
         once.  TPU executes enqueued programs in order, so this is N
@@ -520,6 +597,7 @@ class Session:
                   "tensors": len(xs), "fuse": bool(fuse),
                   "t_arrive": round(T.job_now(), 6)} if T.enabled() else None,
         )
+        c = self._byte_counters
         with stall_detector(gname), span:
             if fuse and len(xs) > 1:
                 xs = [jnp.asarray(x) for x in xs]
@@ -529,8 +607,30 @@ class Session:
                             f"leading dim {x.shape[0]} != session size "
                             f"{self.size}; per-peer tensors stack on dim 0"
                         )
-                signature = tuple((x.shape, str(x.dtype)) for x in xs)
-                outs = list(self._fused_group_fn(signature, op, impl)(*xs))
+                if bucket_bytes:
+                    groups = self.pack_buckets([x.nbytes for x in xs],
+                                               int(bucket_bytes))
+                else:
+                    groups = [list(range(len(xs)))]
+                outs = [None] * len(xs)
+                pending = []
+                for idxs in groups:
+                    sub = [xs[i] for i in idxs]
+                    signature = tuple((x.shape, str(x.dtype)) for x in sub)
+                    res = self._fused_group_fn(signature, op, impl)(*sub)
+                    pending.append((idxs, res))
+                for idxs, res in pending:
+                    for i, o in zip(idxs, res):
+                        outs[i] = o
+                    if bucket_bytes and c is not None:
+                        # per-bucket dispatch-to-ready latency: overlapped
+                        # buckets finish close together, a serialized
+                        # fused block shows one monotone staircase
+                        for o in res:
+                            o.block_until_ready()
+                        c.observe_hist(
+                            "collective_overlap",
+                            (time.perf_counter() - t0) * 1e3, label=gname)
             else:
                 serialize = jax.default_backend() == "cpu"
                 outs = []
@@ -544,10 +644,10 @@ class Session:
         dt = time.perf_counter() - t0
         total = sum(jnp.asarray(x).nbytes for x in xs)
         self.stats.record(gname, total, dt)
-        c = self._byte_counters
         if c is not None:
             c.add_egress(gname, total)
             c.observe_hist("collective_latency_ms", dt * 1e3, label=gname)
+            c.record_collective_impl(self._impl_tag(impl))
         return outs
 
     def reduce(self, x, root: int = 0, op: str = "sum", name: str = ""):

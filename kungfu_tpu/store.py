@@ -244,9 +244,14 @@ class StoreServer:
                                 self.request.sendall(struct.pack(">BQ", _ST_NOT_FOUND, 0))
                             else:
                                 data = blob.pack()
-                                self.request.sendall(struct.pack(">BQ", _ST_OK, len(data)) + data)
+                                # account BEFORE the send: a client that
+                                # reads /metrics right after its request
+                                # returns must see this response's bytes
+                                # (counting after sendall raced exactly
+                                # that read)
                                 if c is not None:
                                     c.add_egress(ckey, len(data))
+                                self.request.sendall(struct.pack(">BQ", _ST_OK, len(data)) + data)
                         else:
                             return
                 except (ConnectionError, OSError):

@@ -50,6 +50,20 @@ JAX_PLATFORMS=cpu python -m kungfu_tpu.planner --smoke --np 2 \
     --cache "$plan_cache_dir/plan_cache.json" --expect-cache-hit
 rm -rf "$plan_cache_dir"
 
+echo "== pallas parity: interpret-mode ring kernels vs XLA lowerings =="
+# the hand-scheduled ring RS/AG + fused-codec kernels must be bit-exact /
+# within computed quant tolerance of the lax.* paths, bucketed grad-sync
+# identical to unbucketed, and every registered pallas plan kf-lint-clean
+JAX_PLATFORMS=cpu python -m pytest tests/unit/test_pallas_collectives.py \
+    -q -m 'not slow' -p no:cacheprovider
+
+echo "== pallas smoke: set_strategy(pallas_ring) + off-TPU fallback (2-rank CPU) =="
+# forcing PALLAS_RING through Session.set_strategy must (1) engage the lax
+# fallback cleanly off-TPU with correct sums and an honest impl=xla stamp,
+# (2) run the real kernel bodies under KFT_PALLAS=interpret bit-identically,
+# (3) keep the fused int8 path inside its quantization tolerance
+JAX_PLATFORMS=cpu python -m kungfu_tpu.ops.pallas_collectives --smoke --np 2
+
 echo "== chaos smoke: scripted crash+heal drill (CPU, buddy-RAM rung) =="
 # --expect-rung buddy: the heal must resync from the peer-redundant
 # in-memory tier (recovery_rung=buddy journaled, zero disk restores)
