@@ -727,6 +727,54 @@ def _measure_serving():
     return section or None
 
 
+def _measure_tuner():
+    """The BENCH json's "tuner" section (ROADMAP item 5a): the compute
+    autotuner's chosen step config for the bench shape, predicted vs
+    measured step_ms (rel_err = the footprint model's honesty), and the
+    tuned-vs-default step_ms / MFU A/B — run by `--bench tuner` through
+    the measurement-resilient runner, so the record is probed before it
+    starts, requeued on failure, and stamped with an honest
+    `measured_this_run`.  The default is always a runoff control, so the
+    tuned config never loses to it.  Opt out with KFT_BENCH_SKIP_TUNER=1.
+    """
+    if os.environ.get("KFT_BENCH_SKIP_TUNER"):
+        return None
+
+    import tempfile
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    try:
+        from kungfu_tpu.benchmarks import runner as bench_runner
+
+        with tempfile.NamedTemporaryFile(suffix=".json") as f:
+            rec = bench_runner.run_section(
+                bench_runner.Section(
+                    name="tuner",
+                    argv=[sys.executable, "-m", "kungfu_tpu.benchmarks",
+                          "--bench", "tuner", "--steps", "3",
+                          "--out", f.name],
+                    out_json=f.name, timeout_s=600.0, cwd=repo,
+                ),
+                probe_timeout_s=60.0, retries=1, interval_s=2.0,
+            )
+    except Exception:  # never let the tuner probe sink the headline
+        return None
+    if not rec.get("measured_this_run"):
+        return {"measured_this_run": False, "error": rec.get("error")}
+    return {
+        "measured_this_run": True,
+        "cache_hit": rec.get("cache_hit"),
+        "chosen": rec.get("chosen"),
+        "predicted_ms": rec.get("predicted_ms"),
+        "measured_ms": rec.get("measured_ms"),
+        "rel_err": rec.get("rel_err"),
+        "default_ms": rec.get("default_ms"),
+        "speedup_vs_default": rec.get("speedup_vs_default"),
+        "mfu": rec.get("mfu"),
+        "default_mfu": rec.get("default_mfu"),
+    }
+
+
 def _measure_step_attribution():
     """The BENCH json's "step_attribution" section: per-phase p50 fractions
     (compute / data-wait / collective-wait) and straggler-detection latency
@@ -977,6 +1025,7 @@ def main():
     serving = _measure_serving()
     planner = _measure_planner()
     pallas = _measure_pallas()
+    tuner = _measure_tuner()
     step_attribution = _measure_step_attribution()
     lat_pcts = best.get("step_latency_pcts") or {}
 
@@ -1067,6 +1116,13 @@ def main():
                 # arms honestly report the engaged fallback) and the
                 # FSDP-transformer bucket_bytes overlap sweep
                 "pallas_collectives": pallas,
+                # compute autotuner (docs/tuning.md): the chosen step
+                # config for the bench shape, predicted vs measured
+                # step_ms (rel_err = footprint-model honesty) and the
+                # tuned-vs-default step_ms/MFU A/B through the probed
+                # runner — >= 1.0 speedup == the tuner never loses the
+                # runoff to the hand-tuned default
+                "tuner": tuner,
                 # straggler observatory (docs/observability.md): per-phase
                 # p50 step fractions (compute/data-wait/collective-wait)
                 # from a live 3-rank drill, plus slow-rank detection

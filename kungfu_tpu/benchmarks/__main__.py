@@ -20,7 +20,7 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="kungfu_tpu.benchmarks")
     p.add_argument("--bench", default="all_reduce",
                    choices=["all_reduce", "p2p", "attention", "compression",
-                            "serving", "planner", "pallas"])
+                            "serving", "planner", "pallas", "tuner"])
     p.add_argument("--slots", type=int, default=4,
                    help="KV slots for --bench serving")
     p.add_argument("--requests", type=int, default=64,
@@ -83,6 +83,12 @@ def main(argv=None) -> int:
 
         bench_pallas(size=args.size, steps=args.steps, warmup=args.warmup,
                      out=args.out)
+        return 0
+
+    if args.bench == "tuner":
+        from .tuner import bench_tuner
+
+        bench_tuner(steps=args.steps, out=args.out)
         return 0
 
     if args.bench == "compression":

@@ -207,10 +207,10 @@ def _lm_throughput(tx, per_replica: bool, batch_per_chip: int, steps: int,
     if cfg.head == "hidden":
         from ..models.transformer import lm_loss_chunked
 
-        ce_block = int(os.environ.get("KFT_CE_BLOCK", "2048"))
-
+        # block=None: the chunked-CE resolver reads KFT_CE_BLOCK itself,
+        # then falls back to the tuner's footprint default (ops/chunked_ce)
         def loss_fn(params, batch):
-            return lm_loss_chunked(model, params, batch, block=ce_block)
+            return lm_loss_chunked(model, params, batch)
     else:
         def loss_fn(params, batch):
             return lm_loss(model.apply({"params": params}, batch), batch)

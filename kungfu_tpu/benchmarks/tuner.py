@@ -1,0 +1,69 @@
+"""Compute-autotuner A/B bench (`--bench tuner`) — ROADMAP item 5a's metric.
+
+One record the BENCH json keys on: for the bench shape (the flagship GPT
+step on a TPU-class backend, a scaled replica on the CPU host), the
+tuner's chosen `StepConfig`, its predicted vs measured `step_ms` (rel_err
+= the footprint model's honesty), and the tuned-vs-default step_ms /
+MFU A/B — the default is always a runoff control, so
+`speedup_vs_default >= 1.0` by construction whenever the runoff ran
+this invocation (a cache hit reuses the persisted numbers and says so).
+
+    python -m kungfu_tpu.benchmarks --bench tuner [--steps 3] [--out f.json]
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, Optional
+
+
+def bench_shape():
+    """The shape this bench tunes: flagship GPT on a TPU-class backend
+    (the gpt-lm-mfu config), a compile-cheap replica on the CPU host so
+    the A/B mechanics still measure something real."""
+    import jax
+
+    from ..tuner import ShapeKey
+
+    if jax.default_backend() == "tpu":
+        return ShapeKey(vocab_size=32000, d_model=1024, n_layers=24,
+                        n_heads=16, n_kv_heads=0, d_ff=4096, seq_len=2048,
+                        batch_per_chip=4, dtype="bfloat16", causal=True)
+    return ShapeKey(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                    n_kv_heads=0, d_ff=128, seq_len=64, batch_per_chip=2,
+                    dtype="float32", causal=True)
+
+
+def bench_tuner(steps: int = 3, out: Optional[str] = None,
+                cache: Optional[str] = None,
+                use_cache: bool = True) -> Dict:
+    import jax
+
+    from ..tuner import ComputeTuner, PriorCache, default_cache_path
+
+    shape = bench_shape()
+    tuner = ComputeTuner(shape, cache=PriorCache(cache or default_cache_path()))
+    rec = tuner.tune(steps=steps, measure_top=3, use_cache=use_cache)
+    record = {
+        "bench": "tuner",
+        "backend": jax.default_backend(),
+        "shape": shape.to_json(),
+        "shape_digest": rec["shape"],
+        "cache_hit": rec["cache_hit"],
+        "chosen": rec["describe"],
+        "config": rec["config"],
+        "predicted_ms": rec.get("predicted_ms"),
+        "measured_ms": rec.get("measured_ms"),
+        "rel_err": rec.get("rel_err"),
+        "default_ms": rec.get("default_ms"),
+        "speedup_vs_default": rec.get("speedup_vs_default"),
+        "mfu": rec.get("mfu"),
+        "default_mfu": rec.get("default_mfu"),
+        "finalists": rec.get("finalists"),
+        "rejected": rec.get("rejected"),
+        "source": rec.get("source"),
+    }
+    print(json.dumps(record), flush=True)
+    if out:
+        with open(out, "w") as f:
+            json.dump(record, f, indent=2)
+    return record

@@ -83,7 +83,10 @@ class FSDPTrainer:
              the step.  Element-wise (uncompressed) reduction is
              numerically identical bucketed or not; a quantized dp wire
              re-aligns its block boundaries to the bucket buffer (within
-             the documented error bound).  Ignored without a dp axis.
+             the documented error bound).  "auto" defers the size to the
+             compute tuner's footprint table, resolved per model at
+             trace time (optimizers/sync._resolve_bucket_bytes).
+             Ignored without a dp axis.
       analyze: arm the kf-lint trace-time hook (kungfu_tpu.analysis): the
              compiled step is statically checked at its first train_step,
              raising AnalysisError before dispatch on error-severity
@@ -116,7 +119,12 @@ class FSDPTrainer:
         self.compression = (
             _compression_mod.resolve(compression) if compression is not None else None
         )
-        self.bucket_bytes = int(bucket_bytes) if bucket_bytes else None
+        # "auto" stays symbolic until the real gradient leaves exist
+        # (dp_reduce resolves it through the tuner's footprint table)
+        self.bucket_bytes = (
+            bucket_bytes if bucket_bytes == "auto"
+            else int(bucket_bytes) if bucket_bytes else None
+        )
         self._donate = donate
         self.loss_fn = loss_fn
         self.tx = tx
@@ -222,10 +230,14 @@ class FSDPTrainer:
                 return jax.tree.map(dp_mean, grads)
             from .optimizers.sync import (
                 _bucketed_reduce, _pack_buckets, _record_bucket_layout,
+                _resolve_bucket_bytes,
             )
 
             leaves, treedef = jax.tree.flatten(grads)
-            buckets = _pack_buckets(leaves, self.bucket_bytes)
+            bb = _resolve_bucket_bytes(self.bucket_bytes, leaves)
+            if not bb:
+                return jax.tree.map(dp_mean, grads)
+            buckets = _pack_buckets(leaves, bb)
             _record_bucket_layout(leaves, buckets)
             return jax.tree.unflatten(treedef, _bucketed_reduce(
                 leaves, buckets, lambda flat, _bi: dp_mean(flat)))

@@ -69,12 +69,18 @@ class TransformerConfig:
     # attends only the last `window` positions (flash kernels skip the
     # dead blocks).  Supported by the "flash"/"full" paths; requires causal
     window: int = 0
-    # flash-kernel tile sizes (q rows / k columns per block).  128x128 is
-    # the safe default; larger blocks amortize per-block softmax
-    # bookkeeping when VMEM allows (scripts/mfu_hunt.py sweeps these
-    # on-chip).  Only the "flash" path reads them.
-    flash_block_q: int = 128
-    flash_block_k: int = 128
+    # flash-kernel tile sizes (q rows / k columns per block).  None =
+    # "ask the compute tuner": the prior cache's measured winner for this
+    # exact (shape, backend, jax version) when one exists, else the
+    # shape-conditional hunt-winner defaults, clamped to the VMEM budget
+    # (kungfu_tpu/tuner/core.resolve_flash_blocks — the round-5
+    # scripts/mfu_hunt.py sweep landed in-library).  Explicit ints always
+    # win.  Only the "flash" path reads them.
+    flash_block_q: Optional[int] = None
+    flash_block_k: Optional[int] = None
+    # flash backward arm: None = per-shape auto (ops/flash.py), "pallas"
+    # or "xla" pin one — the tuner installs the arm its runoff measured
+    flash_backward: Optional[str] = None
     # feed-forward flavor: "gelu" (2-matmul) or "swiglu" (gated, 3-matmul)
     ffn: str = "gelu"
     # normalization flavor: "layer" (LayerNorm, no bias) or "rms"
@@ -97,6 +103,12 @@ class TransformerConfig:
     # for not storing per-layer activations — the standard long-sequence
     # memory lever (jax.checkpoint / nn.remat per block)
     remat: bool = False
+    # remat policy (remat=True only): "full" (= "none" here, recompute
+    # everything — jax.checkpoint's default) or "dots" =
+    # jax.checkpoint_policies.dots_saveable: keep the MXU matmul outputs,
+    # recompute only the cheap elementwise tail — ~1/6 extra FLOPs
+    # instead of ~1/3 for most of the memory win.  A tuner search axis.
+    remat_policy: str = "none"  # "none" | "full" | "dots"
     # "dense" returns [B, L, V] logits; "hidden" returns the final hidden
     # states and defers the head to a streaming loss (lm_loss_chunked /
     # ops/chunked_ce) that never materializes the logits tensor — the
@@ -144,6 +156,10 @@ class TransformerConfig:
             )
         assert self.ffn in ("gelu", "swiglu"), self.ffn
         assert self.norm in ("layer", "rms"), self.norm
+        assert self.remat_policy in ("none", "full", "dots"), self.remat_policy
+        assert self.flash_backward in (None, "pallas", "xla"), (
+            self.flash_backward
+        )
         assert self.head in ("dense", "hidden"), self.head
         assert self.kv_cache_dtype in ("model", "int8"), self.kv_cache_dtype
         if self.decode:
@@ -152,6 +168,20 @@ class TransformerConfig:
     @property
     def kv_heads(self) -> int:
         return self.n_kv_heads or self.n_heads
+
+
+def _attention_kind(cfg: TransformerConfig) -> str:
+    """Resolve attention="auto" through the SAME gate the Pallas kernels
+    use (compat.pallas_mode): flash when the kernels can run — compiled on
+    TPU, interpreted under KFT_PALLAS=interpret — plain einsum when they
+    are off.  Deciding off `jax.default_backend() == "tpu"` directly (the
+    old rule) meant interpret-mode CI silently exercised the full-einsum
+    path while claiming to test the flash path the tuner tunes."""
+    if cfg.attention != "auto":
+        return cfg.attention
+    from .. import compat
+
+    return "flash" if compat.pallas_mode() != "off" else "full"
 
 
 def apply_rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
@@ -341,9 +371,7 @@ class Attention(nn.Module):
             pos = jnp.arange(L)
             q = apply_rope(q, pos, cfg.rope_theta)
             k = apply_rope(k, pos, cfg.rope_theta)
-        kind = cfg.attention
-        if kind == "auto":
-            kind = "flash" if jax.default_backend() == "tpu" else "full"
+        kind = _attention_kind(cfg)
         if Hkv != H:
             # flash (index-mapped kv), full, ring (grouped einsums on the
             # un-repeated kv — the rotated ring payload stays Hkv-sized),
@@ -393,6 +421,12 @@ class Attention(nn.Module):
         elif kind == "flash":
             from ..ops.flash import flash_attention
 
+            # tile resolution: explicit config ints win; None asks the
+            # compute tuner's prior cache / shape-conditional defaults
+            # (kungfu_tpu/tuner), clamped to the VMEM budget
+            from ..tuner import resolve_flash_blocks
+
+            bq, bk = resolve_flash_blocks(cfg, batch=B, seq_len=L)
             if cfg.mesh is not None:
                 # pjit path with sharded q/k/v: a pallas_call is not GSPMD-
                 # partitionable, so enter a manual region over the batch/head
@@ -407,8 +441,8 @@ class Attention(nn.Module):
                 attn = _shard_map(
                     partial(flash_attention, causal=cfg.causal,
                             window=cfg.window or None,
-                            block_q=cfg.flash_block_q,
-                            block_k=cfg.flash_block_k),
+                            block_q=bq, block_k=bk,
+                            backward=cfg.flash_backward),
                     mesh=cfg.mesh,
                     in_specs=(spec, spec, spec),
                     out_specs=spec,
@@ -417,8 +451,8 @@ class Attention(nn.Module):
             else:
                 o = flash_attention(q, k, v, causal=cfg.causal,
                                     window=cfg.window or None,
-                                    block_q=cfg.flash_block_q,
-                                    block_k=cfg.flash_block_k)
+                                    block_q=bq, block_k=bk,
+                                    backward=cfg.flash_backward)
         else:
             o = full_attention(q, k, v, causal=cfg.causal,
                                window=cfg.window or None)
@@ -551,11 +585,18 @@ class TransformerLM(nn.Module):
         # per-block remat: backward recomputes each block's forward
         # instead of reading every intermediate from HBM — at seq 2048+
         # the saved activations (~O(10 * B*L*D) bf16 per layer) dominate
-        # HBM, and recompute costs ~1/3 extra forward FLOPs.  Stable
-        # block_{i} names keep the param tree identical across the flag.
-        block_cls = nn.remat(
-            Block, static_argnums=(2,)
-        ) if cfg.remat else Block
+        # HBM, and recompute costs ~1/3 extra forward FLOPs (or ~1/6
+        # under remat_policy="dots", which keeps the matmul outputs and
+        # recomputes only the elementwise tail — the tuner's middle
+        # ground).  Stable block_{i} names keep the param tree identical
+        # across the flags.
+        if cfg.remat:
+            remat_kw = {}
+            if cfg.remat_policy == "dots":
+                remat_kw["policy"] = jax.checkpoint_policies.dots_saveable
+            block_cls = nn.remat(Block, static_argnums=(2,), **remat_kw)
+        else:
+            block_cls = Block
         for i in range(cfg.n_layers):
             use_moe = cfg.n_experts > 0 and (i % cfg.moe_every == cfg.moe_every - 1)
             x = block_cls(cfg, use_moe=use_moe, name=f"block_{i}")(x, train)
@@ -725,8 +766,8 @@ def lm_loss(
 
 
 def lm_loss_chunked(
-    model: "TransformerLM", params, tokens: jax.Array, block: int = 2048,
-    z_loss: float = 0.0,
+    model: "TransformerLM", params, tokens: jax.Array,
+    block: Optional[int] = None, z_loss: float = 0.0,
 ) -> jax.Array:
     """`lm_loss` without materializing [B, L, V] logits.
 
@@ -734,6 +775,8 @@ def lm_loss_chunked(
     final hidden states and the head matmul + log-softmax stream over
     vocab blocks (ops/chunked_ce — recomputed in backward).  At GPT scale
     the logits tensor is the single largest activation; this removes it.
+    `block=None` resolves the chunk size through the tuner's defaults
+    (KFT_CE_BLOCK env, then the footprint table — ops/chunked_ce).
     """
     cfg = model.cfg
     assert cfg.head == "hidden", 'lm_loss_chunked needs TransformerConfig(head="hidden")'

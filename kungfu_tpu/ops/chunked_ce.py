@@ -18,13 +18,38 @@ perf-first benchmark culture (README.md:203-219).
 """
 from __future__ import annotations
 
+import os
 from functools import partial
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 NEG_INF = -1e30
+
+
+def resolve_ce_block(block: Optional[int], n_tokens: Optional[int] = None,
+                     vocab: Optional[int] = None) -> int:
+    """The vocab chunk size the streaming head actually runs with.
+
+    An explicit int always wins; None asks, in order: the KFT_CE_BLOCK
+    env knob (the unattended-queue override baseline_matrix used to read
+    itself), then the tuner's footprint default (streams ~64 MiB logit
+    blocks, clamped to [512, 8192] — kungfu_tpu/tuner/footprint.py).
+    Malformed env values fall through rather than wedge a trace.
+    """
+    if block:
+        return int(block)
+    env = os.environ.get("KFT_CE_BLOCK", "").strip()
+    if env:
+        try:
+            return int(env)
+        except ValueError:
+            pass
+    from ..tuner.footprint import default_ce_block
+
+    return default_ce_block(n_tokens, vocab)
 
 
 def _pad_w(w: jax.Array, block: int):
@@ -36,16 +61,23 @@ def _pad_w(w: jax.Array, block: int):
     return w, nb, v
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3,))
-def chunked_lm_head_ll(h, w, targets, block: int = 2048):
+def chunked_lm_head_ll(h, w, targets, block: Optional[int] = None):
     """Streaming log-likelihood of `targets` under softmax(h @ w).
 
     h: [N, D] (any float dtype; matmul runs in f32 like the dense head),
-    w: [D, V], targets: [N] int32.
+    w: [D, V], targets: [N] int32.  `block=None` resolves the vocab chunk
+    through `resolve_ce_block` (env, then the tuner's footprint default).
     Returns (ll [N] f32, log_z [N] f32) — log-probability of the target
     and the log-normalizer (for PaLM z-loss), matching the dense
     `_token_ll` contract.
     """
+    return _chunked_lm_head_ll(
+        h, w, targets, resolve_ce_block(block, int(h.shape[0]),
+                                        int(w.shape[1])))
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _chunked_lm_head_ll(h, w, targets, block: int):
     ll, log_z, _ = _forward(h, w, targets, block)
     return ll, log_z
 
@@ -123,4 +155,4 @@ def _bwd_vjp(block, res, cts):
     return dh.astype(h.dtype), dw.astype(w.dtype), None
 
 
-chunked_lm_head_ll.defvjp(_fwd_vjp, _bwd_vjp)
+_chunked_lm_head_ll.defvjp(_fwd_vjp, _bwd_vjp)

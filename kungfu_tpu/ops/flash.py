@@ -20,8 +20,13 @@ the bf16 rate.  Softmax statistics (m, l, lse, delta) and accumulators are
 always fp32; the attention scale is applied to the f32 scores post-dot, so
 no precision is spent on pre-scaled operands.
 
-On non-TPU backends the kernel runs in interpreter mode automatically, so
-the same code path is exercised by the CPU test suite.
+Interpret gating is `compat.pallas_mode` — the SAME env knob that drives
+the Pallas ring collectives: compiled on TPU, the interpreted kernels
+under KFT_PALLAS=interpret (so CPU CI exercises the real kernel bodies
+through one gate), and the pure-XLA reference/blocked paths when the mode
+is "off" (plain CPU — the interpreter's per-op cost is not worth paying
+by default).  Explicit `interpret=True/False` still forces a mode, which
+is what the kernel unit tests use.
 """
 from __future__ import annotations
 
@@ -40,8 +45,9 @@ from .. import compat
 NEG_INF = -1e30
 
 
-def _use_interpret() -> bool:
-    return jax.default_backend() != "tpu"
+def _mode(interpret: Optional[bool] = None) -> str:
+    """"compiled" | "interpret" | "off" — see compat.pallas_mode."""
+    return compat.pallas_mode(interpret)
 
 
 def _kloop_ranges(qi, block_q: int, block_k: int, nk: int, causal: bool,
@@ -221,7 +227,8 @@ def _flash_fwd(q, k, v, scale: float, causal: bool, block_q: int, block_k: int,
                interpret: Optional[bool], h: int = 1, hkv: int = 1,
                window: int = 0):
     """q: [B*H, L, D]; k,v: [B*Hkv, L, D] -> (o [B*H, L, D], lse [B*H, L])."""
-    if interpret is None and _use_interpret():
+    mode = _mode(interpret)
+    if interpret is None and mode == "off":
         return _fwd_reference(
             q, _expand_kv(k, h, hkv), _expand_kv(v, h, hkv), scale, causal,
             window,
@@ -257,7 +264,7 @@ def _flash_fwd(q, k, v, scale: float, causal: bool, block_q: int, block_k: int,
             compat.shape_dtype_struct((bh, lq, d), q.dtype, vma=vma),
             compat.shape_dtype_struct((bh, 1, lq), jnp.float32, vma=vma),
         ],
-        interpret=_use_interpret() if interpret is None else interpret,
+        interpret=mode == "interpret",
     )(qp, kp, vp)
     return o[:, :seq_len], lse[:, 0, :seq_len]
 
@@ -684,22 +691,29 @@ def _dispatch_bwd(q, k, v, o, lse, g, scale, causal, block_q, block_k,
 
     1. explicit `backward=` ("pallas" | "xla") from the caller;
     2. KFT_FLASH_BWD env (trace-time A/B switch, see flash_attention doc);
-    3. off-TPU (and no forced interpret): blocked XLA — it lowers anywhere;
+    3. pallas_mode "off" (plain CPU, no forced interpret): blocked XLA —
+       it lowers anywhere;
     4. auto by shape: Pallas when the work is kernel-shaped (sliding window
        — the kernel skips dead blocks, XLA can't — GQA, or seq >=
        KFT_FLASH_BWD_AUTO_SEQ), blocked XLA below that, where its single
        pass (5 matmuls vs the two-kernel Pallas split's 7) wins on-chip.
+
+    Under KFT_PALLAS=interpret the auto choice runs the kernel arms
+    through the interpreter — the tier-1 CPU path exercises the same gate
+    and the same kernels the tuner tunes on-chip.
     """
     if backward is None:
         # tolerate unrecognized env values (legacy behavior: only the exact
         # strings select; KFT_FLASH_BWD=0/true/... falls through to auto).
-        # env "pallas" is honored only where the kernel runs compiled: on
-        # CPU it would silently force the orders-of-magnitude-slower
-        # interpreter (a stale export was a no-op before this knob existed)
+        # env "pallas" is honored where the kernel can run at all (TPU,
+        # forced interpret, or KFT_PALLAS=interpret — an explicit opt-in
+        # to the interpreter); on a plain CPU it stays a no-op rather than
+        # silently forcing the orders-of-magnitude-slower interpreter
         env = os.environ.get("KFT_FLASH_BWD")
         if env == "xla":
             backward = "xla"
-        elif env == "pallas" and (interpret is not None or not _use_interpret()):
+        elif env == "pallas" and (interpret is not None
+                                  or _mode() != "off"):
             backward = "pallas"
     if backward is not None:
         # entry points validate user input at call time; by here the value
@@ -709,7 +723,7 @@ def _dispatch_bwd(q, k, v, o, lse, g, scale, causal, block_q, block_k,
         # explicit interpret (True OR False) means the caller forced the
         # kernel in the forward — mirror it in the backward
         use_kernel = True
-    elif _use_interpret():
+    elif _mode() == "off":
         use_kernel = False
     else:
         seq_len = q.shape[1]
@@ -719,7 +733,7 @@ def _dispatch_bwd(q, k, v, o, lse, g, scale, causal, block_q, block_k,
     if use_kernel:
         return _bwd_pallas(
             q, k, v, o, lse, g, scale, causal, block_q, block_k,
-            interpret=_use_interpret() if interpret is None else interpret,
+            interpret=_mode(interpret) == "interpret",
             g_lse=g_lse, h=h, hkv=hkv, window=window,
         )
     if h != hkv:
@@ -794,7 +808,9 @@ def flash_attention(
     """Fused attention, [B, L, H, D] -> [B, L, H, D] in q's dtype.
 
     Exact (not approximate): numerically the online-softmax refactoring of
-    softmax(qk^T)v.  `interpret=None` auto-selects interpreter mode off-TPU.
+    softmax(qk^T)v.  `interpret=None` defers to `compat.pallas_mode` (one
+    gate with the Pallas ring collectives): compiled on TPU, interpreted
+    kernels under KFT_PALLAS=interpret, pure-XLA reference otherwise.
     GQA/MQA: k/v may carry Hkv < H heads (H % Hkv == 0) — the kernels
     index-map the shared kv heads instead of materializing repeats.
     `window` (sliding-window / local attention, requires causal): each

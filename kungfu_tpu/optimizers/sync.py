@@ -92,6 +92,24 @@ def _mean_reducer(axis_name: AxisName, impl: str):
     raise ValueError(f"unknown reduce impl {impl!r}")
 
 
+def _resolve_bucket_bytes(bucket_bytes, leaves) -> int:
+    """The bucket size a sync layout actually runs with (0 = unbucketed).
+
+    "auto" asks the compute tuner's footprint table
+    (tuner.footprint.default_bucket_bytes): small gradient trees keep
+    XLA's single fused collective, larger ones get the 4 MiB overlap
+    layout.  Resolved at trace time from the real leaves, so the same
+    transform does the right thing for every model it's reused on.
+    """
+    if bucket_bytes == "auto":
+        from ..tuner.footprint import default_bucket_bytes
+
+        total = sum(int(g.size) * jnp.dtype(g.dtype).itemsize
+                    for g in leaves)
+        return default_bucket_bytes(total) or 0
+    return int(bucket_bytes) if bucket_bytes else 0
+
+
 def _pack_buckets(leaves, bucket_bytes: int):
     """Greedy in-traversal-order packing of leaf indices into size buckets.
 
@@ -156,7 +174,7 @@ def all_reduce_gradients(
     compression: Comp.AxisCompression = None,
     seed: int = 0,
     analyze: Optional[bool] = None,
-    bucket_bytes: Optional[int] = None,
+    bucket_bytes: Union[int, str, None] = None,
 ) -> optax.GradientTransformation:
     """Gradient-averaging transform: the core of S-SGD (sync_sgd.py:81-112).
 
@@ -216,11 +234,13 @@ def all_reduce_gradients(
             _lint_scope()
             if bucket_bytes:
                 leaves, treedef = jax.tree.flatten(updates)
-                buckets = _pack_buckets(leaves, int(bucket_bytes))
-                _record_bucket_layout(leaves, buckets)
-                reduced = _bucketed_reduce(
-                    leaves, buckets, lambda flat, _bi: reducer(flat))
-                return jax.tree.unflatten(treedef, reduced), state
+                bb = _resolve_bucket_bytes(bucket_bytes, leaves)
+                if bb:
+                    buckets = _pack_buckets(leaves, bb)
+                    _record_bucket_layout(leaves, buckets)
+                    reduced = _bucketed_reduce(
+                        leaves, buckets, lambda flat, _bi: reducer(flat))
+                    return jax.tree.unflatten(treedef, reduced), state
             return jax.tree.map(reducer, updates), state
 
         return optax.GradientTransformation(init_fn, update_fn)
@@ -277,7 +297,8 @@ def _compressed_reducer(axis_name: AxisName, impl: str,
 
 def _compressed_all_reduce_gradients(
     axis_name: AxisName, impl: str, compression: Comp.AxisCompression,
-    seed: int, lint_scope=lambda: None, bucket_bytes: Optional[int] = None
+    seed: int, lint_scope=lambda: None,
+    bucket_bytes: Union[int, str, None] = None,
 ) -> optax.GradientTransformation:
     reduce_leaf, local_cfg = _compressed_reducer(axis_name, impl, compression)
     use_ef = local_cfg.error_feedback and local_cfg.scheme != "none"
@@ -296,8 +317,9 @@ def _compressed_all_reduce_gradients(
             Comp.error_feedback.correct(updates, state.ef) if use_ef else updates
         )
         leaves, treedef = jax.tree.flatten(corrected)
-        if bucket_bytes:
-            buckets = _pack_buckets(leaves, int(bucket_bytes))
+        if bucket_bytes and _resolve_bucket_bytes(bucket_bytes, leaves):
+            buckets = _pack_buckets(
+                leaves, _resolve_bucket_bytes(bucket_bytes, leaves))
             _record_bucket_layout(leaves, buckets)
             keys = jax.random.split(sub, len(buckets) + 1)
             reduced = jax.tree.unflatten(treedef, _bucketed_reduce(
@@ -328,7 +350,7 @@ def synchronous_sgd(
     impl: str = "pmean",
     compression: Comp.AxisCompression = None,
     analyze: Optional[bool] = None,
-    bucket_bytes: Optional[int] = None,
+    bucket_bytes: Union[int, str, None] = None,
 ) -> optax.GradientTransformation:
     """SynchronousSGDOptimizer: average grads across the mesh, then `inner`.
 

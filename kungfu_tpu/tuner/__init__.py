@@ -1,0 +1,50 @@
+"""Compute autotuner — the MFU chase as a subsystem (ROADMAP item 5a).
+
+Per (model shape × backend × batch), searches step-graph configurations
+— flash tiles + backward arm, head layout, remat policy, chunked-CE
+chunk, donation and gradient-sync buckets — prunes with a VMEM/HBM
+footprint model, runs a measured runoff (the hand-tuned default always a
+control), and persists winners in a JSON prior cache keyed
+(shape digest | backend | jax version).  `resolve_flash_blocks` is the
+read path `TransformerConfig(flash_block_q=None)` consults.  See
+docs/tuning.md.
+"""
+from .cache import PriorCache, backend_name, default_cache_path, jax_version
+from .core import (
+    ComputeTuner,
+    default_flash_blocks,
+    resolve_flash_blocks,
+)
+from .footprint import (
+    check_fit,
+    default_bucket_bytes,
+    default_ce_block,
+    flash_vmem_bytes,
+    predict_step_ms,
+    step_hbm_bytes,
+)
+from .measure import flash_sweep, measure_step, probe_peak
+from .space import ShapeKey, StepConfig, default_config, enumerate_configs
+
+__all__ = [
+    "ComputeTuner",
+    "PriorCache",
+    "ShapeKey",
+    "StepConfig",
+    "backend_name",
+    "check_fit",
+    "default_bucket_bytes",
+    "default_cache_path",
+    "default_ce_block",
+    "default_config",
+    "default_flash_blocks",
+    "enumerate_configs",
+    "flash_sweep",
+    "flash_vmem_bytes",
+    "jax_version",
+    "measure_step",
+    "predict_step_ms",
+    "probe_peak",
+    "resolve_flash_blocks",
+    "step_hbm_bytes",
+]

@@ -1,127 +1,41 @@
 #!/usr/bin/env python
-"""Re-run the GPT MFU config with mfu_hunt's winning flash tiling.
+"""Apply mfu_hunt's winning flash tiling — thin wrapper over the tuner CLI.
 
-The unattended round can't stop to read the hunt's output, so this job
-closes the loop: parse the last `HUNT: {"probe": "flash", ... "best": ...}`
-line from the hunt log, and when the winner is one of OUR kernel arms with
-non-default blocks, re-run baseline_matrix config 9 with
-KFT_FLASH_BQ/KFT_FLASH_BK set to it.  If the hunt never ran, failed, or
-the default tiling already won, exit 0 without burning a chip window.
+The round-5 close-the-loop job, retired into
+``python -m kungfu_tpu.tuner --apply-hunt-log`` (PR 10): the hunt log's
+winner now lands in the tuner's PRIOR CACHE (so every later run resolves
+it through `TransformerConfig(flash_block_q=None)`, not just the one
+re-measured config), and the guarded config-9 re-run keeps its old
+record-protection rules (a failed or slower tuned re-run never replaces a
+better committed record — kungfu_tpu/tuner/hunt.py).
 
     python scripts/apply_hunt_winner.py [--log /tmp/tpuq/hunt.log] \
         [--out /root/repo/BENCH_CONFIGS.json]
-
-Verdict r5 context: "chase the result until MFU >= 0.40 (kernel
-block-size sweep via mfu_hunt.py)" — this is the chase step.
 """
 from __future__ import annotations
 
 import argparse
-import json
 import os
-import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
-def find_best(log_path: str):
-    """Last flash-probe summary's best row, or None."""
-    best = None
-    try:
-        with open(log_path) as f:
-            for line in f:
-                if not line.startswith("HUNT: "):
-                    continue
-                try:
-                    d = json.loads(line[len("HUNT: "):])
-                except ValueError:
-                    continue
-                if d.get("probe") == "flash" and d.get("best"):
-                    best = d["best"]
-    except OSError:
-        return None
-    return best
-
-
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--log", default="/tmp/tpuq/hunt.log")
     ap.add_argument("--out", default=os.path.join(REPO, "BENCH_CONFIGS.json"))
+    ap.add_argument("--no-rerun", action="store_true",
+                    help="only ingest the winner into the prior cache")
     args = ap.parse_args(argv)
 
-    best = find_best(args.log)
-    if best is None:
-        print("# no flash-hunt summary found; nothing to apply")
-        return 0
-    if best.get("impl") not in ("ours", "ours_xla_bwd"):
-        print(f"# hunt winner is {best.get('impl')}; no tiling to apply")
-        return 0
-    bq, bk = int(best.get("block_q", 0)), int(best.get("block_k", 0))
-    if (bq, bk) in ((0, 0), (128, 128)):
-        print(f"# winner uses default tiling ({bq}x{bk}); config 9 already "
-              "measured it")
-        return 0
-    def read_record():
-        try:
-            with open(args.out) as f:
-                for rec in json.load(f).get("results", []):
-                    if rec.get("config") == "gpt-lm-mfu":
-                        return rec
-        except (OSError, ValueError):
-            pass
-        return None
+    from kungfu_tpu.tuner.__main__ import main as tuner_main
 
-    before = read_record()
-    env = dict(os.environ)
-    env["KFT_FLASH_BQ"], env["KFT_FLASH_BK"] = str(bq), str(bk)
-    # the tiling was timed on the winning arm's backward path; config 9's
-    # auto choice (xla below KFT_FLASH_BWD_AUTO_SEQ) may differ — pin the
-    # backward to the one the hunt actually measured
-    bwd = "pallas" if best["impl"] == "ours" else "xla"
-    env["KFT_FLASH_BWD"] = bwd
-    print(f"# re-running gpt-lm-mfu with flash blocks {bq}x{bk} "
-          f"backward={bwd} ({best.get('ms')}ms in the hunt)")
-    r = subprocess.run(
-        [sys.executable, "-m", "kungfu_tpu.benchmarks.baseline_matrix",
-         "--only", "9", "--out", args.out],
-        env=env, cwd=REPO,
-    )
-    from kungfu_tpu.benchmarks.baseline_matrix import _merge_into
-
-    after = read_record()
-    tuned = {"flash_blocks": [bq, bk], "flash_backward": bwd}
-    if before and before.get("value") and not (after and after.get("value")):
-        # the tuned rerun failed/wedged and its error/partial record
-        # replaced the good committed one: put the good record back, with
-        # the failure noted
-        restored = dict(before)
-        restored["tuned_rerun"] = {
-            **tuned, "error": (after or {}).get("error", "no value recorded"),
-            "note": "hunt-winner tiling rerun failed; prior record restored",
-        }
-        _merge_into(args.out, restored)
-        print("# tuned rerun produced no value; restored the prior record")
-    elif (before and after and before.get("value") and after.get("value")
-            and after["value"] < before["value"]):
-        # never let a worse tuned run replace a better committed record
-        restored = dict(before)
-        restored["tuned_rerun"] = {
-            **tuned, "mfu": after["value"],
-            "note": "hunt-winner tiling re-run scored lower; default kept",
-        }
-        _merge_into(args.out, restored)
-        print(f"# tuned rerun mfu {after['value']} < recorded "
-              f"{before['value']}; restored the better record")
-    elif after and after.get("value"):
-        # the tuned run IS the record: stamp the tiling that produced it
-        # or the number is unreproducible from the record alone
-        stamped = dict(after)
-        stamped["flash_blocks"] = [bq, bk]
-        stamped["flash_backward"] = bwd
-        _merge_into(args.out, stamped)
-    return r.returncode
+    cli = ["--apply-hunt-log", args.log, "--out", args.out]
+    if not args.no_rerun:
+        cli.append("--rerun")
+    return tuner_main(cli)
 
 
 if __name__ == "__main__":

@@ -50,6 +50,20 @@ JAX_PLATFORMS=cpu python -m kungfu_tpu.planner --smoke --np 2 \
     --cache "$plan_cache_dir/plan_cache.json" --expect-cache-hit
 rm -rf "$plan_cache_dir"
 
+echo "== tuner smoke: enumerate -> footprint gate -> runoff -> install (CPU) =="
+# the compute-autotuner pipeline must run end to end: the footprint gate
+# rejects + journals a seeded oversized tiling, the measured runoff keeps
+# the hand-tuned default as a control (the winner never loses to it),
+# apply() lands the winner on a TransformerConfig, tuned-vs-default
+# forward parity is bit-identical, and the prior cache persists — the
+# SECOND run must be a pure cache hit and skip the runoff (docs/tuning.md)
+tuner_cache_dir=$(mktemp -d)
+JAX_PLATFORMS=cpu python -m kungfu_tpu.tuner --smoke \
+    --cache "$tuner_cache_dir/prior_cache.json"
+JAX_PLATFORMS=cpu python -m kungfu_tpu.tuner --smoke \
+    --cache "$tuner_cache_dir/prior_cache.json" --expect-cache-hit
+rm -rf "$tuner_cache_dir"
+
 echo "== pallas parity: interpret-mode ring kernels vs XLA lowerings =="
 # the hand-scheduled ring RS/AG + fused-codec kernels must be bit-exact /
 # within computed quant tolerance of the lax.* paths, bucketed grad-sync
