@@ -825,6 +825,60 @@ def _measure_step_attribution():
     }
 
 
+def _measure_scaling():
+    """The BENCH json's "scaling" section (ROADMAP item 1): the
+    scaling-efficiency observatory — per-world-size bus-bandwidth
+    efficiency per algorithm (ring/hierarchical/pallas_ring) and payload
+    bucket, the train-step loss attribution (compute vs collective-wait),
+    and the efficiency-floor SLO verdict.  Run by `--bench scaling`
+    through the measurement-resilient runner on the virtual-device CPU
+    mesh (world sizes 1/2/4 — the curve machinery is world-size-agnostic,
+    so the netns pod drill plugs in unchanged).  A breached floor fails
+    the child (exit 4) and records honestly here.  Opt out with
+    KFT_BENCH_SKIP_SCALING=1."""
+    if os.environ.get("KFT_BENCH_SKIP_SCALING"):
+        return None
+
+    import tempfile
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    try:
+        from kungfu_tpu.benchmarks import runner as bench_runner
+
+        with tempfile.NamedTemporaryFile(suffix=".json") as f:
+            rec = bench_runner.run_section(
+                bench_runner.Section(
+                    name="scaling",
+                    argv=[sys.executable, "-m", "kungfu_tpu.benchmarks",
+                          "--bench", "scaling", "--sizes", "1,2,4",
+                          "--steps", "4", "--out", f.name],
+                    out_json=f.name, timeout_s=420.0, cwd=repo,
+                    # the observatory forces the virtual-device CPU mesh:
+                    # probe CPU so a wedged tunnel can't block it
+                    env={"JAX_PLATFORMS": "cpu"},
+                ),
+                probe_timeout_s=60.0, retries=1, interval_s=2.0,
+            )
+    except Exception:  # never let the curve probe sink the headline
+        return None
+    if not rec.get("measured_this_run"):
+        # exit 4 = the floor tripped: the curve DID measure and the SLO
+        # failed the bench — surface the recorded breach, not a blank
+        if "exited 4" in str(rec.get("error", "")):
+            return {"measured_this_run": True, "slo_breached": True,
+                    "error": rec.get("error")}
+        return {"measured_this_run": False, "error": rec.get("error")}
+    return {
+        "measured_this_run": True,
+        "sizes": rec.get("sizes"),
+        "allreduce_scaling_efficiency": rec.get("allreduce_scaling_efficiency"),
+        "efficiency_by_algorithm": rec.get("efficiency_by_algorithm"),
+        "loss_attribution": rec.get("loss_attribution"),
+        "train": rec.get("train"),
+        "slo_breached": rec.get("slo_breached"),
+    }
+
+
 def _measure_pallas():
     """The BENCH json's "pallas_collectives" section (ROADMAP item 1's
     success metric): the xla-vs-pallas-vs-pallas_fused `step_ms` /
@@ -1027,6 +1081,7 @@ def main():
     pallas = _measure_pallas()
     tuner = _measure_tuner()
     step_attribution = _measure_step_attribution()
+    scaling = _measure_scaling()
     lat_pcts = best.get("step_latency_pcts") or {}
 
     # comparative context (VERDICT r4 missing #1): the recorded
@@ -1130,6 +1185,12 @@ def main():
                 # judge — run through the probed/requeueing bench runner,
                 # so measured_this_run is stamped honestly per section
                 "step_attribution": step_attribution,
+                # scaling-efficiency observatory (docs/observability.md):
+                # per-world-size busbw efficiency per algorithm + bucket,
+                # the train-step loss attribution, and the efficiency-
+                # floor SLO verdict — a scaling regression fails this
+                # section (slo_breached), not just single-chip speed
+                "scaling": scaling,
                 "input_pipeline": input_pipeline,
                 "sweep": [
                     {

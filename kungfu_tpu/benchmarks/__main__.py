@@ -20,7 +20,17 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="kungfu_tpu.benchmarks")
     p.add_argument("--bench", default="all_reduce",
                    choices=["all_reduce", "p2p", "attention", "compression",
-                            "serving", "planner", "pallas", "tuner"])
+                            "serving", "planner", "pallas", "tuner",
+                            "scaling"])
+    p.add_argument("--sizes", default="1,2,4",
+                   help="world sizes for --bench scaling")
+    p.add_argument("--chaos-collective-ms", type=float, default=0.0,
+                   help="scaling observatory: inject this per-dispatch delay "
+                        "at the largest world size (the induced regression "
+                        "that must trip the efficiency-floor SLO)")
+    p.add_argument("--no-slo", action="store_true",
+                   help="scaling observatory: skip the efficiency-floor SLO "
+                        "gate (curve recording only)")
     p.add_argument("--slots", type=int, default=4,
                    help="KV slots for --bench serving")
     p.add_argument("--requests", type=int, default=64,
@@ -90,6 +100,20 @@ def main(argv=None) -> int:
 
         bench_tuner(steps=args.steps, out=args.out)
         return 0
+
+    if args.bench == "scaling":
+        from .scaling import _ensure_devices, bench_scaling
+
+        sizes = sorted({int(s) for s in args.sizes.split(",") if s})
+        _ensure_devices(max(sizes))
+        rec = bench_scaling(
+            sizes=sizes, steps=args.steps, warmup=args.warmup,
+            chaos_collective_ms=args.chaos_collective_ms, out=args.out,
+            slo=not args.no_slo,
+        )
+        # a tripped efficiency floor FAILS the bench — a scaling
+        # regression is a first-class failure, not just single-chip speed
+        return 4 if rec.get("slo_breached") else 0
 
     if args.bench == "compression":
         from .compression import bench_compression

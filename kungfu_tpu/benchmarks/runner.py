@@ -77,16 +77,21 @@ def _kill_tree(p: subprocess.Popen) -> None:
         log.warning("child %d unkillable (abandoned)", p.pid)
 
 
-def probe_backend(timeout_s: float = 90.0,
-                  env: Optional[Dict[str, str]] = None) -> Optional[str]:
+def probe_backend_ex(timeout_s: float = 90.0,
+                     env: Optional[Dict[str, str]] = None) -> Optional[Dict[str, object]]:
     """None when a trivial dispatch completes on an acceptable platform
-    within `timeout_s`; else the reason the backend is unusable."""
+    within `timeout_s`; else a diagnosis dict: `reason` (the headline),
+    `exit` (returncode or "timeout"), and the probe's captured `stderr`
+    tail — the detail the BENCH journal needs to say WHY
+    `measured_this_run` went false instead of just that it did
+    (ROADMAP item 6: two committed rounds shipped with a wedged probe and
+    no recorded cause)."""
     full_env = dict(os.environ)
     if env:
         full_env.update(env)
     p = subprocess.Popen(
         [sys.executable, "-c", PROBE_SRC],
-        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         env=full_env, start_new_session=True,
     )
     deadline = time.monotonic() + timeout_s
@@ -94,16 +99,45 @@ def probe_backend(timeout_s: float = 90.0,
         time.sleep(0.2)
     if p.poll() is None:
         _kill_tree(p)
-        return f"probe timed out after {timeout_s:.0f}s (backend wedged)"
+        return {"reason": f"probe timed out after {timeout_s:.0f}s "
+                          "(backend wedged)",
+                "exit": "timeout", "stderr": ""}
     out = p.stdout.read() if p.stdout is not None else ""
+    err = (p.stderr.read() if p.stderr is not None else "").strip()[-800:]
     if p.returncode != 0:
-        return f"probe exited {p.returncode}"
+        return {"reason": f"probe exited {p.returncode}",
+                "exit": p.returncode, "stderr": err}
     if "PROBE_OK" in out:
         return None
     if "PROBE_FALLBACK" in out:
-        return ("backend fell back to an unrequested platform "
-                f"({out.strip().split()[-1]})")
-    return "probe printed no sentinel"
+        return {"reason": ("backend fell back to an unrequested platform "
+                           f"({out.strip().split()[-1]})"),
+                "exit": p.returncode, "stderr": err}
+    return {"reason": "probe printed no sentinel",
+            "exit": p.returncode, "stderr": err}
+
+
+def probe_backend(timeout_s: float = 90.0,
+                  env: Optional[Dict[str, str]] = None) -> Optional[str]:
+    """None when the backend answers; else the reason string (the
+    compatibility wrapper over `probe_backend_ex`)."""
+    diag = probe_backend_ex(timeout_s, env=env)
+    return None if diag is None else str(diag["reason"])
+
+
+# env vars a wedged attempt can leave poisoned; the fresh-env retry strips
+# them so a stale XLA/libtpu override cannot wedge every later probe too
+_PROBE_SCRUB_VARS = ("XLA_FLAGS", "LIBTPU_INIT_ARGS", "TPU_LIBRARY_PATH")
+
+
+def fresh_probe_env(env: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    """A scrubbed copy of the section env for the probe's second chance:
+    XLA/libtpu overrides dropped (even section-provided ones — they are
+    the usual poison), the section's requested platform kept."""
+    out = dict(env or {})
+    for k in _PROBE_SCRUB_VARS:
+        out[k] = ""  # "" overrides any inherited value in the child env
+    return out
 
 
 @dataclasses.dataclass
@@ -156,21 +190,35 @@ def _execute(section: Section) -> Optional[dict]:
     raise RuntimeError("no JSON record in section output")
 
 
+def _normalize_probe(result) -> Optional[Dict[str, object]]:
+    """None | reason-string | diagnosis-dict -> None | diagnosis-dict."""
+    if result is None:
+        return None
+    return result if isinstance(result, dict) else {"reason": str(result)}
+
+
 def run_sections(sections: Sequence[Section], probe_timeout_s: float = 90.0,
                  retries: int = 2, interval_s: float = 5.0,
-                 probe: Callable[..., Optional[str]] = probe_backend,
+                 probe: Callable[..., object] = probe_backend_ex,
                  sleep: Callable[[float], None] = time.sleep) -> Dict[str, dict]:
     """Probe-gated queue over `sections`; every record is stamped with an
     honest `measured_this_run`.
 
     Each pop probes the backend first (with the section's env, so CPU-only
-    drills never block on a wedged tunnel).  A failed probe or section run
-    journals (`bench_probe_failed` / `bench_requeued`) and moves the
-    section to the BACK of the queue — the backend gets `interval_s` to
-    recover while other sections take their turn — until its attempt
-    budget (`retries` + 1) is spent, at which point the section records
-    `measured_this_run: False` with the last error (`bench_section_failed`)
-    instead of silently vanishing from the BENCH json."""
+    drills never block on a wedged tunnel).  A failing probe gets ONE
+    immediate second chance with a fresh subprocess env
+    (`fresh_probe_env`: inherited XLA/libtpu overrides scrubbed) — a
+    poisoned env from a wedged attempt must not fail every later probe
+    too; recovery journals `bench_probe_recovered` and the section runs.
+    A probe that fails both ways journals `bench_probe_failed` WITH the
+    captured stderr tail and exit cause (the ROADMAP-6 diagnosis:
+    `measured_this_run: false` now says why).  Failed probes/sections move
+    to the BACK of the queue (`bench_requeued`) — the backend gets
+    `interval_s` to recover while other sections take their turn — until
+    the attempt budget (`retries` + 1) is spent, at which point the
+    section records `measured_this_run: False` with the last error
+    (`bench_section_failed`) instead of silently vanishing from the BENCH
+    json."""
     queue = deque(sections)
     attempts: Dict[str, int] = {}
     results: Dict[str, dict] = {}
@@ -179,11 +227,26 @@ def run_sections(sections: Sequence[Section], probe_timeout_s: float = 90.0,
         attempts[s.name] = attempts.get(s.name, 0) + 1
         fail: Optional[str] = None
         rec: Optional[dict] = None
-        err = probe(probe_timeout_s, env=s.env)
-        if err is not None:
-            fail = f"probe: {err}"
+        diag = _normalize_probe(probe(probe_timeout_s, env=s.env))
+        if diag is not None:
+            retry_diag = _normalize_probe(
+                probe(probe_timeout_s, env=fresh_probe_env(s.env)))
+            if retry_diag is None:
+                journal_event("bench_probe_recovered", section=s.name,
+                              attempt=attempts[s.name],
+                              error=diag.get("reason"),
+                              exit=diag.get("exit"),
+                              stderr=diag.get("stderr"))
+                log.warning("section %s: probe recovered on a fresh env "
+                            "(first failure: %s)", s.name, diag.get("reason"))
+                diag = None
+        if diag is not None:
+            fail = f"probe: {diag.get('reason')}"
             journal_event("bench_probe_failed", section=s.name,
-                          attempt=attempts[s.name], error=err)
+                          attempt=attempts[s.name], error=diag.get("reason"),
+                          exit=diag.get("exit"), stderr=diag.get("stderr"),
+                          retried=True,
+                          retry_error=retry_diag.get("reason"))
             log.warning("section %s: %s", s.name, fail)
         else:
             try:

@@ -88,14 +88,12 @@ def run_drill(plan: str, np: int, total_samples: int, timeout_s: float,
 
 
 def _journal_events(journal_dir: str) -> list:
+    from ..monitor.journal import read_journal_segments
+
     events = []
     for p in sorted(glob.glob(os.path.join(journal_dir, "journal-*.jsonl"))):
-        with open(p, encoding="utf-8") as f:
-            for line in f:
-                try:
-                    events.append(json.loads(line))
-                except ValueError:
-                    continue
+        # rotated segments (.1/.2 under KFT_JOURNAL_MAX_MB) fold in too
+        events.extend(read_journal_segments(p))
     return events
 
 

@@ -901,6 +901,9 @@ def run_elastic(
                     _rebuild_buddy(seed=True)
                     resizes += 1
                     resize_events.append(ev)
+                    if step_counters is not None:
+                        step_counters.set_gauge("cluster_size",
+                                                float(peer.size))
                     if anomaly is not None:
                         anomaly.reset()  # new world, new step-time baseline
                     tracing.record_span("resize", m_resize0, cat="elastic",
@@ -946,6 +949,7 @@ def run_elastic(
                 heal_events.append(hev)
                 global_counters().inc_event("heals")
                 global_counters().set_gauge("heal_mttr_s", hev["mttr_s"])
+                global_counters().set_gauge("cluster_size", float(peer.size))
                 rung = hev.get("recovery_rung")
                 if rung:
                     # per-rung MTTR: the ladder's value proposition is the
@@ -991,6 +995,9 @@ def run_elastic(
         from ..monitor.straggler import AnomalyWatchdog
 
         anomaly = AnomalyWatchdog(counters=step_counters)
+        # cluster_size as a gauge: the time-series sampler turns it into
+        # the fleet's resize/heal history (`gauge:cluster_size` series)
+        step_counters.set_gauge("cluster_size", float(peer.size))
     while offset < cfg.total_samples:
         m_step0 = time.monotonic()
         step_before = step

@@ -35,3 +35,17 @@ from .straggler import (  # noqa: F401
     StragglerDetector,
     StragglerMonitor,
 )
+from .timeseries import (  # noqa: F401
+    CountersSampler,
+    FleetSampler,
+    Series,
+    TimeSeriesStore,
+    percentile_from_buckets,
+)
+from .slo import (  # noqa: F401
+    DEFAULT_RULES,
+    SLO_EXIT_CODE,
+    SLOEngine,
+    SLORule,
+    load_rules,
+)

@@ -3,10 +3,22 @@
 Two modes:
 
   --merge DIR     offline merge of a (possibly dead) job's telemetry
-                  artifacts: every `journal-*.jsonl` in DIR is merged into
-                  `merged-journal.jsonl` (wall-clock ordered) and every
+                  artifacts: every `journal-*.jsonl` in DIR (plus its
+                  rotated `.1`/`.2` segments, KFT_JOURNAL_MAX_MB) is merged
+                  into `merged-journal.jsonl` (wall-clock ordered), every
                   `trace-*.json` (the workers' exit dumps, KFT_TRACE_DUMP_DIR)
-                  into `merged-trace.json` with one Perfetto lane per file.
+                  into `merged-trace.json` with one Perfetto lane per file,
+                  and every `timeseries-*.json` (the samplers' exit dumps,
+                  monitor.timeseries) into `merged-timeseries.json` keyed
+                  by process identity.
+
+  --slo-drill     end-to-end SLO drill (the scripts/check.sh stage): a
+                  2-rank CPU fleet under `-telemetry -slo-exit-code` with a
+                  chaos slow@ window and a tight step-latency SLO; asserts
+                  the breach sustains (journaled slo_breach, /slo shows the
+                  rule active), clears after the window passes
+                  (slo_cleared), /history carries the p99 series that drove
+                  it, and the launcher exits with the SLO exit code.
 
   --smoke         end-to-end telemetry smoke (the scripts/check.sh stage):
                   launches a 2-process CPU job under `kungfu-run -telemetry`
@@ -36,11 +48,14 @@ from typing import Dict, List, Optional
 def run_merge(dirpath: str, trace_out: str = "", journal_out: str = "") -> int:
     from .fleet import merge_chrome_traces
     from .journal import merge_journals
+    from .timeseries import merge_dumps
 
     journals = sorted(glob.glob(os.path.join(dirpath, "journal-*.jsonl")))
     traces = sorted(glob.glob(os.path.join(dirpath, "trace-*.json")))
-    if not journals and not traces:
-        print(f"no journal-*.jsonl or trace-*.json under {dirpath}", file=sys.stderr)
+    series = sorted(glob.glob(os.path.join(dirpath, "timeseries-*.json")))
+    if not journals and not traces and not series:
+        print(f"no journal-*.jsonl, trace-*.json or timeseries-*.json under "
+              f"{dirpath}", file=sys.stderr)
         return 1
 
     if journals:
@@ -74,6 +89,16 @@ def run_merge(dirpath: str, trace_out: str = "", journal_out: str = "") -> int:
             json.dump(merged, f)
         print(f"trace: {len(merged['traceEvents'])} events from {len(loaded)} "
               f"lanes -> {trace_out} (open in https://ui.perfetto.dev)")
+
+    if series:
+        folded = merge_dumps(series)
+        ts_out = os.path.join(dirpath, "merged-timeseries.json")
+        with open(ts_out, "w") as f:
+            json.dump(folded, f)
+        n_series = sum(len(s.get("series") or {})
+                       for s in folded["stores"].values())
+        print(f"timeseries: {n_series} series from {len(folded['stores'])} "
+              f"stores -> {ts_out}")
     return 0
 
 
@@ -252,14 +277,143 @@ def run_smoke(np_: int, plan: str, total_samples: int, timeout_s: float) -> int:
     return 0
 
 
+# -- SLO drill -------------------------------------------------------------------------
+
+
+def run_slo_drill(np_: int = 2, timeout_s: float = 240.0) -> int:
+    """2-rank SLO drill: a chaos slow@ window must drive a SUSTAINED
+    step-latency breach (journaled slo_breach, /slo shows the rule
+    active), the breach must CLEAR after the window passes (slo_cleared),
+    /history must carry the windowed p99 series that drove it, and under
+    -slo-exit-code the otherwise-clean launcher must exit SLO_EXIT_CODE."""
+    from .slo import SLO_EXIT_CODE
+
+    telem = tempfile.mkdtemp(prefix="kft-slo-drill-")
+    rule_name = "drill_step_latency_p99"
+    slo_file = os.path.join(telem, "slo.json")
+    with open(slo_file, "w") as f:
+        json.dump({"rules": [{
+            "name": rule_name,
+            "metric": "hist:step_latency_ms:p99",
+            "op": "<=", "threshold": 50.0,
+            "sustain_s": 2.0, "clear_s": 2.0, "severity": "page",
+            "description": "drill: fake-trainer step p99 stays under 50 ms",
+        }]}, f)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["KFT_JOURNAL_DIR"] = telem
+    env["KFT_TRACE_DUMP_DIR"] = telem
+    env["KFT_SLO_FILE"] = slo_file
+    env["KFT_TS_INTERVAL_S"] = "0.5"
+    # phase 1 (steps 10..): 300 ms steps, p99 >> 50 ms -> sustained breach;
+    # phase 2: 25 ms steps, under the threshold but slow enough in wall
+    # time that the sampler sees several healthy windows -> cleared.  The
+    # windowed-delta percentile is what makes the clear possible at all —
+    # a lifetime p99 would stay pinned at 300 ms forever.
+    plan = ("slow@step=10:rank=0:ms=300:steps=25;"
+            "slow@step=40:rank=0:ms=25:steps=400")
+    env["KFT_FAULT_PLAN"] = plan
+    total = 32 * np_ * 470
+    cmd = [
+        sys.executable, "-m", "kungfu_tpu.run", "-w", "-heal", "-telemetry",
+        "-slo-exit-code", "-np", str(np_), "-platform", "cpu", "-port", "0",
+        "-timeout", str(int(timeout_s)),
+        "--", sys.executable, "-m", "kungfu_tpu.testing.fake_adaptive_trainer",
+        "--total-samples", str(total), "--batch-size", "32",
+    ]
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True, bufsize=1)
+    lines: List[str] = []
+    url_box: Dict[str, str] = {}
+
+    def pump():
+        assert proc.stdout is not None
+        for line in proc.stdout:
+            lines.append(line)
+            if line.startswith("TELEMETRY_URL:"):
+                url_box["url"] = line.split(":", 1)[1].strip()
+
+    threading.Thread(target=pump, daemon=True).start()
+
+    saw_active = saw_history = False
+    deadline = time.monotonic() + timeout_s + 30
+    while proc.poll() is None and time.monotonic() < deadline:
+        url = url_box.get("url")
+        if url:
+            try:
+                rep = json.loads(_http_get(f"{url}/slo", timeout=10))
+            except (OSError, ValueError):
+                rep = None
+            if rep and rule_name in (rep.get("active") or ()):
+                saw_active = True
+            if saw_active and not saw_history:
+                try:
+                    hist = json.loads(_http_get(
+                        f"{url}/history?series=hist:step_latency_ms",
+                        timeout=10))
+                except (OSError, ValueError):
+                    hist = None
+                if hist and any(k.startswith("hist:step_latency_ms")
+                                for k in (hist.get("series") or {})):
+                    saw_history = True
+        time.sleep(0.4)
+    try:
+        rc = proc.wait(timeout=60)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        rc = -9
+
+    failures: List[str] = []
+    if not saw_active:
+        failures.append(f"/slo never showed {rule_name} active mid-run")
+    if not saw_history:
+        failures.append("/history never served the step-latency p99 series")
+    if rc != SLO_EXIT_CODE:
+        failures.append(f"launcher exited {rc}, want SLO exit code "
+                        f"{SLO_EXIT_CODE} (-slo-exit-code armed, breach "
+                        "sustained)")
+    from .journal import merge_journals
+
+    events = merge_journals(
+        sorted(glob.glob(os.path.join(telem, "journal-*.jsonl"))))
+    breaches = [e for e in events if e.get("event") == "slo_breach"
+                and e.get("rule") == rule_name]
+    clears = [e for e in events if e.get("event") == "slo_cleared"
+              and e.get("rule") == rule_name]
+    if not breaches:
+        failures.append("no slo_breach journal event for the drill rule")
+    if not clears:
+        failures.append("no slo_cleared journal event: the breach never "
+                        "cleared after the slow window passed")
+    if breaches and clears and clears[0]["t_wall"] <= breaches[0]["t_wall"]:
+        failures.append("slo_cleared precedes slo_breach")
+
+    if failures:
+        print("SLO DRILL FAILED: " + "; ".join(failures), file=sys.stderr)
+        print("--- launcher output tail ---\n" + "".join(lines[-60:]),
+              file=sys.stderr)
+        return 1
+    print(f"SLO DRILL OK: rule {rule_name} breached "
+          f"(journaled, /slo active, exit code {rc}) and cleared after the "
+          f"slow window; /history served the driving p99 series "
+          f"(artifacts in {telem})")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="kungfu_tpu.monitor")
     ap.add_argument("--merge", metavar="DIR", default="",
-                    help="offline-merge journal-*.jsonl + trace-*.json in DIR")
+                    help="offline-merge journal-*.jsonl + trace-*.json + "
+                         "timeseries-*.json in DIR")
     ap.add_argument("--trace-out", default="", help="merged trace path")
     ap.add_argument("--journal-out", default="", help="merged journal path")
     ap.add_argument("--smoke", action="store_true",
                     help="run the end-to-end telemetry smoke (CPU, subprocesses)")
+    ap.add_argument("--slo-drill", action="store_true",
+                    help="run the 2-rank SLO drill: chaos slow@ must drive "
+                         "a sustained slo_breach that clears after the "
+                         "window, with a nonzero -slo-exit-code exit")
     ap.add_argument("--np", type=int, default=2)
     # the slow window holds BOTH ranks alive for seconds of real training
     # (fake steps run sub-ms on CPU) so the mid-run fleet scrape provably
@@ -276,7 +430,9 @@ def main(argv=None) -> int:
         return run_merge(args.merge, args.trace_out, args.journal_out)
     if args.smoke:
         return run_smoke(args.np, args.plan, args.total_samples, args.timeout)
-    ap.error("pick a mode: --merge DIR or --smoke")
+    if args.slo_drill:
+        return run_slo_drill(args.np, args.timeout)
+    ap.error("pick a mode: --merge DIR, --smoke or --slo-drill")
     return 2
 
 

@@ -121,6 +121,41 @@ class Histogram:
         return self.max  # pragma: no cover - unreachable (counts sum to count)
 
 
+# HELP strings for the exposition format — a real Prometheus scraping the
+# worker/fleet endpoints unmodified expects `# HELP` + `# TYPE` per family
+# (text format version 0.0.4).  Unknown families get a generic line.
+METRIC_HELP: Dict[str, str] = {
+    "egress_total_bytes": "Bytes sent per peer/op at the session boundary.",
+    "ingress_total_bytes": "Bytes received per peer at the session boundary.",
+    "egress_rate_bytes_per_sec": "Windowed egress byte rate per peer.",
+    "ingress_rate_bytes_per_sec": "Windowed ingress byte rate per peer.",
+    "collective_logical_total_bytes":
+        "Uncompressed collective payload bytes per op.",
+    "collective_wire_total_bytes":
+        "Bytes the chosen wire format actually moved per op.",
+    "collective_compression_ratio": "logical/wire bytes per op (gauge).",
+    "collective_quantization_error":
+        "Last relative L2 quantization error per op (gauge).",
+    "kungfu_events_total": "Lifecycle event counts by event kind.",
+    "kungfu_gauge": "Last observed value of a named gauge.",
+    "step_latency_ms": "Per-step wall latency histogram (ms).",
+    "collective_latency_ms": "Per-collective wall latency histogram (ms).",
+    "collective_overlap":
+        "Bucketed gradient-sync dispatch-to-ready latency histogram (ms).",
+    "kungfu_fleet_ranks_scraped": "1 if the rank answered the fleet scrape.",
+    "kungfu_fleet_scrape_errors_total": "Failed fleet scrape fan-out fetches.",
+}
+
+
+def metric_help(name: str) -> str:
+    return METRIC_HELP.get(name, f"{name} (kungfu_tpu metric).")
+
+
+def help_and_type(name: str, kind: str) -> List[str]:
+    """The `# HELP` + `# TYPE` header pair for one metric family."""
+    return [f"# HELP {name} {metric_help(name)}", f"# TYPE {name} {kind}"]
+
+
 class Counters:
     """Named egress/ingress accumulators with Prometheus-text exposition."""
 
@@ -142,6 +177,10 @@ class Counters:
         # or ("collective_latency_ms", "grad-allreduce").  All writes/reads
         # go through the single Counters lock.
         self._hists: Dict[Tuple[str, str], Histogram] = {}
+        # incarnation epoch: reset_for_reinit bumps it so delta-based
+        # consumers (the time-series sampler) re-anchor instead of reading
+        # negative rates against a dead incarnation's totals
+        self._epoch = 0
 
     def _get(self, table: Dict[str, RateWindow], key: str) -> RateWindow:
         w = table.get(key)
@@ -248,6 +287,7 @@ class Counters:
             for table in (self._egress, self._ingress, self._logical, self._wire):
                 table.clear()
             self._hists.clear()
+            self._epoch += 1
 
     def snapshot_json(self) -> Dict:
         """JSON-serializable snapshot of every accumulator: byte totals,
@@ -258,6 +298,7 @@ class Counters:
         with self._lock:
             return {
                 "version": 1,
+                "epoch": self._epoch,
                 "window_s": self._window_s,
                 "egress": {k: w.total for k, w in self._egress.items()},
                 "ingress": {k: w.total for k, w in self._ingress.items()},
@@ -345,7 +386,8 @@ class Counters:
             ("egress_rate_bytes_per_sec", erate),
             ("ingress_rate_bytes_per_sec", irate),
         ):
-            lines.append(f"# TYPE {metric} {'counter' if 'total' in metric else 'gauge'}")
+            lines.extend(help_and_type(
+                metric, "counter" if "total" in metric else "gauge"))
             for key in sorted(table):
                 lines.append(f'{metric}{{peer="{key}"}} {table[key]}')
         ltot, wtot = self.wire_totals()
@@ -357,16 +399,16 @@ class Counters:
         ):
             if not table:
                 continue
-            lines.append(f"# TYPE {metric} {kind}")
+            lines.extend(help_and_type(metric, kind))
             for key in sorted(table):
                 lines.append(f'{metric}{{op="{key}"}} {table[key]}')
         ev, ga = self.events(), self.gauges()
         if ev:
-            lines.append("# TYPE kungfu_events_total counter")
+            lines.extend(help_and_type("kungfu_events_total", "counter"))
             for key in sorted(ev):
                 lines.append(f'kungfu_events_total{{event="{key}"}} {ev[key]}')
         if ga:
-            lines.append("# TYPE kungfu_gauge gauge")
+            lines.extend(help_and_type("kungfu_gauge", "gauge"))
             for key in sorted(ga):
                 lines.append(f'kungfu_gauge{{name="{key}"}} {ga[key]}')
         with self._lock:
@@ -379,7 +421,7 @@ class Counters:
         for metric, label, cum, hsum, hcount in hists:
             if metric not in seen_types:
                 seen_types.add(metric)
-                lines.append(f"# TYPE {metric} histogram")
+                lines.extend(help_and_type(metric, "histogram"))
             lab = f'op="{label}",' if label else ""
             for le, c in cum:
                 lines.append(f'{metric}_bucket{{{lab}le="{le}"}} {c}')

@@ -18,6 +18,12 @@ single endpoint over the whole job:
   /stragglers  the straggler observatory's merged report (monitor.straggler):
              per-rank compute/data-wait/collective-wait attribution, arrival
              skew + suspicion flags, DCN/ICI hotspot, input starvation.
+  /history   the fleet time-series store (monitor.timeseries): the fleet
+             sampler's merged-scrape history as JSON series, fleet-summed
+             by default, `?split=rank` / `?rank=N` for the per-rank view,
+             `?series=<prefix>` to filter.
+  /slo       the SLO rule engine's evaluated state (monitor.slo): per-rule
+             breached/no_data, active breaches, lifetime breach_total.
 
 Scrapes fan out in PARALLEL with a per-target timeout, so one wedged worker
 costs one timeout — not a timeout per wedged rank serialized — and can never
@@ -30,15 +36,18 @@ picked up by the next request via `targets_fn`.
 from __future__ import annotations
 
 import json
+import os
 import re
 import threading
 import time
+import urllib.parse
 import urllib.request
 from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Callable, Dict, List, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 from ..utils import get_logger
+from .counters import help_and_type
 from .server import monitor_port
 
 log = get_logger("kungfu.fleet")
@@ -111,13 +120,17 @@ def _series_sort_key(key):
     return (name, tuple(lab_key(kv) for kv in labels))
 
 
-def merge_prometheus(texts: Dict[int, str]) -> str:
+def merge_prometheus(texts: Dict[int, str],
+                     all_ranks: Optional[Set[int]] = None) -> str:
     """Merge per-rank exposition bodies into the fleet body.
 
     Counters keep their exact per-worker name+labels with the SUM across
     ranks as the value (the fleet counter == sum of worker counters), plus
     a per-rank breakdown with an added rank label.  Gauges get agg="min/
-    max/avg" series plus the per-rank breakdown.
+    max/avg" series plus the per-rank breakdown.  `all_ranks` names every
+    TARGETED rank — the `kungfu_fleet_ranks_scraped` series is a complete
+    0/1 reachability signal, emitted exactly once (a real Prometheus
+    rejects duplicate metric families in one exposition).
     """
     types: Dict[str, str] = {}
     # (name, labels) -> {rank: value}
@@ -129,9 +142,10 @@ def merge_prometheus(texts: Dict[int, str]) -> str:
             merged.setdefault(key, {})[rank] = v
 
     lines: List[str] = []
-    lines.append("# TYPE kungfu_fleet_ranks_scraped gauge")
-    for rank in sorted(texts):
-        lines.append(f'kungfu_fleet_ranks_scraped{{rank="{rank}"}} 1')
+    lines.extend(help_and_type("kungfu_fleet_ranks_scraped", "gauge"))
+    for rank in sorted(all_ranks if all_ranks is not None else set(texts)):
+        up = 1 if rank in texts else 0
+        lines.append(f'kungfu_fleet_ranks_scraped{{rank="{rank}"}} {up}')
 
     emitted_types = set()
     for (name, labels) in sorted(merged, key=_series_sort_key):
@@ -139,9 +153,11 @@ def merge_prometheus(texts: Dict[int, str]) -> str:
         for suffix in ("_bucket", "_sum", "_count"):
             if name.endswith(suffix) and types.get(name[: -len(suffix)]) == "histogram":
                 base = name[: -len(suffix)]
+        if base == "kungfu_fleet_ranks_scraped":
+            continue  # already emitted as the complete 0/1 series above
         if base not in emitted_types:
             emitted_types.add(base)
-            lines.append(f"# TYPE {base} {types.get(base, 'gauge')}")
+            lines.extend(help_and_type(base, types.get(base, "gauge")))
         per_rank = merged[(name, labels)]
         lab = ",".join(f'{k}="{v}"' for k, v in labels)
         kind = _series_kind(name, types)
@@ -203,7 +219,8 @@ class FleetAggregator:
     """
 
     def __init__(self, targets_fn: Callable[[], Targets],
-                 host: str = "0.0.0.0", port: int = 0, timeout_s: float = 3.0):
+                 host: str = "0.0.0.0", port: int = 0, timeout_s: float = 3.0,
+                 slo_rules=None, sample_interval_s: Optional[float] = None):
         self.targets_fn = targets_fn
         self.timeout_s = timeout_s
         self._scrape_errors = 0
@@ -213,11 +230,31 @@ class FleetAggregator:
         self._pool = ThreadPoolExecutor(max_workers=16,
                                         thread_name_prefix="kft-scrape")
         self._straggler = None  # monitor.straggler.StragglerMonitor, lazy
+        # fleet time-series store + SLO engine + sampler (the long-horizon
+        # layer: /history and /slo read these; the sampler thread fills
+        # them every KFT_TS_INTERVAL_S so breaches are detected even when
+        # nobody polls)
+        from .counters import global_counters
+        from .slo import SLOEngine, load_rules
+        from .timeseries import FleetSampler, TimeSeriesStore
+
+        self.ts_store = TimeSeriesStore()
+        self.slo_engine = SLOEngine(
+            self.ts_store,
+            rules=slo_rules if slo_rules is not None else load_rules(),
+            counters=global_counters(),
+        )
+        self._sampler = FleetSampler(
+            self, self.ts_store, engine=self.slo_engine,
+            interval_s=sample_interval_s, local_counters=global_counters(),
+        )
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):
-                path = self.path.rstrip("/")
+                split = urllib.parse.urlsplit(self.path)
+                path = split.path.rstrip("/")
+                query = urllib.parse.parse_qs(split.query)
                 try:
                     if path in ("", "/metrics"):
                         body = outer.merged_metrics().encode()
@@ -230,6 +267,12 @@ class FleetAggregator:
                         ctype = "application/json"
                     elif path == "/stragglers":
                         body = json.dumps(outer.straggler_report()).encode()
+                        ctype = "application/json"
+                    elif path == "/history":
+                        body = json.dumps(outer.history(query)).encode()
+                        ctype = "application/json"
+                    elif path == "/slo":
+                        body = json.dumps(outer.slo_report()).encode()
                         ctype = "application/json"
                     else:
                         self.send_response(404)
@@ -287,17 +330,15 @@ class FleetAggregator:
 
     def merged_metrics(self) -> str:
         bodies, errors = self.scrape("/metrics")
-        text = merge_prometheus(bodies)
-        text += "# TYPE kungfu_fleet_scrape_errors_total counter\n"
+        # per-rank reachability is emitted by merge_prometheus as ONE
+        # complete 0/1 series over every TARGETED rank: external pollers —
+        # the serving load balancer, an alerting rule — need "rank present
+        # and healthy" as a positive signal they can sum, and a compliant
+        # exposition allows each metric family exactly once
+        text = merge_prometheus(bodies, all_ranks=set(bodies) | set(errors))
+        text += "\n".join(help_and_type(
+            "kungfu_fleet_scrape_errors_total", "counter")) + "\n"
         text += f"kungfu_fleet_scrape_errors_total {self._scrape_errors}\n"
-        # per-rank reachability as a complete 0/1 series (not only the
-        # failures): external pollers — the serving load balancer, an
-        # alerting rule — need "rank present and healthy" to be a positive
-        # signal they can sum, not the absence of an error line
-        text += "# TYPE kungfu_fleet_ranks_scraped gauge\n"
-        for rank in sorted(set(bodies) | set(errors)):
-            up = 1 if rank in bodies else 0
-            text += f'kungfu_fleet_ranks_scraped{{rank="{rank}"}} {up}\n'
         return text
 
     def merged_timeline(self) -> Dict[str, Any]:
@@ -344,18 +385,59 @@ class FleetAggregator:
             "errors": {str(r): e for r, e in errors.items()},
         }
 
+    # -- time series + SLO ------------------------------------------------------------
+
+    def history(self, query: Optional[Dict[str, List[str]]] = None) -> Dict[str, Any]:
+        """The fleet time-series store as JSON (docs/observability.md).
+
+        Query params: `series=<prefix>` filters names, `split=rank`
+        includes the per-rank `...@N` splits, `rank=N` selects one rank's
+        splits only.  Default: the fleet-summed view."""
+        from .timeseries import sample_interval_s
+
+        query = query or {}
+        prefix = (query.get("series") or [""])[0]
+        rank = None
+        if query.get("rank"):
+            try:
+                rank = int(query["rank"][0])
+            except ValueError:
+                rank = None
+        include_ranks = (query.get("split") or [""])[0] == "rank"
+        snap = self.ts_store.snapshot(prefix=prefix,
+                                      include_ranks=include_ranks, rank=rank)
+        snap["interval_s"] = self._sampler.interval_s or sample_interval_s()
+        snap["ticks"] = self._sampler.ticks
+        return snap
+
+    def slo_report(self) -> Dict[str, Any]:
+        """One SLO evaluation + report — `/slo`.  Evaluation is per-sample
+        idempotent, so polling faster than the sampler is safe."""
+        return self.slo_engine.evaluate()
+
+    def slo_breach_total(self) -> int:
+        return self.slo_engine.breach_total
+
     # -- lifecycle --------------------------------------------------------------------
 
     def start(self) -> "FleetAggregator":
         self._thread.start()
-        log.info("fleet telemetry on http://%s:%d/metrics (+ /timeline)",
-                 self.host, self.port)
+        self._sampler.start()
+        log.info("fleet telemetry on http://%s:%d/metrics (+ /timeline, "
+                 "/history, /slo)", self.host, self.port)
         return self
 
     def close(self) -> None:
         if self._closed:
             return
         self._closed = True
+        self._sampler.close()
+        # on-exit dump: the fleet's metric history survives the job for
+        # `python -m kungfu_tpu.monitor --merge` forensics
+        d = (os.environ.get("KFT_TRACE_DUMP_DIR")
+             or os.environ.get("KFT_JOURNAL_DIR"))
+        if d and self.ts_store.names():
+            self.ts_store.dump(os.path.join(d, "timeseries-fleet.json"))
         if self._thread.is_alive():
             self._srv.shutdown()
         self._srv.server_close()

@@ -24,6 +24,15 @@ label set via set_journal_context, else the pid).  `kungfu-run -telemetry`
 sets the dir for the launcher and every worker.  With neither env set,
 journal_event is a no-op costing one dict lookup.
 
+Size control: `KFT_JOURNAL_MAX_MB` caps each journal file — when an emit
+pushes the file past the cap it rotates (`.2` dropped, `.1` -> `.2`,
+live -> `.1`, all atomic renames, then a fresh live file), so a 64+-rank
+fleet's journal volume (ROADMAP item 1's open stressor) is bounded at
+~3x the cap per process instead of unbounded.  Readers walk rotated
+segments oldest-first: `segment_paths` / `read_journal_segments`, and
+`merge_journals` + `python -m kungfu_tpu.monitor --merge` fold them in
+automatically.
+
 Offline: read_journal / merge_journals, and `python -m kungfu_tpu.monitor
 --merge <dir>` for a dead job's files.
 """
@@ -41,6 +50,16 @@ log = get_logger("kungfu.journal")
 
 JOURNAL_FILE_ENV = "KFT_JOURNAL_FILE"
 JOURNAL_DIR_ENV = "KFT_JOURNAL_DIR"
+JOURNAL_MAX_MB_ENV = "KFT_JOURNAL_MAX_MB"  # per-file cap; 0/unset = unbounded
+ROTATE_KEEP = 2  # rotated segments kept per journal (.1 newer, .2 older)
+
+
+def _max_bytes_from_env() -> int:
+    try:
+        v = os.environ.get(JOURNAL_MAX_MB_ENV, "")
+        return max(0, int(float(v) * 1024 * 1024)) if v else 0
+    except ValueError:
+        return 0
 
 # late-bound identity stamps: Peer.start()/update_cluster refresh rank and
 # cluster_version; the launcher labels itself "launcher"
@@ -61,15 +80,39 @@ def set_journal_context(rank: Optional[Union[int, str]] = None,
 
 class Journal:
     """One append-only JSONL file; every emit is flushed (events must
-    survive an os._exit two lines later)."""
+    survive an os._exit two lines later).  With a size cap, the file
+    rotates through `.1`/`.2` suffixes via atomic renames — an emit
+    landing mid-rotation still goes to A journal, never to a closed fd."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, max_bytes: Optional[int] = None):
         self.path = path
+        self.max_bytes = (_max_bytes_from_env() if max_bytes is None
+                          else max(0, int(max_bytes)))
+        self.rotations = 0
         self._lock = threading.Lock()
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
         self._f = open(path, "a", encoding="utf-8")
+
+    def _rotate_locked(self) -> None:
+        """Shift segments (oldest dropped by the `.1` -> `.2` replace) and
+        reopen a fresh live file.  Rename failures abort the rotation but
+        never the emit — a full disk loses history, not events."""
+        try:
+            self._f.close()
+        except OSError:  # pragma: no cover
+            pass
+        try:
+            for i in range(ROTATE_KEEP, 1, -1):
+                older = f"{self.path}.{i - 1}"
+                if os.path.exists(older):
+                    os.replace(older, f"{self.path}.{i}")
+            os.replace(self.path, f"{self.path}.1")
+            self.rotations += 1
+        except OSError as e:
+            log.warning("journal rotation of %s failed: %s", self.path, e)
+        self._f = open(self.path, "a", encoding="utf-8")
 
     def emit(self, event: str, **fields: Any) -> None:
         from ..utils.trace import job_now
@@ -86,6 +129,8 @@ class Journal:
         with self._lock:
             self._f.write(line + "\n")
             self._f.flush()
+            if self.max_bytes and self._f.tell() >= self.max_bytes:
+                self._rotate_locked()
 
     def close(self) -> None:
         with self._lock:
@@ -156,6 +201,25 @@ def _reset_for_tests() -> None:
 # -- readers ---------------------------------------------------------------------------
 
 
+def segment_paths(path: str) -> List[str]:
+    """Every existing segment of one journal, OLDEST first (`.2`, `.1`,
+    then the live file) — the order that keeps per-process event order
+    intact across rotations."""
+    out = [f"{path}.{i}" for i in range(ROTATE_KEEP, 0, -1)
+           if os.path.exists(f"{path}.{i}")]
+    if os.path.exists(path):
+        out.append(path)
+    return out
+
+
+def read_journal_segments(path: str) -> List[Dict[str, Any]]:
+    """read_journal across every rotated segment, oldest first."""
+    out: List[Dict[str, Any]] = []
+    for p in segment_paths(path):
+        out.extend(read_journal(p))
+    return out
+
+
 def read_journal(path: str) -> List[Dict[str, Any]]:
     """Parse one JSONL journal; malformed lines (torn writes from a killed
     process) are skipped, not fatal."""
@@ -175,11 +239,14 @@ def read_journal(path: str) -> List[Dict[str, Any]]:
 def merge_journals(paths: Sequence[str]) -> List[Dict[str, Any]]:
     """Merge several processes' journals into one wall-clock-ordered list
     (wall time is the only cross-host merge key; per-host ordering is
-    already correct within each file)."""
+    already correct within each file).  Each path's rotated segments
+    (`.1`/`.2`) are folded in automatically, oldest first."""
     events: List[Dict[str, Any]] = []
     for p in paths:
         try:
-            events.extend(read_journal(p))
+            segs = segment_paths(p) or [p]
+            for seg in segs:
+                events.extend(read_journal(seg))
         except OSError as e:
             log.warning("skipping unreadable journal %s: %s", p, e)
     events.sort(key=lambda e: e.get("t_wall", 0.0))

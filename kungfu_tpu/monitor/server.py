@@ -6,14 +6,17 @@ the port offset differs (16000) to stay clear of the store (+15000) and the
 jax.distributed coordinator (+20000) while remaining below the Linux
 ephemeral range.
 
-Besides /metrics the endpoint serves /trace: this worker's span ring buffer
-(utils.trace) as Chrome-trace JSON — the per-rank feed the launcher-side
-fleet aggregator (monitor.fleet) merges into one timeline.
+Besides /metrics the endpoint serves /trace — this worker's span ring
+buffer (utils.trace) as Chrome-trace JSON, the per-rank feed the
+launcher-side fleet aggregator (monitor.fleet) merges into one timeline —
+and /history: this worker's self-sampled time-series store
+(monitor.timeseries; `?series=<prefix>` filters by name prefix).
 """
 from __future__ import annotations
 
 import json
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -43,14 +46,18 @@ class MonitorServer:
     JSON of this worker's span buffer)."""
 
     def __init__(self, counters: Optional[Counters] = None,
-                 host: str = "0.0.0.0", port: int = 0, trace_buffer=None):
+                 host: str = "0.0.0.0", port: int = 0, trace_buffer=None,
+                 ts_store=None):
         self.counters = counters if counters is not None else global_counters()
         self.trace_buffer = trace_buffer  # None = the global span buffer
+        self.ts_store = ts_store  # None = the global worker store
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):
-                path = self.path.rstrip("/")
+                split = urllib.parse.urlsplit(self.path)
+                path = split.path.rstrip("/")
+                query = urllib.parse.parse_qs(split.query)
                 if path in ("", "/metrics"):
                     body = outer.counters.prometheus_text().encode()
                     ctype = "text/plain; version=0.0.4"
@@ -61,6 +68,17 @@ class MonitorServer:
                     if buf is None:
                         buf = T.global_trace_buffer()
                     body = json.dumps(T.export_chrome_trace(buf)).encode()
+                    ctype = "application/json"
+                elif path == "/history":
+                    from . import timeseries as TS
+
+                    store = outer.ts_store
+                    if store is None:
+                        store = TS.worker_store()
+                    prefix = (query.get("series") or [""])[0]
+                    snap = store.snapshot(prefix=prefix)
+                    snap["interval_s"] = TS.sample_interval_s()
+                    body = json.dumps(snap).encode()
                     ctype = "application/json"
                 else:
                     self.send_response(404)
@@ -102,7 +120,14 @@ class MonitorServer:
 
 def maybe_start_monitor(worker_port: int, host: str = "0.0.0.0") -> Optional[MonitorServer]:
     """Start the endpoint iff KFT_CONFIG_ENABLE_MONITORING is set
-    (the reference's gate, peer.go:92-99)."""
+    (the reference's gate, peer.go:92-99).  Also arms the process-global
+    time-series self-sampler (monitor.timeseries) behind the same gate, so
+    every monitored worker serves `/history` — the sampler daemon is
+    process-global and survives the heal/resize teardown that closes and
+    re-binds this endpoint."""
     if not enabled():
         return None
+    from .timeseries import maybe_start_worker_sampler
+
+    maybe_start_worker_sampler()
     return MonitorServer(host=host, port=monitor_port(worker_port)).start()

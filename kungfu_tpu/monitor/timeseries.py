@@ -1,0 +1,646 @@
+"""Fleet time-series store — bounded metric history for trend analysis.
+
+The scrape endpoints (monitor.server / monitor.fleet) answer "what is the
+value NOW"; the failure modes that matter at pod scale — DCN hotspots,
+input starvation, stragglers, scaling regressions — only surface as
+*trends across time and world sizes* (the MLPerf TPU-v3 pod lesson).  This
+module gives every process a fixed-memory metric history:
+
+  Series          two-tier ring: a fine ring of recent (t, value) samples
+                  plus a coarse ring of downsampled retention — when the
+                  fine ring fills, its oldest `chunk` samples fold into ONE
+                  coarse point (t span + min/max/avg/count), so old history
+                  degrades in resolution, never in boundedness.
+  TimeSeriesStore named Series under one lock with a hard series cap
+                  (`KFT_TS_MAX_SERIES`; overflow is counted, not fatal),
+                  JSON snapshot/restore, and an atomic dump
+                  (tmp + rename — a kill mid-write never tears the file).
+  CountersSampler worker-side self-sampler over a `Counters`: gauges as-is,
+                  event counters as windowed RATES, histograms as windowed
+                  p50/p99 (bucket DELTAS between ticks, so a past slow
+                  window cannot pin the percentile forever).  Epoch-aware:
+                  `Counters.reset_for_reinit` after a heal re-rendezvous
+                  re-anchors every delta instead of producing negative
+                  rates.
+  FleetSampler    launcher-side sampler over the merged fleet scrape:
+                  fleet-summed series plus per-rank splits (`...@<rank>`),
+                  optional straggler-attribution feed, and the SLO engine
+                  hook (monitor.slo) evaluated every tick.
+
+Workers start their sampler next to the monitor endpoint (Peer.start →
+`maybe_start_worker_sampler`); the daemon is process-global so heals and
+resizes never duplicate or kill it.  `KFT_TS_INTERVAL_S` sets the tick
+(default 5 s, 0 disables).  On exit each process dumps its store to
+`timeseries-<identity>.json` in `KFT_TRACE_DUMP_DIR` (atomic), which
+`python -m kungfu_tpu.monitor --merge` folds into offline analysis.
+
+Series naming scheme (shared by both samplers and the SLO rule exprs):
+
+    gauge:<name>                  last observed gauge value
+    rate:<event>                  events/sec over the sampling interval
+    hist:<metric>:p50|p99         windowed percentile, unlabelled histogram
+    hist:<metric>[<label>]:p99    labelled histogram
+    <series>@<rank>               per-rank split (fleet store only)
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..utils import get_logger
+from ..utils.trace import job_now
+
+log = get_logger("kungfu.timeseries")
+
+INTERVAL_ENV = "KFT_TS_INTERVAL_S"
+FINE_ENV = "KFT_TS_FINE"            # fine ring capacity, samples
+COARSE_ENV = "KFT_TS_COARSE"        # coarse ring capacity, points
+MAX_SERIES_ENV = "KFT_TS_MAX_SERIES"
+
+DEFAULT_INTERVAL_S = 5.0
+DEFAULT_FINE = 512
+DEFAULT_COARSE = 256
+DEFAULT_MAX_SERIES = 512
+COARSE_CHUNK = 8  # fine samples folded per coarse point
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        v = os.environ.get(name, "")
+        return max(1, int(v)) if v else default
+    except ValueError:
+        return default
+
+
+def sample_interval_s() -> float:
+    """Configured sampling interval; 0 disables the samplers."""
+    try:
+        v = os.environ.get(INTERVAL_ENV, "")
+        return max(0.0, float(v)) if v else DEFAULT_INTERVAL_S
+    except ValueError:
+        return DEFAULT_INTERVAL_S
+
+
+class Series:
+    """One metric's bounded two-tier history.  Not internally locked —
+    TimeSeriesStore serializes access (the Counters discipline)."""
+
+    __slots__ = ("fine", "coarse", "chunk", "_fine_cap")
+
+    def __init__(self, fine_cap: int = DEFAULT_FINE,
+                 coarse_cap: int = DEFAULT_COARSE, chunk: int = COARSE_CHUNK):
+        self._fine_cap = max(2, int(fine_cap))
+        self.fine: deque = deque()  # (t, value)
+        self.coarse: deque = deque(maxlen=max(1, int(coarse_cap)))
+        self.chunk = max(1, int(chunk))
+
+    def append(self, t: float, value: float) -> None:
+        if len(self.fine) >= self._fine_cap:
+            self._fold()
+        self.fine.append((float(t), float(value)))
+
+    def _fold(self) -> None:
+        """Fold the oldest `chunk` fine samples into one coarse point."""
+        n = min(self.chunk, len(self.fine))
+        pts = [self.fine.popleft() for _ in range(n)]
+        ts = [p[0] for p in pts]
+        vs = [p[1] for p in pts]
+        # coarse deque is bounded: appending past maxlen drops the oldest
+        self.coarse.append((min(ts), max(ts), min(vs), max(vs),
+                            sum(vs) / len(vs), len(vs)))
+
+    def latest(self) -> Optional[Tuple[float, float]]:
+        return self.fine[-1] if self.fine else None
+
+    def recent(self, since_t: float) -> List[Tuple[float, float]]:
+        """Fine samples with t >= since_t, oldest first."""
+        return [p for p in self.fine if p[0] >= since_t]
+
+    def __len__(self) -> int:
+        return len(self.fine) + len(self.coarse)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "fine": [[round(t, 4), v] for t, v in self.fine],
+            "coarse": [[round(t0, 4), round(t1, 4), mn, mx, round(avg, 6), n]
+                       for t0, t1, mn, mx, avg, n in self.coarse],
+        }
+
+    @classmethod
+    def from_json(cls, obj: Dict[str, Any], **kw) -> "Series":
+        s = cls(**kw)
+        for row in obj.get("coarse") or []:
+            s.coarse.append(tuple(row))
+        for t, v in obj.get("fine") or []:
+            s.append(float(t), float(v))
+        return s
+
+
+class TimeSeriesStore:
+    """Named bounded series under one lock, with a hard series cap."""
+
+    def __init__(self, fine_cap: Optional[int] = None,
+                 coarse_cap: Optional[int] = None,
+                 max_series: Optional[int] = None):
+        self._lock = threading.Lock()
+        self._fine_cap = fine_cap if fine_cap is not None else _env_int(
+            FINE_ENV, DEFAULT_FINE)
+        self._coarse_cap = coarse_cap if coarse_cap is not None else _env_int(
+            COARSE_ENV, DEFAULT_COARSE)
+        self.max_series = max_series if max_series is not None else _env_int(
+            MAX_SERIES_ENV, DEFAULT_MAX_SERIES)
+        self._series: Dict[str, Series] = {}
+        self.dropped_series = 0
+
+    def record(self, name: str, t: float, value: float) -> None:
+        if value is None or not math.isfinite(float(value)):
+            return
+        with self._lock:
+            s = self._series.get(name)
+            if s is None:
+                if len(self._series) >= self.max_series:
+                    # bound memory against label explosions: new names past
+                    # the cap are counted, existing series keep recording
+                    self.dropped_series += 1
+                    return
+                s = self._series[name] = Series(self._fine_cap,
+                                                self._coarse_cap)
+            s.append(t, value)
+
+    def latest(self, name: str) -> Optional[Tuple[float, float]]:
+        with self._lock:
+            s = self._series.get(name)
+            return s.latest() if s is not None else None
+
+    def recent(self, name: str, since_t: float) -> List[Tuple[float, float]]:
+        with self._lock:
+            s = self._series.get(name)
+            return s.recent(since_t) if s is not None else []
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._series)
+
+    def snapshot(self, prefix: str = "", include_ranks: bool = False,
+                 rank: Optional[int] = None) -> Dict[str, Any]:
+        """JSON-serializable view.  `prefix` filters series names; the
+        default hides per-rank splits (`...@N`) — the fleet-summed view;
+        include_ranks=True keeps them, `rank` selects ONE rank's."""
+        with self._lock:
+            out: Dict[str, Any] = {}
+            for name, s in sorted(self._series.items()):
+                base, _, r = name.partition("@")
+                if prefix and not base.startswith(prefix):
+                    continue
+                if rank is not None:
+                    if r != str(rank):
+                        continue
+                elif r and not include_ranks:
+                    continue
+                out[name] = s.to_json()
+            return {
+                "version": 1,
+                "series": out,
+                "dropped_series": self.dropped_series,
+            }
+
+    @classmethod
+    def from_snapshot(cls, snap: Dict[str, Any], **kw) -> "TimeSeriesStore":
+        store = cls(**kw)
+        with store._lock:
+            for name, obj in (snap.get("series") or {}).items():
+                store._series[name] = Series.from_json(
+                    obj, fine_cap=store._fine_cap,
+                    coarse_cap=store._coarse_cap)
+        return store
+
+    def dump(self, path: str) -> Optional[str]:
+        """Atomic write (tmp + rename); returns the path or None on IO
+        error — a dump must never take the process down."""
+        try:
+            d = os.path.dirname(path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            tmp = f"{path}.tmp{os.getpid()}"
+            with open(tmp, "w") as f:
+                # the dump is the full record, rank splits included
+                json.dump(self.snapshot(include_ranks=True), f)
+            os.replace(tmp, path)
+            return path
+        except OSError as e:
+            log.warning("timeseries dump to %s failed: %s", path, e)
+            return None
+
+
+# -- percentiles from bucket deltas ----------------------------------------------------
+
+
+def percentile_from_buckets(pairs: Sequence[Tuple[float, float]],
+                            p: float) -> Optional[float]:
+    """Percentile estimate from NON-cumulative (upper_bound, count) pairs,
+    linearly interpolated inside the containing bucket (the straggler
+    hotspot's p50 scheme generalized to any p).  None with no counts."""
+    total = sum(c for _, c in pairs)
+    if total <= 0:
+        return None
+    rank = max(1, math.ceil(min(max(p, 0.0), 1.0) * total))
+    cum = 0.0
+    lo = 0.0
+    for bound, c in pairs:
+        if c and cum + c >= rank:
+            hi = bound if math.isfinite(bound) else (lo * 2 or 1.0)
+            return lo + (hi - lo) * (rank - cum) / c
+        cum += c
+        if math.isfinite(bound):
+            lo = bound
+    return lo
+
+
+def _decumulate(buckets: Dict[float, float]) -> List[Tuple[float, float]]:
+    """{upper_bound: cumulative_count} -> sorted non-cumulative pairs."""
+    out: List[Tuple[float, float]] = []
+    prev = 0.0
+    for bound in sorted(buckets):
+        out.append((bound, buckets[bound] - prev))
+        prev = buckets[bound]
+    return out
+
+
+def _delta_pairs(cur: Dict[float, float],
+                 prev: Optional[Dict[float, float]]) -> List[Tuple[float, float]]:
+    """Windowed non-cumulative bucket counts between two cumulative
+    snapshots; negative deltas (a reset mid-window) read as a fresh
+    anchor — the current snapshot alone."""
+    cur_pairs = _decumulate(cur)
+    if prev is None:
+        return cur_pairs
+    prev_pairs = dict(_decumulate(prev))
+    out: List[Tuple[float, float]] = []
+    for bound, c in cur_pairs:
+        d = c - prev_pairs.get(bound, 0.0)
+        if d < 0:
+            return cur_pairs  # reset: re-anchor on the new epoch
+        out.append((bound, d))
+    return out
+
+
+# -- worker-side sampler ---------------------------------------------------------------
+
+
+HIST_PCTS = ((0.50, "p50"), (0.99, "p99"))
+
+
+def hist_series_name(metric: str, label: str, pct: str) -> str:
+    return (f"hist:{metric}[{label}]:{pct}" if label
+            else f"hist:{metric}:{pct}")
+
+
+class CountersSampler:
+    """Self-sample one `Counters` into a TimeSeriesStore.
+
+    Every `sample_once` records gauges as-is, event-counter RATES over the
+    tick, and windowed histogram p50/p99 from cumulative-bucket deltas.
+    Epoch-aware: `reset_for_reinit` (heal re-rendezvous) bumps the counter
+    epoch, and the sampler re-anchors every delta instead of emitting
+    negative rates or percentiles of a dead incarnation."""
+
+    def __init__(self, counters, store: TimeSeriesStore,
+                 clock: Callable[[], float] = job_now):
+        self.counters = counters
+        self.store = store
+        self.clock = clock
+        self._prev_t: Optional[float] = None
+        self._prev_events: Dict[str, int] = {}
+        self._prev_hists: Dict[Tuple[str, str], Dict[float, float]] = {}
+        self._epoch: Optional[int] = None
+
+    def sample_once(self, now: Optional[float] = None) -> None:
+        t = self.clock() if now is None else float(now)
+        snap = self.counters.snapshot_json()
+        epoch = snap.get("epoch", 0)
+        if self._epoch is not None and epoch != self._epoch:
+            # the counters were reset (heal): distributions restarted, so
+            # every delta anchor from the old incarnation is poison
+            self._prev_hists.clear()
+            self._prev_events = {}
+            self._prev_t = None
+        self._epoch = epoch
+
+        for name, v in (snap.get("gauges") or {}).items():
+            self.store.record(f"gauge:{name}", t, v)
+
+        events = snap.get("events") or {}
+        if self._prev_t is not None and t > self._prev_t:
+            dt = t - self._prev_t
+            for name, total in events.items():
+                delta = total - self._prev_events.get(name, 0)
+                if delta >= 0:
+                    self.store.record(f"rate:{name}", t, delta / dt)
+        self._prev_events = dict(events)
+
+        for h in snap.get("hists") or []:
+            metric, label = h["metric"], h.get("label", "")
+            bounds = list(h["bounds"]) + [float("inf")]
+            cum: Dict[float, float] = {}
+            running = 0.0
+            for b, c in zip(bounds, h["counts"]):
+                running += c
+                cum[b] = running
+            key = (metric, label)
+            pairs = _delta_pairs(cum, self._prev_hists.get(key))
+            self._prev_hists[key] = cum
+            if sum(c for _, c in pairs) <= 0:
+                continue  # no new observations this tick: stay silent
+            for p, tag in HIST_PCTS:
+                v = percentile_from_buckets(pairs, p)
+                if v is not None:
+                    self.store.record(hist_series_name(metric, label, tag),
+                                      t, v)
+        self._prev_t = t
+
+
+# -- process-global worker sampler -----------------------------------------------------
+
+
+_worker_store: Optional[TimeSeriesStore] = None
+_worker_thread: Optional[threading.Thread] = None
+_worker_lock = threading.Lock()
+
+
+def worker_store() -> TimeSeriesStore:
+    """The process-wide store the worker sampler fills and `/history`
+    serves (monitor.server)."""
+    global _worker_store
+    if _worker_store is None:
+        with _worker_lock:
+            if _worker_store is None:
+                _worker_store = TimeSeriesStore()
+    return _worker_store
+
+
+def _dump_identity() -> str:
+    spec = os.environ.get("KFT_SELF_SPEC", "")
+    if spec:
+        return spec.replace(":", "-").replace("/", "-")
+    return f"pid{os.getpid()}"
+
+
+def dump_worker_store(reason: str = "exit") -> Optional[str]:
+    """Write this process's store to KFT_TRACE_DUMP_DIR, atomically —
+    the artifact `python -m kungfu_tpu.monitor --merge` folds in."""
+    d = os.environ.get("KFT_TRACE_DUMP_DIR")
+    store = _worker_store
+    if not d or store is None or not store.names():
+        return None
+    return store.dump(os.path.join(d, f"timeseries-{_dump_identity()}.json"))
+
+
+def maybe_start_worker_sampler() -> Optional[TimeSeriesStore]:
+    """Start the process-global self-sampler daemon (idempotent).
+
+    Gated exactly like the monitor endpoint (KFT_CONFIG_ENABLE_MONITORING)
+    plus KFT_TS_INTERVAL_S > 0.  The thread is process-global and samples
+    `global_counters()`, so elastic heals/resizes — which tear down and
+    rebuild the Peer and its monitor server — neither kill nor duplicate
+    it; the epoch re-anchor in CountersSampler absorbs the
+    reset_for_reinit each heal performs."""
+    global _worker_thread
+    from .server import enabled
+
+    interval = sample_interval_s()
+    if not enabled() or interval <= 0:
+        return None
+    store = worker_store()
+    with _worker_lock:
+        if _worker_thread is not None:
+            return store
+        from .counters import global_counters
+
+        sampler = CountersSampler(global_counters(), store)
+
+        def loop() -> None:  # pragma: no cover - timing loop; ticks are tested
+            while True:
+                time.sleep(interval)
+                try:
+                    sampler.sample_once()
+                except Exception as e:  # noqa: BLE001 - sampling never kills training
+                    log.warning("worker sampler tick failed: %s", e)
+
+        _worker_thread = threading.Thread(target=loop, daemon=True,
+                                          name="kft-ts-sampler")
+        _worker_thread.start()
+        if os.environ.get("KFT_TRACE_DUMP_DIR"):
+            import atexit
+
+            atexit.register(dump_worker_store)
+    return store
+
+
+def _reset_for_tests() -> None:
+    global _worker_store, _worker_thread
+    with _worker_lock:
+        _worker_store = None
+        _worker_thread = None  # the old daemon keeps its old store; harmless
+
+
+# -- fleet-side sampler ----------------------------------------------------------------
+
+
+class FleetSampler:
+    """Sample the merged fleet scrape into a TimeSeriesStore every tick.
+
+    Records fleet-summed counters as rates, fleet gauges (agg="avg") and
+    per-rank splits (`...@<rank>`), windowed histogram percentiles from the
+    fleet-summed `_bucket` deltas, optionally the straggler observatory's
+    attribution fractions, and local launcher-process gauges (the serving
+    router's `queue_depth` lives in the launcher, not in any worker) — then
+    evaluates the SLO engine so breaches are detected even when nobody
+    polls `/slo`."""
+
+    def __init__(self, aggregator, store: TimeSeriesStore, engine=None,
+                 interval_s: Optional[float] = None,
+                 local_counters=None, straggler: Optional[bool] = None,
+                 clock: Callable[[], float] = job_now):
+        self.aggregator = aggregator
+        self.store = store
+        self.engine = engine
+        self.interval_s = (sample_interval_s() if interval_s is None
+                          else float(interval_s))
+        self.local_counters = local_counters
+        self.straggler = (os.environ.get("KFT_TS_STRAGGLER", "1") != "0"
+                          if straggler is None else straggler)
+        self.clock = clock
+        self._prev_counters: Dict[str, float] = {}
+        self._prev_t: Optional[float] = None
+        self._prev_hists: Dict[str, Dict[float, float]] = {}
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.ticks = 0
+
+    # -- one tick ---------------------------------------------------------------------
+
+    def tick(self, now: Optional[float] = None) -> None:
+        t = self.clock() if now is None else float(now)
+        from .fleet import merge_prometheus
+
+        bodies, errors = self.aggregator.scrape("/metrics")
+        seen_gauges = set()
+        if bodies:
+            text = merge_prometheus(
+                bodies, all_ranks=set(bodies) | set(errors))
+            self._consume_text(text, t, seen_gauges)
+        self.store.record("gauge:ranks_up", t, float(len(bodies)))
+        if self.straggler:
+            self._sample_straggler(t)
+        if self.local_counters is not None:
+            for name, v in self.local_counters.gauges().items():
+                # fleet series win: a local gauge shadowed by a worker's
+                # identically-named one must not interleave two semantics
+                if f"gauge:{name}" not in seen_gauges:
+                    self.store.record(f"gauge:{name}", t, v)
+        self.ticks += 1
+        if self.engine is not None:
+            self.engine.evaluate(now=t)
+
+    def _consume_text(self, text: str, t: float, seen_gauges: set) -> None:
+        from .fleet import parse_prometheus, _series_kind
+
+        types, series = parse_prometheus(text)
+        hist_cums: Dict[str, Dict[float, float]] = {}
+        counter_cums: Dict[str, float] = {}
+        for (name, labels), v in series.items():
+            lab = dict(labels)
+            rank = lab.pop("rank", None)
+            agg = lab.pop("agg", None)
+            if name.startswith("kungfu_fleet_"):
+                continue
+            if name == "kungfu_gauge":
+                g = lab.get("name", "")
+                if rank is not None:
+                    self.store.record(f"gauge:{g}@{rank}", t, v)
+                elif agg in (None, "avg"):
+                    self.store.record(f"gauge:{g}", t, v)
+                    seen_gauges.add(f"gauge:{g}")
+                continue
+            if name == "kungfu_events_total":
+                ev = lab.get("event", "")
+                key = f"rate:{ev}@{rank}" if rank is not None else f"rate:{ev}"
+                counter_cums[key] = v
+                continue
+            base = name[:-len("_bucket")] if name.endswith("_bucket") else ""
+            if base and types.get(base) == "histogram":
+                if rank is not None:
+                    continue  # fleet-summed percentiles only: bound the work
+                le = lab.pop("le", "")
+                try:
+                    bound = float("inf") if le == "+Inf" else float(le)
+                except ValueError:
+                    continue
+                hkey = base
+                if lab:
+                    hkey = f"{base}[{','.join(f'{k}={v2}' for k, v2 in sorted(lab.items()))}]"
+                hist_cums.setdefault(hkey, {})[bound] = v
+                continue
+            if name.endswith("_sum") or name.endswith("_count"):
+                if types.get(name[:name.rfind('_')]) == "histogram":
+                    continue
+            if rank is not None or agg not in (None, "avg"):
+                continue  # rank/min/max splits of generic series: skip
+            label_sfx = (f"[{','.join(f'{k}={v2}' for k, v2 in sorted(lab.items()))}]"
+                         if lab else "")
+            if _series_kind(name, types) == "counter":
+                counter_cums[f"rate:{name}{label_sfx}"] = v
+            else:
+                self.store.record(f"gauge:{name}{label_sfx}", t, v)
+                seen_gauges.add(f"gauge:{name}{label_sfx}")
+
+        if self._prev_t is not None and t > self._prev_t:
+            dt = t - self._prev_t
+            for key, total in counter_cums.items():
+                delta = total - self._prev_counters.get(key, 0.0)
+                if delta >= 0:
+                    self.store.record(key, t, delta / dt)
+        self._prev_counters = counter_cums
+
+        for hkey, cum in hist_cums.items():
+            pairs = _delta_pairs(cum, self._prev_hists.get(hkey))
+            self._prev_hists[hkey] = cum
+            if sum(c for _, c in pairs) <= 0:
+                continue
+            for p, tag in HIST_PCTS:
+                v = percentile_from_buckets(pairs, p)
+                if v is not None:
+                    # hkey is "<metric>" or "<metric>[label]": splice the
+                    # percentile tag behind it
+                    self.store.record(f"hist:{hkey}:{tag}", t, v)
+        self._prev_t = t
+
+    def _sample_straggler(self, t: float) -> None:
+        """Feed the straggler observatory's attribution medians into the
+        store — the `collective_wait_frac` SLO rule's series."""
+        import statistics
+
+        try:
+            rep = self.aggregator.straggler_report()
+        except Exception as e:  # noqa: BLE001 - a sick rank must not stop sampling
+            log.debug("straggler feed skipped: %s", e)
+            return
+        fracs: Dict[str, List[float]] = {}
+        for r, st in (rep.get("ranks") or {}).items():
+            att = st.get("attribution")
+            if not att:
+                continue
+            for phase in ("compute_frac", "data_frac", "collective_wait_frac"):
+                fracs.setdefault(phase, []).append(att[phase])
+                self.store.record(f"gauge:{phase}@{r}", t, att[phase])
+        for phase, vals in fracs.items():
+            self.store.record(f"gauge:{phase}", t, statistics.median(vals))
+        self.store.record("gauge:stragglers_suspected", t,
+                          float(len(rep.get("suspected") or ())))
+
+    # -- lifecycle --------------------------------------------------------------------
+
+    def start(self) -> "FleetSampler":
+        if self._thread is not None or self.interval_s <= 0:
+            return self
+
+        def loop() -> None:  # pragma: no cover - timing loop; tick() is tested
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.tick()
+                except Exception as e:  # noqa: BLE001
+                    log.warning("fleet sampler tick failed: %s", e)
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="kft-fleet-sampler")
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+# -- offline merge ---------------------------------------------------------------------
+
+
+def merge_dumps(paths: Sequence[str]) -> Dict[str, Any]:
+    """Fold per-process `timeseries-*.json` dumps into one document keyed
+    by dump identity — the offline counterpart of the fleet `/history`."""
+    out: Dict[str, Any] = {"version": 1, "stores": {}}
+    for p in paths:
+        ident = os.path.splitext(os.path.basename(p))[0]
+        ident = ident.replace("timeseries-", "", 1)
+        try:
+            with open(p) as f:
+                out["stores"][ident] = json.load(f)
+        except (OSError, ValueError) as e:
+            log.warning("skipping unreadable timeseries dump %s: %s", p, e)
+    return out

@@ -81,6 +81,17 @@ def main(argv=None):
         "-telemetry-port", dest="telemetry_port", type=int, default=0,
         help="fleet telemetry port (0 = ephemeral, printed as TELEMETRY_URL)",
     )
+    ap.add_argument(
+        "-slo-file", dest="slo_file", default="",
+        help="JSON SLO rule file for the fleet engine (KFT_SLO_FILE; "
+             "default = the shipped rules, docs/observability.md)",
+    )
+    ap.add_argument(
+        "-slo-exit-code", dest="slo_exit_code", action="store_true",
+        help="exit nonzero when any SLO rule sustained a breach during the "
+             "run, even if the job itself succeeded (drills/CI; implies "
+             "-telemetry)",
+    )
     ap.add_argument("-config-server", dest="config_server", default="")
     ap.add_argument(
         "-builtin-config-server", dest="builtin_cs", action="store_true",
@@ -110,6 +121,10 @@ def main(argv=None):
 
     if args.heal:
         args.watch = True  # healing is a watch-mode capability
+    if args.slo_exit_code:
+        args.telemetry = True  # the SLO engine lives in the fleet aggregator
+    if args.slo_file:
+        os.environ["KFT_SLO_FILE"] = args.slo_file
 
     hosts = HostList.parse(args.hosts) if args.hosts else HostList.parse(f"127.0.0.1:{args.np}")
     cluster = Cluster.from_hostlist(hosts, args.np)
@@ -184,6 +199,14 @@ def main(argv=None):
             )
     finally:
         if fleet is not None:
+            if args.slo_exit_code:
+                from ..monitor.slo import resolve_exit_code
+
+                new_rc = resolve_exit_code(rc, fleet.slo_breach_total())
+                if new_rc != rc:
+                    print(f"SLO_BREACHED: {fleet.slo_breach_total()} sustained "
+                          f"breach(es); exiting {new_rc}", flush=True)
+                rc = new_rc
             fleet.close()
         if cs is not None:
             cs.stop()

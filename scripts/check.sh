@@ -111,6 +111,15 @@ echo "== straggler drill: slow rank fingered, not killed (CPU) =="
 # instead of killing it — the job finishes at full size
 JAX_PLATFORMS=cpu python -m kungfu_tpu.chaos --straggler-drill --timeout 240
 
+echo "== SLO drill: chaos slow@ drives a sustained breach that clears (CPU) =="
+# 2-rank fleet under -telemetry -slo-exit-code with a tight step-latency
+# SLO: the slow window must journal a sustained slo_breach (/slo shows the
+# rule active, /history serves the windowed p99 series that drove it), the
+# breach must clear after the window passes (slo_cleared), and the
+# otherwise-clean launcher must exit with the SLO exit code
+# (docs/observability.md)
+JAX_PLATFORMS=cpu python -m kungfu_tpu.monitor --slo-drill --timeout 240
+
 echo "== telemetry smoke: fleet aggregation + merged timeline (CPU) =="
 # 2-process run under -telemetry: fleet /metrics must merge both ranks
 # with consistent counter sums, /timeline must parse as valid Chrome trace

@@ -680,9 +680,11 @@ class TestBenchRunner:
         monkeypatch.setenv(J.JOURNAL_FILE_ENV, jpath)
         J._reset_for_tests()
         try:
+            # two verdicts per failed attempt: the initial probe AND its
+            # fresh-env second chance must both fail before a requeue
             rec = run_section(
                 Section(name="flaky", fn=lambda: {"value": 7}),
-                probe=self._probe(["tunnel wedged", None]),
+                probe=self._probe(["tunnel wedged", "still wedged", None]),
                 retries=2, sleep=lambda s: None,
             )
             assert rec["measured_this_run"] is True and rec["value"] == 7
@@ -702,7 +704,8 @@ class TestBenchRunner:
         try:
             rec = run_section(
                 Section(name="dead", fn=lambda: {"v": 1}),
-                probe=self._probe(["down"] * 3),
+                # 2 probe calls (initial + fresh-env retry) x 3 attempts
+                probe=self._probe(["down"] * 6),
                 retries=2, sleep=lambda s: None,
             )
             assert rec["measured_this_run"] is False
