@@ -922,6 +922,49 @@ def _measure_pallas():
     }
 
 
+def _measure_fused():
+    """The BENCH json's "fused" section (ROADMAP item 3's success
+    metric): the fused computation-collective kernels' A/B — all-gather-
+    matmul and matmul-reduce-scatter vs their unfused XLA references,
+    plus the FSDP-transformer step fused vs unfused — measured by
+    `--bench fused` through the measurement-resilient runner, each row
+    carrying the straggler observatory's compute/collective-wait
+    decomposition and the EFFECTIVE impl (off-TPU the fused arms report
+    the engaged fallback, never a fake kernel number).  Opt out with
+    KFT_BENCH_SKIP_FUSED=1."""
+    if os.environ.get("KFT_BENCH_SKIP_FUSED"):
+        return None
+
+    import tempfile
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    try:
+        from kungfu_tpu.benchmarks import runner as bench_runner
+
+        with tempfile.NamedTemporaryFile(suffix=".json") as f:
+            rec = bench_runner.run_section(
+                bench_runner.Section(
+                    name="fused",
+                    argv=[sys.executable, "-m", "kungfu_tpu.benchmarks",
+                          "--bench", "fused", "--steps", "6",
+                          "--out", f.name],
+                    out_json=f.name, timeout_s=420.0, cwd=repo,
+                ),
+                probe_timeout_s=60.0, retries=1, interval_s=2.0,
+            )
+    except Exception:  # never let the A/B probe sink the headline
+        return None
+    if not rec.get("measured_this_run"):
+        return {"measured_this_run": False, "error": rec.get("error")}
+    return {
+        "measured_this_run": True,
+        "ops": rec.get("ops"),
+        "fsdp_step": rec.get("fsdp_step"),
+        "fused_speedup_vs_unfused": rec.get("fused_speedup_vs_unfused"),
+        "fused_fallback_engaged": rec.get("fused_fallback_engaged"),
+    }
+
+
 def _measure_planner():
     """The BENCH json's "planner" section: the collective plan compiler's
     per-bucket A/B (kungfu_tpu.planner) — chosen plan, predicted vs
@@ -1079,6 +1122,7 @@ def main():
     serving = _measure_serving()
     planner = _measure_planner()
     pallas = _measure_pallas()
+    fused = _measure_fused()
     tuner = _measure_tuner()
     step_attribution = _measure_step_attribution()
     scaling = _measure_scaling()
@@ -1171,6 +1215,14 @@ def main():
                 # arms honestly report the engaged fallback) and the
                 # FSDP-transformer bucket_bytes overlap sweep
                 "pallas_collectives": pallas,
+                # fused computation-collective kernels (docs/pallas.md):
+                # all-gather-matmul / matmul-reduce-scatter vs their
+                # unfused references and the FSDP-transformer step fused
+                # vs unfused, each with the straggler observatory's
+                # compute/collective-wait decomposition attached — the
+                # collective_wait_frac driven toward zero IS ROADMAP
+                # item 3's success metric
+                "fused": fused,
                 # compute autotuner (docs/tuning.md): the chosen step
                 # config for the bench shape, predicted vs measured
                 # step_ms (rel_err = footprint-model honesty) and the

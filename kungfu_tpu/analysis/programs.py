@@ -467,6 +467,11 @@ def builtin_programs() -> List[Program]:
                            compression="int8"),
                 "Pallas ring with the int8 codec fused into the kernel "
                 "body (three-op XLA schedule off-TPU)"),
+        Program("session-pallas-fused-matmul", ("session",),
+                _b_session("PALLAS_FUSED_MATMUL", {"dp": 8}, 1),
+                "fused computation-collective strategy (its allreduce is "
+                "the pallas ring pair; the matmul fusion itself lives in "
+                "ops/fused_matmul + fsdp.py's gather/scatter paths)"),
         # parallel schedules
         Program("pipeline-gpipe", ("parallel",), _b_pipeline(1),
                 "GPipe schedule over the pp ring"),

@@ -21,7 +21,7 @@ def main(argv=None) -> int:
     p.add_argument("--bench", default="all_reduce",
                    choices=["all_reduce", "p2p", "attention", "compression",
                             "serving", "planner", "pallas", "tuner",
-                            "scaling"])
+                            "scaling", "fused"])
     p.add_argument("--sizes", default="1,2,4",
                    help="world sizes for --bench scaling")
     p.add_argument("--chaos-collective-ms", type=float, default=0.0,
@@ -93,6 +93,12 @@ def main(argv=None) -> int:
 
         bench_pallas(size=args.size, steps=args.steps, warmup=args.warmup,
                      out=args.out)
+        return 0
+
+    if args.bench == "fused":
+        from .fused import bench_fused
+
+        bench_fused(steps=args.steps, warmup=args.warmup, out=args.out)
         return 0
 
     if args.bench == "tuner":

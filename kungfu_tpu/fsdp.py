@@ -87,6 +87,19 @@ class FSDPTrainer:
              compute tuner's footprint table, resolved per model at
              trace time (optimizers/sync._resolve_bucket_bytes).
              Ignored without a dp axis.
+      dma_collectives: route the fsdp-axis unshard/scatter through the
+             Pallas DMA gather/scatter pair (ops/fused_matmul.py
+             dma_all_gather / dma_reduce_scatter): the forward weight
+             unshard rides the double-buffered DMA ring, and — because
+             the pair is each other's custom VJP — the backward gradient
+             reduce-scatter rides it too, overlapping hop h's transfer
+             with the compute consuming hop h-1 instead of serializing
+             the unshard against the matmuls.  The wrappers self-gate
+             (compat.pallas_mode + per-call shape/VMEM checks) and fall
+             back to the exact lax.all_gather/psum_scatter lowering, so
+             None/True is always safe; False keeps the legacy XLA
+             program (the unfused A/B control `--bench fused` measures
+             against).
       analyze: arm the kf-lint trace-time hook (kungfu_tpu.analysis): the
              compiled step is statically checked at its first train_step,
              raising AnalysisError before dispatch on error-severity
@@ -103,6 +116,7 @@ class FSDPTrainer:
         compression=None,
         analyze: Optional[bool] = None,
         bucket_bytes: Optional[int] = None,
+        dma_collectives: Optional[bool] = None,
     ):
         from . import compression as _compression_mod
         from .utils.envflag import analyze_enabled
@@ -125,6 +139,11 @@ class FSDPTrainer:
             bucket_bytes if bucket_bytes == "auto"
             else int(bucket_bytes) if bucket_bytes else None
         )
+        # None/"auto"/True -> the self-gating DMA wrappers (they fall back
+        # to the lax lowerings wherever the kernels can't run); False pins
+        # the legacy XLA program (the unfused bench control)
+        self.dma_collectives = (dma_collectives is not False
+                                and dma_collectives != "off")
         self._donate = donate
         self.loss_fn = loss_fn
         self.tx = tx
@@ -160,26 +179,44 @@ class FSDPTrainer:
     # -- step construction ------------------------------------------------------------
 
     def _gather_params(self, chunks):
-        """Per-device chunk views -> full params (tiled all_gather on fsdp)."""
+        """Per-device chunk views -> full params: the tiled all_gather on
+        fsdp, riding the Pallas DMA ring when armed (dma_collectives) —
+        whose custom VJP puts the backward reduce-scatter on the same
+        data plane — and the plain lax lowering otherwise."""
         shapes = self._shapes
+        use_dma = self.dma_collectives
 
         def gather(c, shape):
-            full = lax.all_gather(c.reshape(-1), "fsdp", tiled=True)
+            flat = c.reshape(-1)
+            if use_dma:
+                from .ops.fused_matmul import dma_all_gather
+
+                full = dma_all_gather(flat, "fsdp")
+            else:
+                full = lax.all_gather(flat, "fsdp", tiled=True)
             size = int(np.prod(shape)) if shape else 1
             return full[:size].reshape(shape)
 
         return jax.tree.map(gather, chunks, shapes)
 
     def _scatter_grads(self, grads):
-        """Full grads -> this device's summed chunk (reduce_scatter)."""
+        """Full grads -> this device's summed chunk (reduce_scatter on the
+        DMA ring when armed, lax.psum_scatter otherwise)."""
         n = self.n_shard
+        use_dma = self.dma_collectives
 
         def scatter(g):
             flat = g.reshape(-1)
             pad = (-flat.size) % n
             if pad:
                 flat = jnp.concatenate([flat, jnp.zeros(pad, flat.dtype)])
-            chunk = lax.psum_scatter(flat, "fsdp", scatter_dimension=0, tiled=True)
+            if use_dma:
+                from .ops.fused_matmul import dma_reduce_scatter
+
+                chunk = dma_reduce_scatter(flat, "fsdp")
+            else:
+                chunk = lax.psum_scatter(flat, "fsdp", scatter_dimension=0,
+                                         tiled=True)
             chunk = chunk / n
             if self.has_dp:
                 chunk = lax.pmean(chunk, "dp")

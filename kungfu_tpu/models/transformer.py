@@ -411,11 +411,18 @@ class Attention(nn.Module):
                 )
             else:
                 fn = partial(ring_attention, axis_name=cfg.sp_axis, causal=cfg.causal)
-            attn = _shard_map(
+            # the DMA KV rotation (ops.fused_matmul.ring_shift) traces a
+            # pallas_call, which has no replication rule: opt out of the
+            # rep/vma check exactly when it engages (Session precedent).
+            # compat.shard_map spells the check kwarg portably.
+            from .. import compat as _compat
+
+            attn = _compat.shard_map(
                 fn,
                 mesh=cfg.mesh,
                 in_specs=(spec, spec, spec),
                 out_specs=spec,
+                check_vma=False if _compat.pallas_mode() != "off" else None,
             )
             o = attn(q, k, v)
         elif kind == "flash":
@@ -438,7 +445,13 @@ class Attention(nn.Module):
                     "tp" if "tp" in names else None,
                     None,
                 )
-                attn = _shard_map(
+                # a pallas_call has no replication rule: opt out of the
+                # rep/vma check exactly when the flash kernels engage
+                # (compiled on TPU or KFT_PALLAS=interpret; the XLA
+                # reference path keeps the check)
+                from .. import compat as _compat
+
+                attn = _compat.shard_map(
                     partial(flash_attention, causal=cfg.causal,
                             window=cfg.window or None,
                             block_q=bq, block_k=bk,
@@ -446,6 +459,8 @@ class Attention(nn.Module):
                     mesh=cfg.mesh,
                     in_specs=(spec, spec, spec),
                     out_specs=spec,
+                    check_vma=(False if _compat.pallas_mode() != "off"
+                               else None),
                 )
                 o = attn(q, k, v)
             else:

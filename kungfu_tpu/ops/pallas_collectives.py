@@ -88,9 +88,30 @@ def _supported_dtype(dtype) -> bool:
     return jnp.dtype(dtype) in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16))
 
 
-def _ring_ok(n: int, chunk: int, dtype,
+def _sole_named_axis(axis_name) -> bool:
+    """True when `axis_name` is the ONLY named mesh axis in scope.
+
+    The ring kernels address their neighbor with a scalar LOGICAL
+    device_id, which is only well-defined (and only implemented by the
+    Pallas DMA lowering/discharge) for a single named axis — the same
+    condition under which Session routes a pallas strategy to the
+    kernels (`len(self._axes) == 1`).  On a multi-axis manual region
+    (e.g. an fsdp ring inside a dp×fsdp shard_map) the wrappers fall
+    back to the lax lowering instead of building an untraceable kernel.
+    Best-effort introspection: unknown ⇒ False (fallback, never wedge).
+    """
+    try:
+        from jax._src import core as _jcore
+
+        names = tuple(_jcore.get_axis_env().axis_sizes.keys())
+    except Exception:
+        return False
+    return names == (axis_name,)
+
+
+def _ring_ok(n: int, chunk: int, dtype, axis_name,
              cfg: Optional[CompressionConfig] = None) -> bool:
-    if n <= 1:
+    if n <= 1 or not _sole_named_axis(axis_name):
         return False
     if cfg is None and not _supported_dtype(dtype):
         return False
@@ -146,7 +167,7 @@ def ring_reduce_scatter(x: jax.Array, axis_name: str,
     mode = pallas_mode(interpret)
     row_elems = int(math.prod(x.shape[1:])) if x.ndim > 1 else 1
     chunk = -(-row_elems // TILE) * TILE
-    if mode == "off" or not _ring_ok(n, chunk, x.dtype):
+    if mode == "off" or not _ring_ok(n, chunk, x.dtype, axis_name):
         return lax.psum_scatter(x, axis_name, scatter_dimension=0, tiled=False)
     flat = x.reshape(n, row_elems)
     pad = chunk - row_elems
@@ -165,7 +186,7 @@ def ring_all_gather(x: jax.Array, axis_name: str,
     mode = pallas_mode(interpret)
     elems = int(x.size)
     chunk = -(-max(elems, 1) // TILE) * TILE
-    if mode == "off" or not _ring_ok(n, chunk, x.dtype):
+    if mode == "off" or not _ring_ok(n, chunk, x.dtype, axis_name):
         return lax.all_gather(x, axis_name, tiled=False)
     flat = x.reshape(-1)
     pad = chunk - elems
@@ -184,7 +205,7 @@ def ring_all_reduce(x: jax.Array, axis_name: str, op: str = "sum",
     mode = pallas_mode(interpret)
     chunk = _chunk_elems(int(x.size), n)
     if (mode == "off" or op not in ("sum", "mean")
-            or not _ring_ok(n, chunk, x.dtype)):
+            or not _ring_ok(n, chunk, x.dtype, axis_name)):
         out = C.ring_all_reduce(x, axis_name, "sum" if op == "mean" else op)
         return out / n if op == "mean" else out
     flat = x.reshape(-1)
@@ -201,8 +222,10 @@ def ring_all_reduce(x: jax.Array, axis_name: str, op: str = "sum",
 # --- fused-codec ring allreduce --------------------------------------------------------
 
 
-def _fused_ok(n: int, cfg: CompressionConfig, chunk: int) -> bool:
-    if n <= 1 or not cfg.is_quantized or cfg.stochastic:
+def _fused_ok(n: int, cfg: CompressionConfig, chunk: int,
+              axis_name) -> bool:
+    if n <= 1 or not cfg.is_quantized or cfg.stochastic \
+            or not _sole_named_axis(axis_name):
         return False
     if cfg.scheme == "fp8" and RK.FP8_DTYPE is None:
         return False
@@ -245,7 +268,7 @@ def fused_ring_all_reduce(
     # per-chunk length must block-align for the in-kernel codec AND tile
     unit = math.lcm(cfg.block, TILE)
     chunk = _chunk_elems(int(x.size), n, multiple=unit)
-    if not _fused_ok(n, cfg, chunk):
+    if not _fused_ok(n, cfg, chunk, axis_name):
         return Comp.all_reduce(x, axis_name, cfg, op=op, key=key)
     interp = mode == "interpret"
     orig_dtype = x.dtype

@@ -310,3 +310,176 @@ def scratch_bytes(n: int, chunk_elems: int,
     code = (n + 1) * chunk_elems * 1
     scales = (n + 1) * nblocks * 4
     return code + scales
+
+
+# --- fused computation-collective kernels ----------------------------------------------
+#
+# The arXiv 2305.06942 placement done on this file's DMA machinery: the
+# collective's per-hop transfer and the matmul that produces/consumes it
+# interleave inside ONE kernel, so the MXU works on hop h's block while
+# hop h+1's remote DMA is in flight and the gathered/partial tensor never
+# materializes as a separate XLA op.
+
+
+def _mxu_dot(a, b, block_m: int = 0, block_n: int = 0):
+    """fp32-accumulated a @ b, optionally split into (block_m, block_n)
+    MXU tiles (static Python loops — straight-line Mosaic).  0 = whole
+    operand in one pass.  The tile shapes are the tuner-owned knob
+    (tuner/space.py fused_block_m/n) sharing the same VMEM budget as the
+    flash tiles and ring comm slots."""
+    m, _ = a.shape
+    nn = b.shape[1]
+    bm = block_m or m
+    bn = block_n or nn
+    if bm >= m and bn >= nn:
+        return jnp.dot(a, b, preferred_element_type=jnp.float32)
+    rows = []
+    for i in range(0, m, bm):
+        cols = [
+            jnp.dot(a[i:i + bm], b[:, j:j + bn],
+                    preferred_element_type=jnp.float32)
+            for j in range(0, nn, bn)
+        ]
+        rows.append(cols[0] if len(cols) == 1 else jnp.concatenate(cols, 1))
+    return rows[0] if len(rows) == 1 else jnp.concatenate(rows, 0)
+
+
+def make_ag_matmul_kernel(n: int, axis_name: str, pipelined: bool,
+                          block_m: int = 0, block_n: int = 0):
+    """All-gather-matmul body: y = x @ concat_rows(W_0..W_{n-1}) with the
+    W shards rotating around the ring, never gathered into one buffer.
+
+    Refs: x (n, M, Ks) — the local activation pre-blocked by contraction
+    chunk (block c multiplies shard W_c); w (Ks, N) — this rank's weight
+    shard; o (M, N) fp32 accumulator/output; comm (n, Ks, N) scratch —
+    slot c holds W_c once it arrives (own slot seeded before hop 0, every
+    other slot written by exactly one incoming DMA, so forwarding in
+    place is race-free — the make_ag_kernel argument).
+
+    Hop s forwards the shard that arrived at hop s-1 (own shard at s=0)
+    and the MXU consumes that same shard while the DMA drains: compute
+    for hop s overlaps communication for hop s+1's payload.
+    """
+    steps = n - 1
+
+    def kernel(x_ref, w_ref, o_ref, comm_ref, send_sems, recv_sems):
+        my_id = lax.axis_index(axis_name)
+        right = lax.rem(my_id + 1, n)
+        comm_ref[my_id] = w_ref[...]
+        dmas = []
+        acc = None
+        for s in range(steps):
+            c = lax.rem(my_id - s + 2 * n, n)
+            if pipelined and s >= 1:
+                dmas[s - 1].wait_recv()  # the shard being forwarded arrived
+            d = _rdma(comm_ref.at[c], comm_ref.at[c],
+                      send_sems.at[s], recv_sems.at[s], right)
+            d.start()
+            if not pipelined:
+                d.wait()
+            # MXU consumes shard c while hop s's DMA is in flight
+            part = _mxu_dot(x_ref[c], comm_ref[c], block_m, block_n)
+            acc = part if acc is None else acc + part
+            dmas.append(d)
+        if pipelined and steps:
+            dmas[steps - 1].wait_recv()
+        c_last = lax.rem(my_id - steps + 2 * n, n)
+        part = _mxu_dot(x_ref[c_last], comm_ref[c_last], block_m, block_n)
+        o_ref[...] = part if acc is None else acc + part
+        if pipelined:
+            for d in dmas:
+                d.wait_send()
+
+    return kernel
+
+
+def make_matmul_rs_kernel(n: int, axis_name: str, pipelined: bool,
+                          block_m: int = 0, block_n: int = 0):
+    """Matmul-reduce-scatter body: each rank's partial product
+    x_local @ W_local reduce-scatters around the ring, with each row
+    chunk's matmul computed right before it is staged into the outbound
+    slot — the backward-epilogue fusion (partials never materialize as a
+    separate [M, N] tensor).
+
+    Refs: x (n, Mc, K) — local activation pre-blocked by output row
+    chunk; w (K, N) — local weight; o (Mc, N) fp32 — the completed
+    summed chunk this rank owns (index == its rank, matching
+    lax.psum_scatter(scatter_dimension=0)); comm (n+1, Mc, N) fp32
+    scratch — per-hop recv slots + two outbound staging slots (the
+    make_rs_kernel layout; partials travel fp32).
+
+    Hop s's matmul (chunk (d-s-1) mod n) runs before hop s-1's recv is
+    awaited, so the MXU fills the DMA's drain time.
+    """
+    steps = n - 1
+    stage0 = steps
+
+    def kernel(x_ref, w_ref, o_ref, comm_ref, send_sems, recv_sems):
+        my_id = lax.axis_index(axis_name)
+        right = lax.rem(my_id + 1, n)
+        dmas = []
+        for s in range(steps):
+            stage = stage0 + (s % 2)
+            if pipelined and s >= 2:
+                dmas[s - 2].wait_send()  # staging slot s%2 free again
+            c = _chunk_index(my_id, s, n)
+            # MXU work for this hop, issued while hop s-1's DMA drains
+            part = _mxu_dot(x_ref[c], w_ref[...], block_m, block_n)
+            if s == 0:
+                payload = part
+            else:
+                if pipelined:
+                    dmas[s - 1].wait_recv()
+                payload = part + comm_ref[s - 1]
+            comm_ref[stage] = payload
+            d = _rdma(comm_ref.at[stage], comm_ref.at[s],
+                      send_sems.at[s], recv_sems.at[s], right)
+            d.start()
+            if not pipelined:
+                d.wait()
+            dmas.append(d)
+        # own chunk's matmul overlaps the final hop's DMA
+        own = _mxu_dot(x_ref[my_id], w_ref[...], block_m, block_n)
+        if pipelined and steps:
+            dmas[steps - 1].wait_recv()
+        o_ref[...] = own + comm_ref[steps - 1] if steps else own
+        if pipelined:
+            for s in range(max(steps - 2, 0), steps):
+                dmas[s].wait_send()
+
+    return kernel
+
+
+def make_shift_kernel(n: int, axis_name: str, shift: int = 1):
+    """Single-hop ring rotation — `lax.ppermute(x, axis, [(i, (i+shift) %
+    n)])` as one remote DMA on the data plane.  The building block ring
+    attention's blockwise KV rotation rides (parallel/ring_attention.py):
+    one RDMA per hop instead of a collective-permute, same bytes.
+
+    Refs: x (rows, LANES) payload, o (rows, LANES) the rotated result.
+    One hop has nothing to pipeline: start(); wait() on both schedules.
+    """
+
+    def kernel(x_ref, o_ref, send_sem, recv_sem):
+        my_id = lax.axis_index(axis_name)
+        dst = lax.rem(my_id + shift + 2 * n, n)
+        d = _rdma(x_ref, o_ref, send_sem, recv_sem, dst)
+        d.start()
+        d.wait()
+
+    return kernel
+
+
+def ag_matmul_scratch_bytes(n: int, ks: int, nn: int, m: int,
+                            itemsize: int) -> int:
+    """VMEM scratch of one all-gather-matmul call: the n rotating weight
+    slots plus the fp32 accumulator — checked against the same
+    KFT_PALLAS_VMEM_MIB budget the ring collectives and flash tiles
+    share."""
+    return n * ks * nn * itemsize + m * nn * 4
+
+
+def matmul_rs_scratch_bytes(n: int, mc: int, nn: int) -> int:
+    """VMEM scratch of one matmul-reduce-scatter call: (n-1) per-hop
+    fp32 recv slots + two staging slots + the fp32 output chunk."""
+    return (n + 2) * mc * nn * 4

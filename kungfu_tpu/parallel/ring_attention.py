@@ -4,11 +4,12 @@ The reference has NO long-context support (SURVEY.md §5: no ring attention,
 no sequence parallelism anywhere in the tree); this module is the TPU-native
 capability the reference lacks, built the way the hardware wants it: the
 sequence is sharded over the `sp` mesh axis, K/V blocks rotate around the
-ring with `lax.ppermute` (neighbor hops ride ICI), and each device folds one
-block per hop into a flash-style online-softmax accumulator (fp32), so the
-full sequence never materializes on any chip.  Peak memory per chip is
-O(L/n), compute overlaps communication hop by hop (XLA pipelines the
-ppermute with the einsums).
+ring on the Pallas DMA data plane (`ops.fused_matmul.ring_shift` — one
+remote DMA per neighbor hop, `lax.ppermute` fallback off-TPU), and each
+device folds one block per hop into a flash-style online-softmax
+accumulator (fp32), so the full sequence never materializes on any chip.
+Peak memory per chip is O(L/n), compute overlaps communication hop by hop
+(hop h+1's DMA streams while the block math for hop h runs).
 
 Use under shard_map with q/k/v sharded on the sequence dim:
 
@@ -27,6 +28,22 @@ from jax import lax
 from ..compat import axis_size as _axis_size
 
 NEG_INF = -1e30
+
+
+def _rotate_kv(k, v, axis_name):
+    """One ring hop of the K/V blocks — on the Pallas DMA data plane.
+
+    `ops.fused_matmul.ring_shift` moves each block as one remote DMA
+    (the same make_async_remote_copy machinery the fused matmul kernels
+    ride) and falls back to the identical `lax.ppermute` lowering
+    whenever the kernels can't run here (compat.pallas_mode off, shapes
+    past the VMEM budget, unsupported dtype) — pure data movement, so
+    the two paths are bit-identical.  Differentiable: ring_shift's VJP
+    rotates the cotangent backwards, matching ppermute's transpose.
+    """
+    from ..ops.fused_matmul import ring_shift
+
+    return ring_shift(k, axis_name, 1), ring_shift(v, axis_name, 1)
 
 
 def _block_attn(q, k, v, m, l, o, q_off, k_off, causal: bool, scale: float):
@@ -152,15 +169,12 @@ def ring_attention(
         m, l, o = _block_attn(q, k, v, m0, l0, o0, q_off, 0, causal, scale)
         return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
 
-    perm = [(i, (i + 1) % n) for i in range(n)]
-
     def hop(carry, s):
         k_cur, v_cur, m, l, o = carry
         # the block currently held arrived from device (idx - s) mod n
         k_off = ((idx - s) % n) * Lc
         m, l, o = _block_attn(q, k_cur, v_cur, m, l, o, q_off, k_off, causal, scale)
-        k_nxt = lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        k_nxt, v_nxt = _rotate_kv(k_cur, v_cur, axis_name)
         return (k_nxt, v_nxt, m, l, o), None
 
     # n-1 rotated hops, then fold the final block without a wasted rotation
@@ -191,7 +205,6 @@ def _ring_attention_flash(q, k, v, axis_name, causal, scale):
         o, lse = _block_attn_flash(q, k, v, mode_for(0), scale)
         return o.astype(q.dtype)
 
-    perm = [(i, (i + 1) % n) for i in range(n)]
     # derive accumulators from q so they inherit its varying-axes type
     o0 = jnp.zeros_like(q, jnp.float32)
     lse0 = o0[:, :, :, 0].transpose(0, 2, 1) + NEG_INF  # [B, H, Lc]
@@ -200,8 +213,7 @@ def _ring_attention_flash(q, k, v, axis_name, causal, scale):
         k_cur, v_cur, o, lse = carry
         o_blk, lse_blk = _block_attn_flash(q, k_cur, v_cur, mode_for(s), scale)
         o, lse = _merge_blocks(o, lse, o_blk, lse_blk)
-        k_nxt = lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        k_nxt, v_nxt = _rotate_kv(k_cur, v_cur, axis_name)
         return (k_nxt, v_nxt, o, lse), None
 
     (k_f, v_f, o, lse), _ = lax.scan(hop, (k, v, o0, lse0), jnp.arange(n - 1))

@@ -22,7 +22,8 @@ from .graph import (
     mst_neighbour_mask,
     RoundRobinSelector,
 )
-from .strategy import Strategy, Impl, DEFAULT_STRATEGY, resolve_auto, impl_of, strategy_graphs
+from .strategy import (Strategy, Impl, DEFAULT_STRATEGY, PALLAS_IMPLS,
+                       resolve_auto, impl_of, strategy_graphs)
 from .mesh import (
     MeshSpec,
     make_mesh,

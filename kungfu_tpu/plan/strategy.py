@@ -47,6 +47,14 @@ class Strategy(enum.Enum):
     # safe — the planner's measured runoff decides when they win
     PALLAS_RING = "PALLAS_RING"
     PALLAS_RING_FUSED = "PALLAS_RING_FUSED"  # in-kernel int8/fp8 codec
+    # fused computation-collective step schedule (ops/fused_matmul.py):
+    # the FSDP gather/scatter legs ride the DMA ring with the MXU
+    # consuming hop h's block while hop h+1's transfer is in flight.  As
+    # a session allreduce it executes the pallas ring RS+AG pair (the
+    # collective component of the fused schedule), so installing it is
+    # always safe; the planner prices its ag_matmul / matmul_rs
+    # candidates with the overlap discount (planner/cost.py)
+    PALLAS_FUSED_MATMUL = "PALLAS_FUSED_MATMUL"
     AUTO = "AUTO"
 
     @classmethod
@@ -75,6 +83,7 @@ class Impl(enum.Enum):
     HIERARCHICAL = "hierarchical"    # per-host then cross-host (ici x dcn)
     PALLAS_RING = "pallas_ring"      # Pallas DMA ring (xla-ring fallback)
     PALLAS_RING_FUSED = "pallas_ring_fused"  # + in-kernel codec
+    PALLAS_FUSED_MATMUL = "pallas_fused_matmul"  # matmul fused into the ring
 
 
 _IMPL_OF = {
@@ -88,7 +97,13 @@ _IMPL_OF = {
     Strategy.MULTI_BINARY_TREE_STAR: Impl.HIERARCHICAL,
     Strategy.PALLAS_RING: Impl.PALLAS_RING,
     Strategy.PALLAS_RING_FUSED: Impl.PALLAS_RING_FUSED,
+    Strategy.PALLAS_FUSED_MATMUL: Impl.PALLAS_FUSED_MATMUL,
 }
+
+#: the Impl family whose programs contain (or may contain) a pallas_call —
+#: shared by Session's dispatch gates (check_vma opt-out, kernel routing)
+PALLAS_IMPLS = (Impl.PALLAS_RING, Impl.PALLAS_RING_FUSED,
+                Impl.PALLAS_FUSED_MATMUL)
 
 
 def impl_of(strategy: Strategy, host_count: int = 1) -> Impl:
@@ -131,7 +146,8 @@ def strategy_graphs(
         ]
     if s is Strategy.CLIQUE:
         return G.gen_clique_graph_pairs(n)
-    if s in (Strategy.RING, Strategy.PALLAS_RING, Strategy.PALLAS_RING_FUSED):
+    if s in (Strategy.RING, Strategy.PALLAS_RING, Strategy.PALLAS_RING_FUSED,
+             Strategy.PALLAS_FUSED_MATMUL):
         # the Pallas kernels execute exactly the circular-pair routing, so
         # they share RING's reference graphs for digests and kf-lint
         return [G.gen_circular_graph_pair(n, shift=k) for k in range(min(n, 4))]

@@ -53,11 +53,23 @@ ALGORITHMS: Dict[str, Strategy] = {
     "hierarchical": Strategy.MULTI_BINARY_TREE_STAR,
     "pallas_ring": Strategy.PALLAS_RING,
     "pallas_ring_fused": Strategy.PALLAS_RING_FUSED,
+    # fused computation-collective schedules (ops/fused_matmul.py): the
+    # gather/scatter leg rides the DMA ring with the MXU consuming hop
+    # h's block while hop h+1's transfer is in flight.  Measured as the
+    # fused kernel's EXPOSED communication (fused wall time minus the
+    # pure-compute time — Planner._measure_fused_matmul); installs the
+    # PALLAS_FUSED_MATMUL strategy (pallas ring allreduce, always safe)
+    "ag_matmul": Strategy.PALLAS_FUSED_MATMUL,
+    "matmul_rs": Strategy.PALLAS_FUSED_MATMUL,
 }
 
 #: wire schemes the fused-codec kernel can express (pallas_ring_fused
 #: enumerates exactly these; bf16/none belong to plain pallas_ring)
 PALLAS_FUSED_SCHEMES = ("int8", "fp8")
+
+#: the fused computation-collective algorithms — full-precision operand
+#: blocks (dtype is the model's/tuner's knob; no codec in the kernels)
+FUSED_MATMUL_ALGORITHMS = ("ag_matmul", "matmul_rs")
 
 #: hidden algorithm id for the seeded-illegal candidate (never part of
 #: enumerate_plans output; the smoke drill injects it to prove the
@@ -211,7 +223,19 @@ def enumerate_plans(
     multi = len(live_hosts) > 1
     plans: List[Plan] = []
     for name, strat in ALGORITHMS.items():
-        if name in ("pallas_ring", "pallas_ring_fused"):
+        if name in FUSED_MATMUL_ALGORITHMS:
+            # fused matmul kernels move operand blocks verbatim on the
+            # link the ring crosses — the operand dtype is a model/tuner
+            # property, so the planner enumerates only the full-precision
+            # wire (installing a fused plan must not flip the session's
+            # allreduce compression as a side effect)
+            leg = "dcn" if multi else "ici"
+            if "none" in schemes:
+                plans.append(Plan(
+                    algorithm=name, strategy_name=strat.name,
+                    wire=((leg, "none"),), bucket=bucket.id, world=world,
+                ))
+        elif name in ("pallas_ring", "pallas_ring_fused"):
             # flat-ring kernels: one leg on the link the ring crosses.
             # pallas_ring is the full-precision (or bf16-cast) kernel;
             # pallas_ring_fused carries exactly the in-kernel codec wires

@@ -177,6 +177,12 @@ class Planner:
     def _measure(self, plan: Plan, nbytes: int, reps: int = 3) -> float:
         """Median wall ms of the plan's allreduce at `nbytes` payload on
         the live session (one unmeasured warmup per compiled program)."""
+        from .candidates import FUSED_MATMUL_ALGORITHMS
+
+        if plan.algorithm in FUSED_MATMUL_ALGORITHMS:
+            ms = self._measure_fused_matmul(plan, nbytes, reps=reps)
+            if ms is not None:
+                return ms
         elems = max(int(nbytes) // 4, 1)
         x = self.session.lift(
             np.random.RandomState(7).randn(elems).astype(np.float32))
@@ -191,6 +197,77 @@ class Planner:
             self.session.all_reduce(x, name=name, **kw)
             times.append((time.perf_counter() - t0) * 1e3)
         return statistics.median(times)
+
+    def _measure_fused_matmul(self, plan: Plan, nbytes: int,
+                              reps: int = 3) -> Optional[float]:
+        """Median EXPOSED-communication ms of a fused matmul plan: the
+        fused kernel's wall time minus the pure-compute (no-collective)
+        matmul at the same shape — the quantity comparable to an
+        allreduce latency in the runoff (it is what the step actually
+        pays for this tensor band's gather/scatter under the fused
+        schedule).  The weight payload totals `nbytes` across ranks.
+        Returns None when the session mesh has no single flat axis (the
+        caller falls back to the allreduce measurement)."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        from ..compat import shard_map
+        from ..ops import fused_matmul as FM
+
+        mesh = self.session.mesh
+        if len(mesh.axis_names) != 1:
+            return None
+        axis = mesh.axis_names[0]
+        n = self.world
+        cols = 128
+        rows = max((max(int(nbytes) // 4, 1) // cols // n) * n, n)
+        dtype = (jnp.bfloat16 if plan.wire_scheme(plan.legs[0]) == "bf16"
+                 else jnp.float32)
+        rng = np.random.RandomState(7)
+        m = 128
+        w = jnp.asarray(rng.randn(n, rows // n, cols), dtype)
+
+        if plan.algorithm == "ag_matmul":
+            x = jnp.asarray(rng.randn(n, m, rows), dtype)
+            fused = jax.jit(shard_map(
+                lambda xx, ww: FM.all_gather_matmul(xx[0], ww[0], axis),
+                mesh=mesh, in_specs=(P(axis), P(axis)), out_specs=P(axis),
+                check_vma=False))
+            compute = jax.jit(shard_map(
+                lambda xx, ww: jnp.dot(
+                    xx[0], jnp.concatenate([ww[0]] * n, axis=0),
+                    preferred_element_type=jnp.float32).astype(dtype),
+                mesh=mesh, in_specs=(P(axis), P(axis)), out_specs=P(axis),
+                check_vma=False))
+        else:  # matmul_rs
+            x = jnp.asarray(rng.randn(n, m * n, rows // n), dtype)
+            fused = jax.jit(shard_map(
+                lambda xx, ww: FM.matmul_reduce_scatter(xx[0], ww[0], axis),
+                mesh=mesh, in_specs=(P(axis), P(axis)), out_specs=P(axis),
+                check_vma=False))
+            compute = jax.jit(shard_map(
+                lambda xx, ww: jnp.dot(
+                    xx[0], ww[0],
+                    preferred_element_type=jnp.float32).astype(dtype),
+                mesh=mesh, in_specs=(P(axis), P(axis)), out_specs=P(axis),
+                check_vma=False))
+
+        def timed(fn):
+            jax.block_until_ready(fn(x, w))  # compile + warm
+            ts = []
+            for _ in range(max(reps, 1)):
+                t0 = time.perf_counter()
+                jax.block_until_ready(fn(x, w))
+                ts.append((time.perf_counter() - t0) * 1e3)
+            return statistics.median(ts)
+
+        fused_ms = timed(fused)
+        compute_ms = timed(compute)
+        # exposed communication; floor at a measurable epsilon so a fully
+        # hidden schedule still records a positive latency
+        return max(fused_ms - compute_ms, 1e-3)
 
     def tune(self, bucket: Bucket, reps: int = 3, measure_top: int = 2,
              use_cache: bool = True, install: bool = False,

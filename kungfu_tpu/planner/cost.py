@@ -28,6 +28,13 @@ Formulas (n = world, h = hosts, m = largest per-host group, e = elements):
                pallas_ring over int8/fp8 codes + scales, with the codec
                fused into the kernel (γ·logical once — same codec work,
                none of the three-op XLA launch overhead)
+  ag_matmul / matmul_rs
+               the fused computation-collective kernels
+               (ops/fused_matmul.py): a single gather/scatter leg whose
+               steady-state hops hide behind the MXU — priced as α once
+               plus ONE exposed round's wire (the first hop, which has
+               no compute to hide behind); the runoff measures the true
+               exposed time (fused wall minus pure-compute)
 
 A compressed leg prices its *wire* bytes (CompressionConfig.wire_bytes)
 plus the fitted codec overhead γ·logical_bytes — so on fabrics where the
@@ -83,6 +90,17 @@ def predict_ms(
         return total
 
     cfg = resolve(plan.wire_scheme(flat_leg))
+    if plan.algorithm in ("ag_matmul", "matmul_rs"):
+        # fused computation-collective schedule: one kernel launch (α
+        # once), a SINGLE gather/scatter leg of n-1 rounds instead of the
+        # allreduce's 2(n-1), and steady-state hops hidden behind the MXU
+        # — the model prices only the exposed wire: the first hop's
+        # transfer (nothing to overlap yet) plus the launch.  The runoff
+        # measures the true exposed time (fused minus pure-compute), so
+        # the model only has to rank, not predict absolutely.
+        link = model.link(flat_leg)
+        round_wire = cfg.wire_bytes(math.ceil(elems / n), 4)
+        return link.alpha_ms + link.beta_ms_per_mib * round_wire / MiB
     if plan.algorithm in ("pallas_ring", "pallas_ring_fused"):
         steps = 2 * (n - 1)
         link = model.link(flat_leg)

@@ -26,7 +26,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .compat import shard_map
 from .ops import collective as C
-from .plan import Strategy, Impl, impl_of, make_mesh
+from .plan import PALLAS_IMPLS, Strategy, Impl, impl_of, make_mesh
 from .utils import get_logger, stall_detector
 
 log = get_logger("kungfu.session")
@@ -193,7 +193,7 @@ class Session:
         impl = impl_of(s, self.host_count)
         if impl is Impl.HIERARCHICAL and self._hierarchical_axes is None:
             impl = Impl.RS_AG  # no ici/dcn split on this mesh
-        if impl in (Impl.RING, Impl.PALLAS_RING, Impl.PALLAS_RING_FUSED) \
+        if (impl is Impl.RING or impl in PALLAS_IMPLS) \
                 and len(self._axes) != 1:
             impl = Impl.RS_AG  # explicit ring needs a single data axis
         return impl
@@ -205,10 +205,12 @@ class Session:
         run (compiled on TPU or forced interpreter), "xla" otherwise —
         including when a pallas strategy is installed but the off-TPU
         fallback engages, so A/B attribution never lies."""
-        if impl not in (Impl.PALLAS_RING, Impl.PALLAS_RING_FUSED):
+        if impl not in PALLAS_IMPLS:
             return "xla"
         from .ops import pallas_collectives as PC
 
+        if impl is Impl.PALLAS_FUSED_MATMUL:
+            return PC.effective_impl("pallas_fused_matmul")
         fused = (impl is Impl.PALLAS_RING_FUSED
                  and cfg is not None and getattr(cfg, "is_quantized", False))
         return PC.effective_impl("pallas_fused" if fused else "pallas")
@@ -232,7 +234,9 @@ class Session:
                 return C.hierarchical_all_reduce(y, "ici", "dcn", op)
             if impl is Impl.RING:
                 return C.ring_all_reduce(y, axes[0], op)
-            if impl in (Impl.PALLAS_RING, Impl.PALLAS_RING_FUSED):
+            if impl in PALLAS_IMPLS:
+                # PALLAS_FUSED_MATMUL's allreduce is the pallas ring pair
+                # (its matmul fusion lives in fsdp.py / ops.fused_matmul)
                 from .ops import pallas_collectives as PC
 
                 return PC.ring_all_reduce(y, axes[0], op)
@@ -265,7 +269,7 @@ class Session:
                         ici_config=ici_cfg, dcn_config=dcn_cfg, op=op,
                     )[None]
             elif cfg is not None and cfg.scheme != "none":
-                if impl in (Impl.PALLAS_RING, Impl.PALLAS_RING_FUSED):
+                if impl in PALLAS_IMPLS:
                     # compressed wire on a pallas ring: codec fused into
                     # the kernel body (falls back to the three-op XLA
                     # schedule off-TPU or for configs the kernel can't
@@ -325,7 +329,7 @@ class Session:
 
         # pallas_call has no replication rule: those programs opt out of
         # the rep/vma check (kf-lint still covers the fallback lowering)
-        check = False if impl in (Impl.PALLAS_RING, Impl.PALLAS_RING_FUSED) else None
+        check = False if impl in PALLAS_IMPLS else None
         return jax.jit(shard_map(body, self.mesh, in_specs=spec,
                                  out_specs=spec, check_vma=check))
 
@@ -515,7 +519,7 @@ class Session:
             return tuple(reduce_impl(jnp.squeeze(y, 0))[None] for y in ys)
 
         specs = tuple(spec for _ in signature)
-        check = False if impl in (Impl.PALLAS_RING, Impl.PALLAS_RING_FUSED) else None
+        check = False if impl in PALLAS_IMPLS else None
         fn = jax.jit(shard_map(body, self.mesh, in_specs=specs,
                                out_specs=specs, check_vma=check))
         self._fns[key] = fn

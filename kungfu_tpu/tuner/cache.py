@@ -79,6 +79,14 @@ def _shipped_priors() -> Dict[str, dict]:
     own best tiling (512×1024 — bigger tiles amortize the VPU bookkeeping
     that dominates at head_dim 64) is carried for shapes whose d_model
     can't re-factor to 128.
+
+    The fused computation-collective arm ships enabled with the MXU-
+    native 256×512 fused tiles (measured-runoff priors for the fused
+    kernels' tile shapes — ops/fused_matmul.py): the FSDP gather/scatter
+    rides the DMA kernels from a fresh checkout.  The runoff contract
+    keeps the unfused path honest — `default_config()` (fused off) is
+    always a measured control, so a fused config can only be the config
+    of record by beating it on the chip.
     """
     flagship = dict(vocab_size=32000, d_model=1024, n_layers=24,
                     n_kv_heads=0, d_ff=4096, seq_len=2048, dtype="bfloat16",
@@ -90,11 +98,13 @@ def _shipped_priors() -> Dict[str, dict]:
                              **flagship)
             cfg = StepConfig(block_q=256, block_k=512, backward="pallas",
                              head_dim=128, remat=False, remat_policy="none",
-                             ce_chunk=0, donate=True, bucket_bytes=0)
+                             ce_chunk=0, donate=True, bucket_bytes=0,
+                             fused_matmul=True, fused_block_m=256,
+                             fused_block_n=512)
             out[shape.digest()] = {
                 "config": cfg.to_json(), "shape": shape.to_json(),
                 "predicted_ms": None, "measured_ms": None,
-                "default_ms": None, "source": "shipped:r5-hunt",
+                "default_ms": None, "source": "shipped:r5-hunt+fused-v1",
             }
     return out
 

@@ -232,9 +232,12 @@ class ComputeTuner:
         Returns (new_config, extras): the replaced TransformerConfig
         (tiles, backward arm, head layout, remat policy, head mode) and
         the step-level knobs that live outside the model config —
-        {"ce_chunk", "donate", "bucket_bytes"} — for the trainer/loss
-        wiring.  With `config=None` the shape's cached winner is used
-        (the default config when there is none).
+        {"ce_chunk", "donate", "bucket_bytes", "dma_collectives",
+        "fused_block_m", "fused_block_n"} — for the trainer/loss wiring
+        (dma_collectives feeds FSDPTrainer's gather/scatter routing, the
+        fused blocks the ops.fused_matmul tile split).  With
+        `config=None` the shape's cached winner is used (the default
+        config when there is none).
         """
         if config is None:
             digest, backend, jaxv = self.key()
@@ -255,7 +258,10 @@ class ComputeTuner:
             kw["n_heads"] = model_cfg.d_model // config.head_dim
         new_cfg = dataclasses.replace(model_cfg, **kw)
         extras = {"ce_chunk": config.ce_chunk, "donate": config.donate,
-                  "bucket_bytes": config.bucket_bytes}
+                  "bucket_bytes": config.bucket_bytes,
+                  "dma_collectives": config.fused_matmul,
+                  "fused_block_m": config.fused_block_m,
+                  "fused_block_n": config.fused_block_n}
         return new_cfg, extras
 
 

@@ -134,6 +134,27 @@ def step_hbm_bytes(cfg: StepConfig, shape: ShapeKey) -> Dict[str, int]:
             "bucket": bucket, "total": total}
 
 
+def fused_matmul_vmem_bytes(cfg: StepConfig, shape: ShapeKey,
+                            world: int = 4) -> int:
+    """Resident VMEM of one fused all-gather-matmul call under this
+    config: the rotating weight-shard comm slots (together one full
+    weight matrix — the widest per-layer matmul, d_model × max(d_ff,
+    4·d_model)) plus the per-hop MXU operand/accumulator tiles.  Shares
+    KFT_PALLAS_VMEM_MIB with the flash tiles and ring comm slots — a
+    tiling that blows the budget is rejected before it can wedge a chip
+    (the fused_matmul wrapper applies the same per-call gate at trace
+    time; this gate keeps such configs out of the runoff entirely)."""
+    if not cfg.fused_matmul:
+        return 0
+    db = _dtype_bytes(shape.dtype)
+    widest = max(shape.d_ff, 4 * shape.d_model)
+    comm = shape.d_model * widest * db  # n slots × (d_model/n × widest)
+    bm = cfg.fused_block_m or 128
+    bn = cfg.fused_block_n or 128
+    tiles = bm * bn * 4 + bm * shape.d_model * db + shape.d_model * bn * db
+    return comm + tiles
+
+
 def check_fit(cfg: StepConfig, shape: ShapeKey) -> Optional[str]:
     """None when the config fits both budgets, else the rejection reason
     (the footprint gate's single entry point — rejected configs journal
@@ -142,6 +163,12 @@ def check_fit(cfg: StepConfig, shape: ShapeKey) -> Optional[str]:
     if vmem > vmem_budget_bytes():
         return (f"flash tile {cfg.block_q}x{cfg.block_k} needs "
                 f"{vmem >> 20} MiB VMEM > {VMEM_ENV}="
+                f"{vmem_budget_bytes() >> 20} MiB")
+    fused_vmem = fused_matmul_vmem_bytes(cfg, shape)
+    if fused_vmem > vmem_budget_bytes():
+        return (f"fused matmul tiles {cfg.fused_block_m}x"
+                f"{cfg.fused_block_n} + weight comm slots need "
+                f"{fused_vmem >> 20} MiB VMEM > {VMEM_ENV}="
                 f"{vmem_budget_bytes() >> 20} MiB")
     hbm = step_hbm_bytes(cfg, shape)
     if hbm["total"] > hbm_budget_bytes():

@@ -78,6 +78,14 @@ echo "== pallas smoke: set_strategy(pallas_ring) + off-TPU fallback (2-rank CPU)
 # (3) keep the fused int8 path inside its quantization tolerance
 JAX_PLATFORMS=cpu python -m kungfu_tpu.ops.pallas_collectives --smoke --np 2
 
+echo "== fused-matmul smoke: interpret kernels + clean fallback (2-rank CPU) =="
+# the fused computation-collective entry points (all-gather-matmul,
+# matmul-reduce-scatter, the dma gather/scatter pair, the ring-shift hop)
+# must (1) produce the exact lax results through the clean fallback with
+# the gate off, (2) run the real kernel bodies bit-identically under
+# KFT_PALLAS=interpret, (3) flow gradients through the custom VJPs
+JAX_PLATFORMS=cpu python -m kungfu_tpu.ops.fused_matmul --smoke --np 2
+
 echo "== chaos smoke: scripted crash+heal drill (CPU, buddy-RAM rung) =="
 # --expect-rung buddy: the heal must resync from the peer-redundant
 # in-memory tier (recovery_rung=buddy journaled, zero disk restores)

@@ -86,6 +86,68 @@ class TestRingAttention:
             rtol=2e-4, atol=2e-5,
         )
 
+    @pytest.mark.parametrize("causal", [True, False], ids=["causal", "bidir"])
+    def test_dma_rotation_matches_ppermute(self, causal, monkeypatch):
+        """KV rotation on the Pallas DMA plane (ops.fused_matmul.ring_shift
+        under KFT_PALLAS=interpret) is pure data movement: the ring output
+        must be BIT-IDENTICAL to the ppermute fallback and match the
+        single-device reference.  The enclosing shard_map opts out of the
+        rep check (pallas_call has no replication rule — docs/pallas.md)."""
+        from kungfu_tpu.compat import shard_map as kft_shard_map
+
+        mesh = make_mesh(sp=4, devices=jax.devices()[:4])
+        B, L, H, D = 2, 64, 4, 16
+        rng = np.random.RandomState(7)
+        q, k, v = (rng.randn(B, L, H, D).astype(np.float32) * 0.5
+                   for _ in range(3))
+        spec = P(None, "sp", None, None)
+
+        def run():
+            return np.asarray(jax.jit(kft_shard_map(
+                lambda q, k, v: ring_attention(q, k, v, axis_name="sp",
+                                               causal=causal),
+                mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+                check_vma=False))(q, k, v))
+
+        monkeypatch.delenv("KFT_PALLAS", raising=False)
+        base = run()  # gate off -> the ppermute fallback
+        monkeypatch.setenv("KFT_PALLAS", "interpret")
+        dma = run()   # the DMA shift kernels under the interpreter
+        assert np.array_equal(base, dma)
+        want = np.asarray(full_attention(jnp.asarray(q), jnp.asarray(k),
+                                         jnp.asarray(v), causal=causal))
+        np.testing.assert_allclose(dma, want, rtol=2e-4, atol=2e-5)
+
+    def test_dma_rotation_grad_flows(self, monkeypatch):
+        """Gradients through the scan + custom-VJP rotation (the VJP
+        rotates the cotangent backwards) must match the single-device
+        reference when the DMA hop is engaged."""
+        from kungfu_tpu.compat import shard_map as kft_shard_map
+
+        monkeypatch.setenv("KFT_PALLAS", "interpret")
+        mesh = make_mesh(sp=4, devices=jax.devices()[:4])
+        B, L, H, D = 1, 32, 2, 8
+        rng = np.random.RandomState(8)
+        q, k, v = (rng.randn(B, L, H, D).astype(np.float32) * 0.5
+                   for _ in range(3))
+        spec = P(None, "sp", None, None)
+
+        def loss_ring(q, k, v):
+            o = kft_shard_map(
+                lambda q, k, v: ring_attention(q, k, v, axis_name="sp"),
+                mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+                check_vma=False)(q, k, v)
+            return jnp.sum(o ** 2)
+
+        g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+        g_full = jax.grad(
+            lambda q, k, v: jnp.sum(full_attention(q, k, v) ** 2),
+            argnums=(0, 1, 2),
+        )(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        for a, b in zip(g_ring, g_full):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-4, atol=5e-5)
+
     def test_grad_flows(self):
         mesh = make_mesh(sp=4, devices=jax.devices()[:4])
         B, L, H, D = 1, 32, 2, 8

@@ -386,7 +386,9 @@ class TestApply:
         assert cfg.remat and cfg.remat_policy == "dots"
         assert cfg.head == "hidden"
         assert extras == {"ce_chunk": 4096, "donate": False,
-                          "bucket_bytes": 4 << 20}
+                          "bucket_bytes": 4 << 20,
+                          "dma_collectives": False,
+                          "fused_block_m": 0, "fused_block_n": 0}
 
     def test_apply_never_refactors_gqa_heads(self):
         from kungfu_tpu.models.transformer import TransformerConfig
