@@ -31,6 +31,13 @@ def main(argv=None) -> int:
     p.add_argument("--no-slo", action="store_true",
                    help="scaling observatory: skip the efficiency-floor SLO "
                         "gate (curve recording only)")
+    p.add_argument("--pod-hosts", type=int, default=0,
+                   help="scaling observatory: also run the netns pod drill "
+                        "at this many namespace hosts (shaped DCN links, "
+                        "scripts/pod_drill.py --bench) and attach its curve "
+                        "as the record's `pod` section under the same SLO "
+                        "floor; 0 = off, auto-skipped without root")
+    p.add_argument("--pod-workers-per-host", type=int, default=2)
     p.add_argument("--slots", type=int, default=4,
                    help="KV slots for --bench serving")
     p.add_argument("--requests", type=int, default=64,
@@ -108,7 +115,7 @@ def main(argv=None) -> int:
         return 0
 
     if args.bench == "scaling":
-        from .scaling import _ensure_devices, bench_scaling
+        from .scaling import _ensure_devices, attach_pod_record, bench_scaling
 
         sizes = sorted({int(s) for s in args.sizes.split(",") if s})
         _ensure_devices(max(sizes))
@@ -117,6 +124,14 @@ def main(argv=None) -> int:
             chaos_collective_ms=args.chaos_collective_ms, out=args.out,
             slo=not args.no_slo,
         )
+        if args.pod_hosts:
+            rec = attach_pod_record(rec, hosts=args.pod_hosts,
+                                    workers_per_host=args.pod_workers_per_host)
+            if args.out:
+                import json as _json
+
+                with open(args.out, "w") as f:
+                    _json.dump(rec, f, indent=2)
         # a tripped efficiency floor FAILS the bench — a scaling
         # regression is a first-class failure, not just single-chip speed
         return 4 if rec.get("slo_breached") else 0

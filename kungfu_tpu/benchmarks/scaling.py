@@ -349,6 +349,47 @@ def bench_scaling(sizes: Sequence[int] = (1, 2, 4),
     return record
 
 
+# -- netns pod arm (the 64-256-rank shaped-link fleet) ---------------------------------
+
+
+def attach_pod_record(record: Dict, hosts: int, workers_per_host: int = 2,
+                      steps_per_rank: int = 30,
+                      timeout_s: float = 900.0) -> Dict:
+    """Run the netns pod weak-scaling drill (scripts/pod_drill.py --bench)
+    and attach its record as `record["pod"]` — the 64-256-rank shaped-link
+    fleet feeding the SAME `scaling` BENCH section and SLO floor as the
+    in-process curve.  Needs root + netns; unavailable environments get an
+    honest `{"skipped": reason}` stamp instead of a silent omission."""
+    import subprocess
+    import tempfile
+
+    from ..testing.pod import pod_available
+
+    if not pod_available():
+        record["pod"] = {"skipped": "netns unavailable (need root + ip/veth)"}
+        return record
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    sizes = sorted({1, max(2, hosts // 2), hosts})
+    with tempfile.NamedTemporaryFile(suffix=".json") as out:
+        r = subprocess.run(
+            [sys.executable, os.path.join(repo, "scripts", "pod_drill.py"),
+             "--bench", "--sizes", ",".join(str(s) for s in sizes),
+             "--workers-per-host", str(workers_per_host),
+             "--steps-per-rank", str(steps_per_rank),
+             "--timeout", str(timeout_s), "--json-out", out.name],
+            capture_output=True, text=True, timeout=timeout_s + 120)
+        try:
+            record["pod"] = json.load(open(out.name))
+        except (OSError, ValueError):
+            record["pod"] = {"skipped": f"pod bench failed (rc={r.returncode})",
+                             "stderr_tail": r.stderr[-1000:]}
+            return record
+    if record["pod"].get("slo_breached"):
+        record["slo_breached"] = True  # the pod curve gates the bench too
+    return record
+
+
 # -- legacy weak-scaling sweep (kungfu-bench-allreduce analog) -------------------------
 
 

@@ -364,6 +364,91 @@ def run_straggler_drill(np_: int = 3, slow_ms: float = 4000.0,
     }
 
 
+def run_network_straggler_drill(latency_ms: float = 120.0,
+                                rate_mbit: float = 2.0,
+                                timeout_s: float = 420.0) -> dict:
+    """Straggler drill, network edition: the degradation is REAL — one
+    host's DCN link is shaped mid-run (tc netem delay where the kernel has
+    it, a tbf rate cap otherwise) instead of an in-process sleep.
+
+    Physics note (docs/fault_tolerance.md "network failure model"): a slow
+    LINK is not a slow RANK.  The victim host's compute is unchanged and it
+    arrives at each collective on time — every rank's collective just takes
+    longer — so the correct observatory response is the fleet-wide one:
+    `anomaly_regression` journaled while the window is open (and cleared
+    after), with ZERO stall kills and ZERO membership changes.  Per-rank
+    arrival-skew flagging stays the in-process `slow@` variant's business.
+    """
+    from .plan import parse_fault_plan
+    from ..testing.pod import LinkShape, PlanExecutor, Pod, PodSpec
+
+    hosts, wph, dim = 2, 2, 16384
+    np_ = hosts * wph
+    steps = 110
+    degrade_at, degrade_secs = 50, 25.0
+    total = 32 * np_ * steps
+    plan = (f"degrade_link@host=h2:step={degrade_at}"
+            f":latency_ms={latency_ms:g}:rate_mbit={rate_mbit:g}"
+            f":duration={degrade_secs:g}")
+    faults = parse_fault_plan(plan).network_faults()
+    spec = PodSpec(hosts=hosts, workers_per_host=wph)
+    pod = Pod(spec, extra_env={"KFT_CONFIG_ENABLE_MONITORING": "1"})
+    failures: list = []
+    try:
+        pod.setup()
+        pod.spawn([
+            sys.executable, "-m", "kungfu_tpu.testing.fake_adaptive_trainer",
+            "--total-samples", str(total), "--batch-size", "32",
+            "--dim", str(dim), "--check-every", "2",
+        ], timeout_s=timeout_s)
+        ex = PlanExecutor(pod, faults)
+        finished = pod.wait(timeout_s, tick=ex.tick, poll_s=0.25)
+        if not finished:
+            failures.append(f"fleet did not finish within {timeout_s:.0f}s")
+        events = pod.journal_events()
+        by_kind: dict = {}
+        for e in events:
+            by_kind.setdefault(e.get("event", "?"), []).append(e)
+        out = "\n".join(pod.launcher_output(ip) for ip in pod.launchers)
+        results = re.findall(
+            r"RESULT: fake-adaptive trained=(\d+) resizes=\d+ "
+            r"final_size=(\d+)", out)
+        applied = [r for r in ex.applied if r["kind"] == "degrade_link"]
+        tc = applied[0].get("tc", "") if applied else ""
+        if not applied:
+            failures.append("the degrade_link fault never fired")
+        elif not tc:
+            failures.append("link shaping unavailable (no netem/tbf) — "
+                            "nothing was degraded; run the in-process "
+                            "variant instead")
+        regressions = by_kind.get("anomaly_regression", [])
+        if applied and tc and not regressions:
+            failures.append("no anomaly_regression journaled: the "
+                            "observatory missed a real link degradation")
+        for bad in ("stall_kill", "worker_failure", "heal_shrink",
+                    "host_heal_shrink"):
+            if by_kind.get(bad):
+                failures.append(f"{bad} x{len(by_kind[bad])}: a degraded "
+                                "link must never cost a rank")
+        if len(results) != np_:
+            failures.append(f"{len(results)}/{np_} worker RESULT lines")
+        for trained, size in results:
+            if int(trained) < total:
+                failures.append(f"worker trained {trained} < {total}")
+            if int(size) != np_:
+                failures.append(f"final_size {size} != {np_}")
+        return {
+            "ok": not failures, "failures": failures, "variant": "network",
+            "shaping": pod.shaping, "tc": tc, "plan": plan, "np": np_,
+            "anomaly_regressions": len(regressions),
+            "anomaly_cleared": len(by_kind.get("anomaly_cleared", ())),
+            "journal_counts": {k: len(v) for k, v in sorted(by_kind.items())},
+            "output_tail": out[-3000:] if failures else "",
+        }
+    finally:
+        pod.teardown()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="kungfu_tpu.chaos")
     ap.add_argument("--plan", default="crash@step=7:rank=2")
@@ -399,6 +484,13 @@ def main(argv=None) -> int:
                     help="per-step slowdown injected into the victim rank")
     ap.add_argument("--straggler-steps", type=int, default=6,
                     help="length of the injected slow window, in steps")
+    ap.add_argument("--network", choices=("auto", "on", "off"),
+                    default="auto",
+                    help="straggler drill: degrade a netns host's link with "
+                         "tc (real network degradation) instead of the "
+                         "in-process slow@ sleep; auto = network when "
+                         "root+netns are available, else the in-process "
+                         "fallback (docs/fault_tolerance.md)")
     ap.add_argument("--serve-drill", action="store_true",
                     help="run the serving drill instead: kill a serving "
                          "rank mid-stream, assert zero dropped requests + "
@@ -416,6 +508,31 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     if args.straggler_drill:
+        use_network = args.network == "on"
+        if args.network == "auto":
+            from ..testing.pod import pod_available
+
+            use_network = pod_available()
+        if use_network:
+            summary = run_network_straggler_drill(timeout_s=max(args.timeout,
+                                                                420.0))
+            if args.json:
+                with open(args.json, "w") as f:
+                    json.dump(summary, f, indent=2)
+            if not summary["ok"]:
+                print("STRAGGLER DRILL (network) FAILED: "
+                      + "; ".join(summary["failures"]), file=sys.stderr)
+                if summary.get("output_tail"):
+                    print("--- output tail ---\n" + summary["output_tail"],
+                          file=sys.stderr)
+                return 1
+            print("STRAGGLER DRILL (network) OK: link degraded for real "
+                  f"(shaping={summary['shaping']}, tc={summary['tc']!r}), "
+                  f"{summary['anomaly_regressions']} anomaly_regression "
+                  f"journaled ({summary['anomaly_cleared']} cleared), "
+                  "0 kills, 0 membership changes, "
+                  f"{summary['np']} ranks finished at full size")
+            return 0
         summary = run_straggler_drill(
             np_=args.np, slow_ms=args.straggler_ms,
             slow_steps=args.straggler_steps, timeout_s=args.timeout,

@@ -43,6 +43,33 @@ Checkpoint-integrity faults (docs/fault_tolerance.md, recovery ladder):
                                     commit and the manifest rename (default
                                     code 43) — the torn-step shape
 
+Network-level faults (docs/fault_tolerance.md "network failure model",
+applied by the pod harness — kungfu_tpu/testing/pod.py — from OUTSIDE the
+workers via netns routes / tc, never in-process):
+
+  partition@step=N:hosts=A|B[:heal_after=S]
+                                    once the fleet reaches step N, split the
+                                    pod: hosts in group A (comma-separated)
+                                    cannot reach hosts in group B and vice
+                                    versa (bidirectional unreachable routes;
+                                    the config server stays reachable from
+                                    BOTH sides — the control plane rides a
+                                    different network in real pods).  With
+                                    heal_after the partition is removed S
+                                    seconds later; the runtime must rejoin
+                                    WITHOUT a membership shrink
+  degrade_link@host=H:latency_ms=L[:loss_pct=P][:rate_mbit=M][:step=N][:duration=S]
+                                    shape host H's DCN link: added latency,
+                                    packet loss, and/or a bandwidth cap
+                                    (netem where available, tbf rate-only
+                                    fallback).  Applies at step N (default
+                                    0 = from the start); with duration the
+                                    degradation is removed S seconds later
+  kill_host@step=N:host=H           SIGKILL host H's launcher AND all K of
+                                    its workers at once — correlated whole-
+                                    host loss; exactly one survivor-side
+                                    shrink CAS must remove all K ranks
+
 Durations accept a trailing "s" or "ms" ("3s", "250ms", bare numbers are
 seconds).  Ranks refer to the worker's LAUNCH rank (its rank when the
 process first joined), not its current rank — current ranks shift when the
@@ -59,7 +86,8 @@ from typing import List, Optional, Tuple
 FAULT_PLAN_ENV = "KFT_FAULT_PLAN"
 
 _KINDS = ("crash", "hang", "slow", "flap", "corrupt_ckpt", "crash_in_save",
-          "crash_serve")
+          "crash_serve", "partition", "degrade_link", "kill_host")
+NETWORK_KINDS = ("partition", "degrade_link", "kill_host")
 DEFAULT_CRASH_CODE = 41
 DEFAULT_CRASH_IN_SAVE_CODE = 43
 DEFAULT_CRASH_SERVE_CODE = 45
@@ -91,6 +119,13 @@ class Fault:
     after: int = DEFAULT_FLAP_AFTER  # flap: requests served before outage
     ckpt_step: int = -1             # corrupt_ckpt: target step; -1 = latest
     tokens: int = -1                # crash_serve: generated-token trigger
+    # network faults (pod harness; hosts/host name netns "hosts", not ranks)
+    host: str = ""                  # degrade_link/kill_host target host
+    groups: Tuple[Tuple[str, ...], ...] = ()  # partition: the two host sides
+    heal_after: float = 0.0         # partition: seconds until partition heals
+    latency_ms: float = 0.0         # degrade_link: added one-way delay
+    loss_pct: float = 0.0           # degrade_link: packet loss percent
+    rate_mbit: float = 0.0          # degrade_link: bandwidth cap; 0 = none
 
     def matches(self, step: int, rank: int) -> bool:
         """True when a worker-side fault fires at (step, rank)."""
@@ -141,6 +176,41 @@ def _parse_one(spec: str) -> Fault:
             **_reject_leftovers(kv, spec),
         )
 
+    if kind == "partition":
+        if "hosts" not in kv:
+            raise ValueError(f"partition fault needs hosts=A|B: {spec!r}")
+        groups = _parse_groups(kv.pop("hosts"), spec)
+        return Fault(
+            kind="partition", step=int(kv.pop("step", 0)), groups=groups,
+            heal_after=_duration_s(kv.pop("heal_after", "0"), spec),
+            **_reject_leftovers(kv, spec),
+        )
+
+    if kind == "degrade_link":
+        if "host" not in kv:
+            raise ValueError(f"degrade_link fault needs host=: {spec!r}")
+        f = dict(
+            kind="degrade_link", host=kv.pop("host"),
+            step=int(kv.pop("step", 0)),
+            latency_ms=float(kv.pop("latency_ms", 0)),
+            loss_pct=float(kv.pop("loss_pct", 0)),
+            rate_mbit=float(kv.pop("rate_mbit", 0)),
+            secs=_duration_s(kv.pop("duration", "0"), spec),
+        )
+        if not (f["latency_ms"] or f["loss_pct"] or f["rate_mbit"]):
+            raise ValueError(
+                f"degrade_link needs latency_ms=, loss_pct= or rate_mbit=: {spec!r}"
+            )
+        return Fault(**f, **_reject_leftovers(kv, spec))
+
+    if kind == "kill_host":
+        if "host" not in kv:
+            raise ValueError(f"kill_host fault needs host=: {spec!r}")
+        return Fault(
+            kind="kill_host", step=int(kv.pop("step", 0)),
+            host=kv.pop("host"), **_reject_leftovers(kv, spec),
+        )
+
     if "step" not in kv or "rank" not in kv:
         raise ValueError(f"{kind} fault needs step= and rank=: {spec!r}")
     f = dict(kind=kind, step=int(kv.pop("step")), rank=int(kv.pop("rank")))
@@ -162,6 +232,21 @@ def _parse_one(spec: str) -> Fault:
         f["ms"] = _duration_s(kv.pop("ms") + "ms", spec) * 1e3
         f["steps"] = int(kv.pop("steps", 0))
     return Fault(**f, **_reject_leftovers(kv, spec))
+
+
+def _parse_groups(value: str, spec: str) -> Tuple[Tuple[str, ...], ...]:
+    """"h1,h2|h3,h4" -> (("h1","h2"), ("h3","h4")) — the two partition sides.
+    Both sides must be non-empty and disjoint (a host cannot be partitioned
+    from itself)."""
+    sides = [tuple(h.strip() for h in side.split(",") if h.strip())
+             for side in value.split("|")]
+    if len(sides) != 2 or not all(sides):
+        raise ValueError(
+            f"partition hosts must be two |-separated non-empty groups: {spec!r}"
+        )
+    if set(sides[0]) & set(sides[1]):
+        raise ValueError(f"partition groups overlap: {spec!r}")
+    return tuple(sides)
 
 
 def _reject_leftovers(kv: dict, spec: str) -> dict:
@@ -191,6 +276,14 @@ class FaultPlan:
 
     def flap_faults(self) -> Tuple[Fault, ...]:
         return tuple(f for f in self.faults if f.kind == "flap")
+
+    def network_faults(self) -> Tuple[Fault, ...]:
+        """Faults applied from OUTSIDE the workers by the pod harness
+        (netns routes / tc shaping / whole-host kills), in step order."""
+        return tuple(sorted(
+            (f for f in self.faults if f.kind in NETWORK_KINDS),
+            key=lambda f: f.step,
+        ))
 
     def __bool__(self) -> bool:
         return bool(self.faults)

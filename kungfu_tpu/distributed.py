@@ -138,7 +138,10 @@ def init_distributed_runtime(coordinator_address: str, num_processes: int,
         missed_heartbeat_callback=_missed_heartbeat,
         shutdown_on_destruction=False, use_compression=True,
     )
+    global _client_connected
+    _client_connected = False
     state.client.connect()
+    _client_connected = True
     state.coordinator_address = coordinator_address
     state.num_processes = num_processes
     state.process_id = process_id
@@ -148,6 +151,21 @@ def init_distributed_runtime(coordinator_address: str, num_processes: int,
     # handler — the elastic loop re-installs its checkpoint-and-detach
     # handler after every re-init (elastic/trainer.py)
     state.initialize_preemption_sync_manager()
+
+
+# coordination services/clients parked by dirty teardowns.  NEVER shut down
+# or destroyed — not even at exit: a service shutdown is broadcast through
+# the error-poll channel and jaxlib's handler terminates the polling
+# process from a C++ thread (std::bad_cast), including THIS process's own
+# parked clients (observed: a worker finishing cleanly, then dying rc=-6
+# inside an atexit flush).  The references are held until the OS reclaims
+# everything at process death; the footprint is one idle listener + a few
+# threads per heal, bounded by heals-per-process-lifetime.
+_parked_services: list = []
+_parked_clients: list = []
+# did the CURRENT client's connect() complete?  shutdown() on a
+# never-connected client blocks unboundedly (see teardown below)
+_client_connected = False
 
 
 def teardown_distributed_runtime(graceful: bool = True) -> None:
@@ -164,18 +182,33 @@ def teardown_distributed_runtime(graceful: bool = True) -> None:
         jax.distributed.shutdown()  # no-op when already torn down
         return
     t0 = time.perf_counter()
-    try:
-        if state.client is not None:
-            state.client.shutdown()
-    except Exception as e:  # noqa: BLE001 - barrier with a dead task
-        log.warning("dirty teardown: client shutdown: %s", str(e)[:200])
+    if state.client is not None:
+        # PARK the client as well — neither shutdown() nor destruction is
+        # safe here.  shutdown() on a never-connected client blocks far
+        # past its timeout (observed: 120s, into the stall deadline), and a
+        # shutdown whose all-tasks barrier cannot complete (that is the
+        # definition of this path — a peer is dead) makes the service
+        # broadcast a barrier error to every OTHER still-connected agent,
+        # which jaxlib's error-poll handler answers by terminating those
+        # processes (std::bad_cast) — one rank's recovery must never
+        # execute its healthy peers.  Parked clients idle (their heartbeats
+        # against a parked/dead service hit the benign callback) and are
+        # dropped at process exit.
+        _parked_clients.append(state.client)
     state.client = None
-    try:
-        if state.service is not None:
-            state.service.shutdown()
-    except Exception as e:  # noqa: BLE001
-        log.warning("dirty teardown: service shutdown: %s", str(e)[:200])
-    state.service = None
+    if state.service is not None:
+        # PARK the coordination service instead of shutting it down: a
+        # service shutdown is pushed to every still-connected agent through
+        # the error-poll channel, and jaxlib's poll handler terminates the
+        # whole process from a C++ thread (coordination_service_agent.cc
+        # "Polled an error ..." -> std::bad_cast -> std::terminate).  A
+        # peer blocked in a collective two ring hops from the dead rank has
+        # seen NO error yet — killing it turns one host loss into a fleet
+        # loss.  Parked services idle on their version-fenced port (the
+        # next incarnation binds a different one) and are shut down at
+        # process exit, when nobody is left to terminate.
+        _parked_services.append(state.service)
+        state.service = None
     state.preemption_sync_manager = None
     state.coordinator_address = None
     # back to the single-process defaults: the CPU backend factory and

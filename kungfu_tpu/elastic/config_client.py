@@ -19,6 +19,7 @@ import json
 import random
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 from typing import Optional, Tuple
 
@@ -133,6 +134,95 @@ class ConfigClient:
         except urllib.error.HTTPError as e:
             log.warning("config PUT rejected: %s", e)
             return False
+
+    def reconvene_cluster(self, cluster: Cluster, version: int) -> bool:
+        """Conditional PUT that bumps the version even when the membership
+        is unchanged — the partition-heal nudge (docs/fault_tolerance.md).
+
+        Workers waiting in recovery only act on a strictly newer document;
+        after a partition heals the membership is correctly identical, so
+        the leader runner moves the version without moving the document.
+        Conditional-only: a racing shrink wins the CAS and this returns
+        False."""
+        body = json.dumps({"cluster": cluster.to_json(), "version": version,
+                           "reconvene": True}).encode()
+
+        def _put():
+            req = urllib.request.Request(
+                self.url, data=body, method="PUT",
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                return 200 <= r.status < 300
+
+        try:
+            return self._with_retry(_put, "config reconvene PUT")
+        except urllib.error.HTTPError:
+            return False  # version conflict: somebody else moved the doc
+
+    # -- KV liveness plane (runner heartbeats, suspicions, progress beacon) -----------
+
+    def kv_put(self, key: str, value) -> bool:
+        """PUT one JSON value under `<url>/kv/<key>`; the server stamps its
+        own receive time (`t_server`) so liveness never compares clocks
+        across hosts.  False when the server is unreachable — heartbeat
+        writers treat that as a skipped beat, not an error."""
+        body = json.dumps(value).encode()
+
+        def _put():
+            req = urllib.request.Request(
+                f"{self.url}/kv/{key}", data=body, method="PUT",
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                return 200 <= r.status < 300
+
+        try:
+            return self._with_retry(_put, f"kv PUT {key}")
+        except OSError:
+            return False
+
+    def kv_get(self, key: str) -> Optional[dict]:
+        """One entry as {"value": ..., "t_server": float}, or None."""
+
+        def _get():
+            with urllib.request.urlopen(f"{self.url}/kv/{key}",
+                                        timeout=self.timeout_s) as r:
+                return json.loads(r.read().decode())
+
+        try:
+            return self._with_retry(_get, f"kv GET {key}")
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise
+        except OSError:
+            return None
+
+    def kv_list(self, prefix: str = "") -> Optional[dict]:
+        """{"now": server_time, "entries": {key: {"value", "t_server"}}}
+        for keys under `prefix`, or None when the server is unreachable."""
+
+        def _get():
+            url = f"{self.url}/kv?prefix={urllib.parse.quote(prefix)}"
+            with urllib.request.urlopen(url, timeout=self.timeout_s) as r:
+                return json.loads(r.read().decode())
+
+        try:
+            return self._with_retry(_get, f"kv LIST {prefix}")
+        except OSError:
+            return None
+
+    def kv_delete(self, key: str) -> None:
+        def _delete():
+            req = urllib.request.Request(f"{self.url}/kv/{key}", method="DELETE")
+            with urllib.request.urlopen(req, timeout=self.timeout_s):
+                pass
+
+        try:
+            self._with_retry(_delete, f"kv DELETE {key}")
+        except OSError:
+            pass  # best-effort: a stale key is judged by its t_server anyway
 
     def clear(self) -> None:
         def _delete():

@@ -22,6 +22,24 @@ Two healing-era extensions over the reference:
     `"version": null` keeps the reference's unconditional semantics);
   - a `flap@config_server=...` fault in KFT_FAULT_PLAN makes the server
     answer 503 for the scripted window (chaos harness outage drills).
+
+Pod-scale extensions (docs/fault_tolerance.md "network failure model"):
+  - a tiny KV plane under `<url>/kv/<key>`: PUT stores a JSON value stamped
+    with the SERVER's receive time (`t_server` — liveness judgments never
+    compare clocks across hosts), GET returns one entry, GET
+    `<url>/kv?prefix=P` lists matching entries plus the server's `now`,
+    DELETE removes one.  Runner heartbeats (`runner-hb/<host>`), worker
+    recovery suspicions (`suspect/<peer>`) and the fleet progress beacon
+    (`progress`) live here.  Like /health, the KV plane answers inside a
+    chaos flap window — it is the liveness plane, and a flap that fakes
+    every runner's death would turn a control-plane brownout into a
+    full-fleet heal storm;
+  - a conditional PUT whose cluster bytes are IDENTICAL to the stored
+    document still bumps the version when the body carries
+    `"reconvene": true` — the launcher's partition-heal nudge: workers
+    waiting in recovery only act on a strictly newer version, and after a
+    partition heals the membership is (correctly) unchanged, so something
+    must move the version without moving the document.
 """
 from __future__ import annotations
 
@@ -43,6 +61,7 @@ class _State:
         self.cluster: Optional[Cluster] = init
         self.version = 0
         self.cleared = False
+        self.kv: dict = {}  # key -> {"value": ..., "t_server": float}
 
     def get(self) -> Optional[Tuple[Cluster, int]]:
         with self.lock:
@@ -50,7 +69,8 @@ class _State:
                 return None
             return self.cluster, self.version
 
-    def put(self, c: Cluster, expect_version: Optional[int] = None) -> Tuple[bool, str]:
+    def put(self, c: Cluster, expect_version: Optional[int] = None,
+            reconvene: bool = False) -> Tuple[bool, str]:
         try:
             c.validate()
         except ValueError as e:
@@ -64,11 +84,44 @@ class _State:
                 # document and re-derive its change (healer CAS loop)
                 return False, f"version conflict: expected {expect_version}, at {self.version}"
             if self.cluster is not None and c.bytes() == self.cluster.bytes():
-                return True, "unchanged"
+                if not (reconvene and expect_version is not None):
+                    return True, "unchanged"
+                # reconvene nudge: identical membership, version moves anyway
+                # (conditional-only, so it can never clobber a racing shrink)
+                self.version += 1
+                log.info("config reconvened at version %d (membership "
+                         "unchanged, %d workers)", self.version, c.size())
+                return True, "reconvened"
             self.cluster = c
             self.version += 1
             log.info("config updated to version %d (%d workers)", self.version, c.size())
             return True, "ok"
+
+    # -- KV liveness plane -----------------------------------------------------------
+
+    def kv_put(self, key: str, value) -> None:
+        import time as _time
+
+        with self.lock:
+            self.kv[key] = {"value": value, "t_server": round(_time.time(), 6)}
+
+    def kv_get(self, key: str) -> Optional[dict]:
+        with self.lock:
+            return self.kv.get(key)
+
+    def kv_list(self, prefix: str) -> dict:
+        import time as _time
+
+        with self.lock:
+            return {
+                "now": round(_time.time(), 6),
+                "entries": {k: dict(v) for k, v in self.kv.items()
+                            if k.startswith(prefix)},
+            }
+
+    def kv_delete(self, key: str) -> None:
+        with self.lock:
+            self.kv.pop(key, None)
 
     def post(self, c: Cluster) -> Tuple[bool, str]:
         try:
@@ -129,10 +182,38 @@ class ConfigServer:
                     return True
                 return False
 
+            def _kv_key(self) -> Optional[str]:
+                """The KV key for a `<anything>/kv/<key>` or `/kv?prefix=`
+                path, or None when this is not a KV request."""
+                path = self.path
+                if "/kv/" in path:
+                    return path.split("/kv/", 1)[1].split("?", 1)[0]
+                if path.split("?", 1)[0].rstrip("/").endswith("/kv"):
+                    return ""  # list form
+                return None
+
             def do_GET(self):
                 if self.path.startswith("/stop"):
                     self._send(200, b"{}")
                     threading.Thread(target=stop_cb, daemon=True).start()
+                    return
+                key = self._kv_key()
+                if key is not None:
+                    # KV is the liveness plane: served inside flap windows
+                    # (a flap that faked every runner heartbeat stale would
+                    # turn a control-plane brownout into a heal storm)
+                    if key == "":
+                        from urllib.parse import parse_qs, urlsplit
+
+                        q = parse_qs(urlsplit(self.path).query)
+                        prefix = (q.get("prefix") or [""])[0]
+                        self._send(200, json.dumps(state.kv_list(prefix)).encode())
+                        return
+                    got = state.kv_get(key)
+                    if got is None:
+                        self._send(404, b'{"error": "no such key"}')
+                        return
+                    self._send(200, json.dumps(got).encode())
                     return
                 if self.path.rstrip("/").endswith("/health"):
                     # liveness endpoint: served even inside a chaos flap
@@ -165,13 +246,31 @@ class ConfigServer:
                     return None
 
             def do_PUT(self):
+                key = self._kv_key()
+                if key:
+                    try:
+                        n = int(self.headers.get("Content-Length", "0"))
+                        value = json.loads(self.rfile.read(n).decode() or "null")
+                    except ValueError as e:
+                        self._send(400, json.dumps({"error": str(e)}).encode())
+                        return
+                    state.kv_put(key, value)
+                    self._send(200, b"{}")
+                    return
                 if self._flapped():
                     return
-                got = self._read_cluster()
-                if got is None:
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    doc = json.loads(self.rfile.read(n).decode())
+                    payload = doc.get("cluster", doc)
+                    version = doc.get("version") if isinstance(doc, dict) else None
+                    reconvene = bool(isinstance(doc, dict) and doc.get("reconvene"))
+                    c = Cluster.from_json(payload)
+                except Exception as e:
+                    self._send(400, json.dumps({"error": str(e)}).encode())
                     return
-                c, expect_version = got
-                ok, msg = state.put(c, expect_version)
+                expect_version = int(version) if version is not None else None
+                ok, msg = state.put(c, expect_version, reconvene=reconvene)
                 self._send(200 if ok else 409, json.dumps({"msg": msg}).encode())
 
             def do_POST(self):
@@ -184,6 +283,11 @@ class ConfigServer:
                 self._send(200 if ok else 409, json.dumps({"msg": msg}).encode())
 
             def do_DELETE(self):
+                key = self._kv_key()
+                if key:
+                    state.kv_delete(key)
+                    self._send(200, b"{}")
+                    return
                 state.delete()
                 self._send(200, b"{}")
 

@@ -636,6 +636,49 @@ def run_elastic(
               flush=True)
         sys.exit(0)
 
+    def _put_suspect(reason: str, step: int) -> None:
+        """Best-effort `suspect/<self>` KV report on entering recovery."""
+        if client is None:
+            return
+        kv_put = getattr(client, "kv_put", None)
+        if kv_put is None:
+            return
+        try:
+            kv_put(f"suspect/{peer.self_id}",
+                   {"reason": reason, "step": int(step),
+                    "cluster_version": peer.cluster_version})
+        except Exception as e:  # noqa: BLE001 - control-plane brownout
+            log.debug("suspect report failed: %s", e)
+
+    def _clear_suspect() -> None:
+        if client is None:
+            return
+        kv_delete = getattr(client, "kv_delete", None)
+        if kv_delete is None:
+            return
+        try:
+            kv_delete(f"suspect/{peer.self_id}")
+        except Exception as e:  # noqa: BLE001
+            log.debug("suspect clear failed: %s", e)
+
+    # progress beacon for the pod harness: step-keyed NETWORK faults
+    # (partition/kill_host/degrade_link) are applied from the root namespace,
+    # which cannot see any worker's step counter — rank 0 publishes it to
+    # the config server's KV plane every check_every steps when armed.
+    _beacon_armed = bool(os.environ.get("KFT_PROGRESS_BEACON")) and client is not None
+
+    def _beacon(step: int) -> None:
+        if not _beacon_armed or peer.rank != 0 or step % cfg.check_every:
+            return
+        kv_put = getattr(client, "kv_put", None)
+        if kv_put is None:
+            return
+        try:
+            kv_put("progress", {"step": int(step), "size": peer.size,
+                                "cluster_version": peer.cluster_version})
+        except Exception as e:  # noqa: BLE001
+            log.debug("progress beacon failed: %s", e)
+
     def _recover(cause: BaseException) -> None:
         """Suspected-dead-peer path: checkpoint -> dirty teardown -> wait for
         the healer's shrunk document -> re-rendezvous -> re-sync state."""
@@ -650,6 +693,12 @@ def run_elastic(
                     type(cause).__name__, str(cause)[:200])
         journal_event("peer_failure_suspected", reason=type(cause).__name__,
                       detail=str(cause)[:200], step=step, old_size=old_size)
+        # file a suspicion with the control plane: the launchers' remote-host
+        # judgment (RemoteHostJudge) reads `suspect/` entries to distinguish
+        # a partition (every runner heartbeat fresh -> partition_suspected,
+        # reconvene nudges, NO shrink) from a host death.  Best-effort: the
+        # judgment also works from runner heartbeats alone.
+        _put_suspect(reason=type(cause).__name__, step=step)
         phases: Dict[str, float] = {}
         # climb the recovery ladder: buddy RAM tier (live buffers -> own
         # rolling snapshot -> fetch-back from the buddy peer) before any
@@ -702,7 +751,12 @@ def run_elastic(
         tracing.record_span("heal:detect", m_detect, m_td0, cat="heal",
                             args={"reason": type(cause).__name__})
         phases["detect_s"] = round(m_td0 - m_detect, 4)
-        _teardown_backend(graceful=False, peer=peer)
+        # the teardown's bounded shutdown waits run for seconds with no
+        # step-loop heartbeat touch — under the watchdog the ticker keeps
+        # the launcher-facing liveness fresh (a worker mid-heal must read
+        # as slow-but-alive, never as frozen)
+        with stall_detector("heal_teardown", force=True):
+            _teardown_backend(graceful=False, peer=peer)
         m_rdv0 = time.monotonic()
         tracing.record_span("heal:teardown", m_td0, m_rdv0, cat="heal")
         phases["teardown_s"] = round(m_rdv0 - m_td0, 4)
@@ -725,7 +779,23 @@ def run_elastic(
                 sys.exit(HEAL_WAIT_EXIT_CODE)
             cluster, version = got
             try:
-                if not peer.update_cluster(cluster, version):
+                try:
+                    with stall_detector("heal_re_rendezvous", force=True):
+                        joined = peer.update_cluster(cluster, version)
+                except (KeyboardInterrupt, SystemExit):
+                    raise
+                except Exception as e:  # noqa: BLE001 - re-init is retryable
+                    # the re-rendezvous includes peers that may be dead or
+                    # unreachable (a partition mid-heal surfaces as opaque
+                    # C++ client errors, e.g. std::bad_cast from a connect
+                    # that cannot reach the coordinator) — ANY init failure
+                    # here means "this document didn't convene"; tear down
+                    # and wait for a newer one (reconvene bumps keep coming
+                    # while the partition lasts)
+                    raise TimeoutError(
+                        f"re-rendezvous at v{version} failed: "
+                        f"{type(e).__name__}: {str(e)[:200]}") from e
+                if not joined:
                     # the healer decided WE were the dead one (e.g. a hang
                     # that un-wedged after the heartbeat timeout): bow out
                     print(f"DETACHED: rank left cluster at version {version}",
@@ -761,10 +831,17 @@ def run_elastic(
                     "newer cluster document", version, type(e).__name__,
                     str(e)[:200],
                 )
+                # re-file the suspicion at the version that just failed:
+                # suspects older than the current document carry no
+                # partition evidence (a membership change answered them),
+                # so a live partition must keep its evidence fresh for the
+                # leader's reconvene nudges to continue
+                _put_suspect(reason=type(e).__name__, step=step)
                 trainer = programs = None
                 gc.collect()
                 m_rt0 = time.monotonic()
-                _teardown_backend(graceful=False, peer=peer)
+                with stall_detector("heal_teardown", force=True):
+                    _teardown_backend(graceful=False, peer=peer)
                 tracing.record_span("heal:teardown", m_rt0, cat="heal",
                                     args={"retry": True})
                 continue
@@ -790,6 +867,7 @@ def run_elastic(
         # the healed membership has new ranks: re-derive the buddy ring and
         # seed it so a back-to-back second failure still finds the RAM tier
         _rebuild_buddy(seed=True)
+        _clear_suspect()  # recovered: withdraw the partition-evidence report
         _pending_heal = {
             "version": version, "old_size": old_size, "new_size": peer.size,
             "reason": type(cause).__name__, "t_detect": t_detect,
@@ -809,6 +887,7 @@ def run_elastic(
             _detach_preempted()
         if hb_file:
             _touch(hb_file)  # liveness signal for the healer's hang detection
+        _beacon(step)
         if chaos is not None:
             # ckpt_dir arms the checkpoint-integrity faults (corrupt_ckpt)
             chaos.on_step(step, chaos_rank, ckpt_dir=cfg.checkpoint_dir)

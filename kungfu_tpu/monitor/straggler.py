@@ -243,6 +243,13 @@ class StragglerDetector:
         med_skew = statistics.median(means.values()) if means else 0.0
         floor_ms = max(self.min_skew_ms, self.rel_frac * med_step_ms)
 
+        # leave-one-out moments from two precomputed sums: O(ranks) total,
+        # not O(ranks^2) — at the 64-256-rank pod scale the quadratic form
+        # made every /stragglers report a re-walk of every pair
+        n_means = len(means)
+        s1 = sum(means.values())
+        s2 = sum(v * v for v in means.values())
+
         ranks_out: Dict[str, Any] = {}
         for r, st in sorted(self._ranks.items()):
             stats: Dict[str, Any] = {
@@ -253,11 +260,14 @@ class StragglerDetector:
                 if st.step_ms else None,
             }
             flagged_now = False
-            if r in means and len(means) >= 2:
+            if r in means and n_means >= 2:
                 m = means[r]
-                others = [v for rr, v in means.items() if rr != r]
-                mu = statistics.fmean(others)
-                sd = statistics.pstdev(others) if len(others) > 1 else 0.0
+                k = n_means - 1
+                mu = (s1 - m) / k
+                # population variance of the others via the moment identity;
+                # clamp tiny negative float residue
+                var = max(0.0, (s2 - m * m) / k - mu * mu) if k > 1 else 0.0
+                sd = math.sqrt(var)
                 # floor the spread: a fleet of near-identical peers must
                 # not z-flag microsecond jitter
                 sd_eff = max(sd, 0.05 * max(med_step_ms, 1.0), 1.0)
@@ -652,10 +662,15 @@ class StragglerMonitor:
             for r in per_rank:
                 self.detector.add_sample(r, skews[r] * 1e3)
             self.matched += 1
-        # bound memory: a rank that left the fleet strands its pending keys
+        # bound memory: a rank that left the fleet strands its pending keys.
+        # One sorted pass over the overflow — the old pop(min(...)) loop
+        # was quadratic in the overflow size, which a 128-rank heal storm
+        # turns into a real stall inside the report path.
         for table in (self._pending_steps, self._pending_coll):
-            while len(table) > self.max_pending:
-                table.pop(min(table))
+            excess = len(table) - self.max_pending
+            if excess > 0:
+                for key in sorted(table)[:excess]:
+                    table.pop(key)
 
     def report(self, ranks_expected: Optional[set] = None,
                scrape_errors: Optional[Dict[int, str]] = None) -> Dict[str, Any]:

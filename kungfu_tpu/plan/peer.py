@@ -124,9 +124,11 @@ class PeerList(tuple):
         whose peer lives on a *different host* — falling back to the plain
         k=1 ring when the cluster is single-host (CPU test shape, where host
         disjointness is unsatisfiable).  Guarantees: never self (n > 1),
-        host-disjoint whenever more than one host exists, and deterministic
-        from the document alone so every peer computes the same assignment
-        without coordination.  Recomputed on every resize/heal (ranks shift).
+        host-disjoint whenever more than one host exists — asserted below,
+        because `kill_host` drills stake RPO=0 on it: a snapshot and its
+        only copy must never share a host — and deterministic from the
+        document alone so every peer computes the same assignment without
+        coordination.  Recomputed on every resize/heal (ranks shift).
         A single peer has nobody to buddy with: buddies == [-1].
         """
         n = len(self)
@@ -142,6 +144,14 @@ class PeerList(tuple):
             else:
                 k = 1
             out.append((r + k) % n)
+        if multi_host:
+            # the cross-host invariant is load-bearing (whole-host loss must
+            # never take a snapshot and its copy together) — fail loudly at
+            # assignment time, not silently at recovery time
+            assert all(self[b].host != p.host for p, b in zip(self, out)), (
+                f"ring_buddies produced a same-host pair on a multi-host "
+                f"document: {self!r} -> {out}"
+            )
         return out
 
     def diff(self, other: "PeerList") -> "PeerList":

@@ -75,6 +75,13 @@ class ReplanPolicy(BasePolicy):
         self._since_replan = cooldown_steps  # first trigger may fire at once
         self._last_world = planner.session.size
         self._gns_high: Optional[bool] = None
+        # sustained-trigger backoff: a signal replanning cannot fix (a
+        # permanently slow rank at pod scale keeps the straggler trigger
+        # truthy forever) must not re-run the full search every cooldown —
+        # consecutive same-reason replans double the effective cooldown up
+        # to 8x, and any trigger-free step resets the streak
+        self._last_reason: Optional[str] = None
+        self._reason_streak = 0
 
     # -- triggers ---------------------------------------------------------------------
 
@@ -113,18 +120,34 @@ class ReplanPolicy(BasePolicy):
 
     # -- policy hooks -----------------------------------------------------------------
 
+    def effective_cooldown(self, reason: str) -> int:
+        """Cooldown for this trigger: base, doubled per consecutive
+        same-reason replan beyond the first (cap 8x) — the churn bound for
+        signals a replan cannot clear."""
+        if reason == self._last_reason and self._reason_streak >= 2:
+            return self.cooldown_steps * min(2 ** (self._reason_streak - 1), 8)
+        return self.cooldown_steps
+
     def after_step(self, metrics: Optional[Dict[str, Any]] = None) -> None:
         self._step += 1
         self._since_replan += 1
         reason = self.trigger_reason(metrics)
         if reason is None:
+            self._last_reason = None
+            self._reason_streak = 0
             return
-        if reason != "resize" and self._since_replan < self.cooldown_steps:
+        cooldown = self.effective_cooldown(reason)
+        if reason != "resize" and self._since_replan < cooldown:
             log.info("replan trigger %r suppressed (cooldown %d/%d)",
-                     reason, self._since_replan, self.cooldown_steps)
+                     reason, self._since_replan, cooldown)
             return
         self._since_replan = 0
         self._last_world = self.planner.session.size
+        if reason == self._last_reason:
+            self._reason_streak += 1
+        else:
+            self._last_reason = reason
+            self._reason_streak = 1
         self.replans += 1
         log.info("replan #%d (reason=%s, step=%d)",
                  self.replans, reason, self._step)

@@ -90,6 +90,26 @@ class BuddySnapshots:
         self._own: Optional[Dict[str, Any]] = None
         self._name = f"{SNAP_NAME_PREFIX}{peer.self_id}"
         self._client = None  # dedicated short-deadline client, lazily built
+        # cross-host placement is what makes `kill_host` RPO=0: a whole-host
+        # loss must never destroy a snapshot and its only copy together.
+        # ring_buddies asserts this in-process; the journal event is the
+        # fleet-visible trail a drill can assert ZERO of (and the honest
+        # record if a future assignment change ever regresses it).
+        peers = peer.config.peers
+        self.cross_host = (
+            self.buddy_rank >= 0
+            and peers[self.buddy_rank].host != peer.self_id.host
+        )
+        if (self.buddy_rank >= 0 and peers.host_count() > 1
+                and not self.cross_host):
+            from ..monitor.journal import journal_event
+
+            log.error("buddy for rank %d is CO-LOCATED on %s — a host loss "
+                      "can take the snapshot and its copy together",
+                      self.rank, peer.self_id.host)
+            journal_event("buddy_colocated", rank=self.rank,
+                          buddy=self.buddy_rank, host=peer.self_id.host)
+            self._count("buddy_colocated")
 
     # -- write side (the step loop) ---------------------------------------------------
 

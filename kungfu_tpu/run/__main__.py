@@ -73,6 +73,13 @@ def main(argv=None):
              "(0 = disabled; catches hung-not-crashed workers)",
     )
     ap.add_argument(
+        "-suspicion-timeout", dest="suspicion_timeout", type=float, default=0.0,
+        help="heal mode: seconds a REMOTE host's runner heartbeat must stay "
+             "silent before its workers are shrunk out (partition-vs-death "
+             "window, docs/fault_tolerance.md; 0 = auto from "
+             "-heartbeat-timeout)",
+    )
+    ap.add_argument(
         "-telemetry", dest="telemetry", action="store_true",
         help="fleet telemetry: enable worker monitoring+tracing+journal and "
              "serve merged /metrics and /timeline from this runner",
@@ -184,6 +191,7 @@ def main(argv=None):
                 job, self_host, client, logdir=args.logdir, quiet=args.quiet,
                 keep=args.keep, heal=args.heal, restart_budget=args.restart_budget,
                 heartbeat_timeout_s=args.heartbeat_timeout,
+                suspicion_s=args.suspicion_timeout,
             )
             rc = runner.run(initial=cluster, timeout_s=args.timeout)
             if runner.heal_events:

@@ -71,6 +71,14 @@ class Job:
             # loop to chase newer cluster documents (default init timeout is
             # 300s — longer than most heal budgets); user env wins
             env.setdefault("KFT_INIT_TIMEOUT_S", "45")
+            # peer-death detection belongs to the HEALER (heartbeats +
+            # suspicion window), not to XLA's coordination service: its
+            # ~100s missed-heartbeat broadcast reaches still-blocked peers
+            # through the error-poll channel, which jaxlib handles by
+            # terminating the process from a C++ thread (std::bad_cast) —
+            # turning one death into a fleet kill.  Push it past every
+            # drill/heal horizon; user env wins
+            env.setdefault("KFT_MAX_MISSING_HEARTBEATS", "100")
         if self.heartbeat_dir:
             # keyed on peer identity, not rank: ranks shift across resizes
             env["KFT_HEARTBEAT_FILE"] = os.path.join(

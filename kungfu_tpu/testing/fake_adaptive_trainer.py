@@ -77,10 +77,15 @@ def main(argv=None) -> int:
     )
     mesh = out["trainer"].mesh
     mesh_desc = ",".join(f"{a}:{mesh.shape[a]}" for a in mesh.axis_names)
+    # `seconds` trails the line: existing RESULT regexes (chaos drills, the
+    # netns drill) match a prefix and must keep doing so.  It is the
+    # training-window wall time (post-initial-sync -> done), the honest
+    # denominator for the pod drill's weak-scaling throughput.
     print(
         f"RESULT: fake-adaptive trained={out['trained_samples']} "
         f"resizes={out['resizes']} final_size={out['final_size']} "
-        f"mesh={mesh_desc} loss={out['loss']:.4f} heals={out['heals']}",
+        f"mesh={mesh_desc} loss={out['loss']:.4f} heals={out['heals']} "
+        f"seconds={out['seconds']:.3f}",
         flush=True,
     )
     if out["heal_events"]:

@@ -1,0 +1,528 @@
+"""Simulated pod harness — M netns "hosts" x K workers over a shaped DCN.
+
+The netns cluster drill (scripts/netns_cluster_drill.py) proved the elastic
+runtime against real network isolation at 3 ranks; the failure modes the
+KungFu paper and the MLPerf TPU-v3 pod study actually care about (DCN
+hotspots, correlated whole-host loss, partitions, stragglers) only appear
+at scale and at the *network* layer.  This module grows that drill into a
+reusable pod:
+
+  topology    one bridge in the root namespace (the "DCN fabric", config
+              server on the gateway IP) + M network namespaces (the
+              "hosts"), each veth-attached with its own IP and running one
+              heal-armed launcher with K worker slots.  Same-host worker
+              traffic rides the namespace's loopback (the ICI stand-in);
+              anything cross-host crosses the veth bridge (the DCN tier) —
+              a real, measurable asymmetry once the links are shaped.
+  shaping     per-host link shaping on BOTH directions of the veth pair:
+              `tc netem` (delay / jitter / loss / rate) where the kernel
+              has it, a `tbf` rate-cap fallback where it does not, honest
+              `shaping="none"` otherwise.  The probe result is stamped on
+              every drill record — an unshaped run must never masquerade
+              as a shaped one.
+  faults      the network half of the chaos grammar (kungfu_tpu/chaos):
+              `partition@...` installs bidirectional `unreachable` routes
+              between the two host groups (sends fail FAST with
+              EHOSTUNREACH — the worker recovery path needs a catchable
+              error, not a silent 15-minute TCP stall; the config server
+              on the gateway stays reachable from both sides, modelling
+              the control plane's separate network), `degrade_link@...`
+              re-shapes one host's link mid-run, `kill_host@...` SIGKILLs
+              a host's launcher and all K of its workers at once.
+  progress    step-keyed network faults are applied from the ROOT
+              namespace, which cannot see any worker's step counter —
+              rank 0 publishes it via the config server's KV plane
+              (`progress` key, KFT_PROGRESS_BEACON) and `PlanExecutor`
+              fires each fault when the fleet reaches its step.
+
+Needs root + the `ip` tool (CAP_NET_ADMIN); `pod_available()` probes.
+Driven by scripts/pod_drill.py (drills, CI smoke, the scaling-bench arm).
+"""
+from __future__ import annotations
+
+import dataclasses
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BRIDGE = "kfpodbr"
+NS_PREFIX = "kfpod"
+DEFAULT_SUBNET = "10.78.0"
+HOST_IP_BASE = 10  # host i -> 10.78.0.(10+i)
+CS_PORT = 9200
+
+
+def sh(cmd: str, check: bool = True, **kw) -> subprocess.CompletedProcess:
+    return subprocess.run(cmd, shell=True, check=check,
+                          capture_output=True, text=True, **kw)
+
+
+def pod_available() -> bool:
+    """True iff we can create a netns + veth pair here (root + ip tool)."""
+    if os.geteuid() != 0:
+        return False
+    probe = sh("ip netns add kfpodprobe && ip link add kfpodprV type veth "
+               "peer name kfpodprP", check=False)
+    sh("ip link del kfpodprV 2>/dev/null; ip netns del kfpodprobe 2>/dev/null",
+       check=False)
+    return probe.returncode == 0
+
+
+_shaping_mode: Optional[str] = None
+
+
+def shaping_mode() -> str:
+    """"netem" (full delay/jitter/loss/rate), "tbf" (rate cap only), or
+    "none".  Probed once on a scratch veth — netem is a kernel module
+    (sch_netem) that minimal container kernels often lack."""
+    global _shaping_mode
+    if _shaping_mode is not None:
+        return _shaping_mode
+    mode = "none"
+    if os.geteuid() == 0:
+        mk = sh("ip link add kfpodshV type veth peer name kfpodshP", check=False)
+        if mk.returncode == 0:
+            if sh("tc qdisc add dev kfpodshV root netem delay 1ms",
+                  check=False).returncode == 0:
+                mode = "netem"
+            elif sh("tc qdisc add dev kfpodshV root tbf rate 100mbit "
+                    "burst 32kbit latency 400ms", check=False).returncode == 0:
+                mode = "tbf"
+            sh("ip link del kfpodshV", check=False)
+    _shaping_mode = mode
+    return mode
+
+
+@dataclasses.dataclass(frozen=True)
+class LinkShape:
+    """Per-host DCN link shape, applied to EACH direction of the veth pair
+    (latency_ms is the one-way delay; a cross-host round trip pays 2x)."""
+
+    latency_ms: float = 0.0
+    jitter_ms: float = 0.0
+    loss_pct: float = 0.0
+    rate_mbit: float = 0.0
+
+    def __bool__(self) -> bool:
+        return bool(self.latency_ms or self.jitter_ms or self.loss_pct
+                    or self.rate_mbit)
+
+    def tc_spec(self, mode: str) -> str:
+        """The qdisc spec for this shape under the probed capability, or ""
+        when nothing of the shape is expressible (the caller stamps the
+        degradation honestly instead of silently dropping it)."""
+        if mode == "netem":
+            parts = ["netem"]
+            if self.latency_ms:
+                parts.append(f"delay {self.latency_ms:g}ms")
+                if self.jitter_ms:
+                    parts.append(f"{self.jitter_ms:g}ms")
+            if self.loss_pct:
+                parts.append(f"loss {self.loss_pct:g}%")
+            if self.rate_mbit:
+                parts.append(f"rate {self.rate_mbit:g}mbit")
+            return " ".join(parts) if len(parts) > 1 else ""
+        if mode == "tbf" and self.rate_mbit:
+            return (f"tbf rate {self.rate_mbit:g}mbit burst 32kbit "
+                    f"latency 400ms")
+        return ""
+
+
+@dataclasses.dataclass
+class PodSpec:
+    hosts: int = 4
+    workers_per_host: int = 1
+    shape: LinkShape = dataclasses.field(default_factory=LinkShape)
+    subnet: str = DEFAULT_SUBNET
+    heartbeat_timeout_s: float = 5.0
+    suspicion_s: float = 6.0
+    init_timeout_s: float = 20.0
+    check_every: int = 2
+
+    @property
+    def world(self) -> int:
+        return self.hosts * self.workers_per_host
+
+    def host_ip(self, i: int) -> str:
+        """Host i (0-based) -> its namespace IP."""
+        return f"{self.subnet}.{HOST_IP_BASE + i}"
+
+    @property
+    def gateway(self) -> str:
+        return f"{self.subnet}.1"
+
+    def hostlist(self, hosts: Optional[int] = None) -> str:
+        n = self.hosts if hosts is None else hosts
+        return ",".join(f"{self.host_ip(i)}:{self.workers_per_host}"
+                        for i in range(n))
+
+
+class Pod:
+    """One simulated pod: bridge + namespaces + per-host launchers.
+
+    Lifecycle: setup() -> spawn(worker_cmd) -> [faults/progress polling]
+    -> wait()/poll() -> teardown().  Always teardown() in a finally —
+    namespaces and qdiscs outlive dead processes.
+    """
+
+    def __init__(self, spec: PodSpec, workdir: Optional[str] = None,
+                 extra_env: Optional[Dict[str, str]] = None):
+        self.spec = spec
+        self.workdir = workdir or tempfile.mkdtemp(prefix="kfpod-")
+        self.extra_env = dict(extra_env or {})
+        self.shaping = shaping_mode()
+        self.launchers: Dict[str, subprocess.Popen] = {}  # host ip -> launcher
+        self.procs: List[subprocess.Popen] = []  # everything spawned (cs first)
+        self.logs: Dict[str, str] = {}
+        self._partition_routes: List[Tuple[str, str]] = []  # (ns, dst_ip)
+        self._client = None
+        self.journal_dir = os.path.join(self.workdir, "journal")
+        os.makedirs(self.journal_dir, exist_ok=True)
+
+    # -- topology ---------------------------------------------------------------------
+
+    def _ns(self, i: int) -> str:
+        return f"{NS_PREFIX}{i}"
+
+    def host_index(self, host: str) -> int:
+        """Resolve "h<N>" (1-based), a bare index, or an IP to a host index."""
+        s = str(host).strip()
+        if s.startswith("h") and s[1:].isdigit():
+            return int(s[1:]) - 1
+        if s.isdigit():
+            return int(s)
+        for i in range(self.spec.hosts):
+            if self.spec.host_ip(i) == s:
+                return i
+        raise ValueError(f"unknown pod host {host!r}")
+
+    def setup(self) -> None:
+        import socket as _socket
+
+        self.teardown_network()  # clear leftovers from a crashed prior run
+        sh(f"ip link add {BRIDGE} type bridge")
+        sh(f"ip addr add {self.spec.gateway}/24 dev {BRIDGE}")
+        sh(f"ip link set {BRIDGE} up")
+        hostname = _socket.gethostname()
+        for i in range(self.spec.hosts):
+            ns, ip = self._ns(i), self.spec.host_ip(i)
+            sh(f"ip netns add {ns}")
+            # namespace deletion is asynchronous in the kernel: a veth from
+            # a just-torn-down pod can briefly outlive its namespace and
+            # collide with this name — delete-then-add is idempotent
+            sh(f"ip link del {NS_PREFIX}v{i}", check=False)
+            sh(f"ip link add {NS_PREFIX}v{i} type veth peer name eth0 netns {ns}")
+            sh(f"ip link set {NS_PREFIX}v{i} master {BRIDGE} up")
+            sh(f"ip netns exec {ns} ip addr add {ip}/24 dev eth0")
+            sh(f"ip netns exec {ns} ip link set eth0 up")
+            sh(f"ip netns exec {ns} ip link set lo up")
+            # Gloo advertises the address the HOSTNAME resolves to; inside a
+            # namespace that is 127.0.0.1 unless overridden (ip netns exec
+            # bind-mounts /etc/netns/<ns>/* over /etc)
+            os.makedirs(f"/etc/netns/{ns}", exist_ok=True)
+            with open(f"/etc/netns/{ns}/hosts", "w") as f:
+                f.write(f"127.0.0.1 localhost\n{ip} {hostname}\n")
+            self._apply_shape(i, self.spec.shape)
+
+    def _apply_shape(self, i: int, shape: LinkShape, replace: bool = False) -> None:
+        spec = shape.tc_spec(self.shaping)
+        verb = "replace" if replace else "add"
+        if not spec:
+            if replace:  # clearing a degradation back to an unshaped base
+                sh(f"tc qdisc del dev {NS_PREFIX}v{i} root", check=False)
+                sh(f"ip netns exec {self._ns(i)} tc qdisc del dev eth0 root",
+                   check=False)
+            return
+        # both directions: root-side veth egress = toward the host,
+        # ns-side eth0 egress = from the host
+        sh(f"tc qdisc {verb} dev {NS_PREFIX}v{i} root {spec}", check=False)
+        sh(f"ip netns exec {self._ns(i)} tc qdisc {verb} dev eth0 root {spec}",
+           check=False)
+
+    # -- fleet ------------------------------------------------------------------------
+
+    def _env(self) -> dict:
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env.pop("XLA_FLAGS", None)
+        env.pop("JAX_PLATFORMS", None)
+        env["KFT_PROGRESS_BEACON"] = "1"
+        env["KFT_JOURNAL_DIR"] = self.journal_dir
+        # recovery re-rendezvous must fail fast enough that reconvene
+        # attempts during a live partition do not eat the drill budget
+        env["KFT_INIT_TIMEOUT_S"] = str(int(self.spec.init_timeout_s))
+        # dirty-teardown shutdown barriers against dead/parked incarnations
+        # must not eat the drill budget
+        env["KFT_SHUTDOWN_TIMEOUT_S"] = "5"
+        env.update(self.extra_env)
+        return env
+
+    @property
+    def config_url(self) -> str:
+        return f"http://{self.spec.gateway}:{CS_PORT}/config"
+
+    def client(self):
+        if self._client is None:
+            from ..elastic.config_client import ConfigClient
+
+            self._client = ConfigClient(self.config_url, timeout_s=3.0,
+                                        retries=1, retry_deadline_s=3.0)
+        return self._client
+
+    def spawn(self, worker_cmd: Sequence[str], np: Optional[int] = None,
+              strategy: str = "", timeout_s: float = 600.0) -> None:
+        """Config server on the gateway + one heal-armed watch launcher per
+        host namespace, all running `worker_cmd` workers."""
+        from ..plan import Cluster, HostList
+
+        env = self._env()
+        np = self.spec.world if np is None else np
+        hostlist = self.spec.hostlist()
+        cluster = Cluster.from_hostlist(HostList.parse(hostlist), np)
+        with tempfile.NamedTemporaryFile("w", suffix=".json", delete=False,
+                                         dir=self.workdir) as f:
+            json.dump(cluster.to_json(), f)
+            init_path = f.name
+        cs = subprocess.Popen(
+            [sys.executable, "-m", "kungfu_tpu.elastic.config_server",
+             "-host", self.spec.gateway, "-port", str(CS_PORT),
+             "-init", init_path],
+            env=env, start_new_session=True, cwd=REPO,
+        )
+        self.procs.append(cs)
+        time.sleep(1.0)
+        for i in range(self.spec.hosts):
+            ns, ip = self._ns(i), self.spec.host_ip(i)
+            log_path = os.path.join(self.workdir, f"launcher-{ns}.log")
+            self.logs[ip] = log_path
+            cmd = ["ip", "netns", "exec", ns,
+                   sys.executable, "-m", "kungfu_tpu.run", "-w", "-heal",
+                   "-H", hostlist, "-np", str(np), "-self", ip,
+                   "-config-server", self.config_url,
+                   "-platform", "cpu",
+                   "-heartbeat-timeout", str(self.spec.heartbeat_timeout_s),
+                   "-suspicion-timeout", str(self.spec.suspicion_s),
+                   "-timeout", str(timeout_s)]
+            if strategy:
+                cmd += ["-strategy", strategy]
+            cmd += ["--"] + list(worker_cmd)
+            p = subprocess.Popen(
+                cmd, env=env, stdout=open(log_path, "w"),
+                stderr=subprocess.STDOUT, start_new_session=True, cwd=REPO,
+            )
+            self.launchers[ip] = p
+            self.procs.append(p)
+
+    # -- fault application ------------------------------------------------------------
+
+    def partition(self, groups: Sequence[Sequence[str]]) -> None:
+        """Split the pod: bidirectional `unreachable` routes between the two
+        groups.  Sends fail immediately with EHOSTUNREACH — a catchable
+        peer-failure error, not a silent TCP retransmit stall.  The config
+        server (gateway) stays reachable from both sides."""
+        from ..peer import COORDINATOR_PORT_OFFSET, COORDINATOR_PORT_WINDOW
+        from ..plan.peer import DEFAULT_WORKER_PORT_BASE
+
+        lo = DEFAULT_WORKER_PORT_BASE + COORDINATOR_PORT_OFFSET
+        hi = lo + COORDINATOR_PORT_WINDOW
+        a = [self.host_index(h) for h in groups[0]]
+        b = [self.host_index(h) for h in groups[1]]
+        for src, dst in [(a, b), (b, a)]:
+            for i in src:
+                ns = self._ns(i)
+                for j in dst:
+                    ip = self.spec.host_ip(j)
+                    sh(f"ip netns exec {ns} ip route add unreachable {ip}/32",
+                       check=False)
+                    self._partition_routes.append((ns, ip))
+                    # established DATA flows must die too: a worker blocked
+                    # in a cross-partition recv on a quiet socket would wait
+                    # out TCP retransmission instead of failing fast.  The
+                    # coordination-service window is exempt — those links go
+                    # quiet (blackholed), NOT aborted: an abort reaches the
+                    # agents through jaxlib's error-poll channel, which
+                    # terminates the process (std::bad_cast) instead of
+                    # surfacing a benign missed heartbeat.
+                    sh(f"ip netns exec {ns} ss -K dst {ip} "
+                       f"'( dport lt :{lo} or dport gt :{hi} )' and "
+                       f"'( sport lt :{lo} or sport gt :{hi} )'",
+                       check=False)
+
+    def heal_partition(self) -> None:
+        for ns, ip in self._partition_routes:
+            sh(f"ip netns exec {ns} ip route del unreachable {ip}/32",
+               check=False)
+        self._partition_routes = []
+
+    def degrade(self, host: str, latency_ms: float = 0.0, loss_pct: float = 0.0,
+                rate_mbit: float = 0.0) -> str:
+        """Re-shape one host's link mid-run; returns the applied tc spec
+        ("" when the capability cannot express it — stamp it, don't lie)."""
+        i = self.host_index(host)
+        shape = LinkShape(latency_ms=latency_ms, loss_pct=loss_pct,
+                          rate_mbit=rate_mbit)
+        self._apply_shape(i, shape, replace=True)
+        return shape.tc_spec(self.shaping)
+
+    def clear_degrade(self, host: str) -> None:
+        """Restore the host's base shape (or unshaped)."""
+        self._apply_shape(self.host_index(host), self.spec.shape, replace=True)
+
+    def kill_host(self, host: str) -> str:
+        """SIGKILL a host's launcher AND all its workers at once (one
+        process group) — correlated whole-host loss.  The namespace stays:
+        survivors' TCP connections get RSTs, like a host whose jobs died."""
+        ip = self.spec.host_ip(self.host_index(host))
+        p = self.launchers.get(ip)
+        if p is not None and p.poll() is None:
+            try:
+                os.killpg(p.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                p.kill()
+        return ip
+
+    # -- observation ------------------------------------------------------------------
+
+    def progress_step(self) -> int:
+        """The fleet's published step (rank 0's beacon), or -1 pre-first."""
+        try:
+            got = self.client().kv_get("progress")
+        except OSError:
+            return -1
+        if not got:
+            return -1
+        try:
+            return int(got["value"]["step"])
+        except (KeyError, TypeError, ValueError):
+            return -1
+
+    def alive_launchers(self) -> int:
+        return sum(1 for p in self.launchers.values() if p.poll() is None)
+
+    def wait(self, timeout_s: float, tick: Optional[Callable[[], None]] = None,
+             poll_s: float = 1.0) -> bool:
+        """Wait for every (non-killed) launcher to exit; `tick` runs every
+        poll (the drill's fault-plan executor).  True = all exited."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if tick is not None:
+                tick()
+            if self.alive_launchers() == 0:
+                return True
+            time.sleep(poll_s)
+        return False
+
+    def launcher_output(self, ip: str) -> str:
+        path = self.logs.get(ip, "")
+        if not path or not os.path.exists(path):
+            return ""
+        with open(path, errors="replace") as f:
+            return f.read()
+
+    def journal_events(self) -> List[dict]:
+        from ..monitor.journal import read_journal_segments
+
+        events: List[dict] = []
+        for p in sorted(glob.glob(os.path.join(self.journal_dir,
+                                               "journal-*.jsonl"))):
+            if p.rsplit(".", 1)[-1].isdigit():
+                continue  # rotated segments fold in via read_journal_segments
+            events.extend(read_journal_segments(p))
+        events.sort(key=lambda e: e.get("t_wall", 0.0))
+        return events
+
+    # -- teardown ---------------------------------------------------------------------
+
+    def teardown(self) -> None:
+        for p in self.procs:
+            if p.poll() is None:
+                try:
+                    os.killpg(p.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    p.kill()
+        for p in self.procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+        self.teardown_network()
+
+    def teardown_network(self) -> None:
+        for i in range(self.spec.hosts):
+            sh(f"ip netns del {self._ns(i)}", check=False)
+            sh(f"ip link del {NS_PREFIX}v{i}", check=False)
+            sh(f"rm -rf /etc/netns/{self._ns(i)}", check=False)
+        sh(f"ip link del {BRIDGE}", check=False)
+
+
+class PlanExecutor:
+    """Step-keyed network-fault scheduler (the launcher side of the chaos
+    grammar's partition/degrade_link/kill_host kinds).
+
+    Pure scheduling against an injected pod interface — `tick(step, now)`
+    applies every fault whose step the fleet has reached and every timed
+    reversal (partition heal_after, degrade duration) that is due.  The
+    applied-event log carries wall times so a drill can assert "no shrink
+    CAS landed inside the partition window"."""
+
+    def __init__(self, pod, faults: Sequence, clock=time.monotonic):
+        self.pod = pod
+        self.pending = sorted(faults, key=lambda f: f.step)
+        self.clock = clock
+        self.reversals: List[Tuple[float, str, Callable[[], None]]] = []
+        self.applied: List[dict] = []
+
+    def done(self) -> bool:
+        return not self.pending and not self.reversals
+
+    def tick(self, step: Optional[int] = None, now: Optional[float] = None) -> None:
+        now = self.clock() if now is None else now
+        step = self.pod.progress_step() if step is None else step
+        for due, kind, fn in [r for r in self.reversals if r[0] <= now]:
+            fn()
+            self.reversals.remove((due, kind, fn))
+            self.applied.append({"kind": kind, "t": now, "step": step})
+        # at most ONE fault per tick: a beacon that jumped several steps
+        # must not collapse distinct drill phases (kill + partition) into
+        # one instant — each fault gets its own episode
+        if self.pending and self.pending[0].step <= step:
+            f = self.pending.pop(0)
+            rec = {"kind": f.kind, "t": now, "step": step, "at_step": f.step}
+            if f.kind == "partition":
+                self.pod.partition(f.groups)
+                rec["groups"] = [list(g) for g in f.groups]
+                if f.heal_after:
+                    self.reversals.append(
+                        (now + f.heal_after, "partition_heal",
+                         self.pod.heal_partition))
+            elif f.kind == "degrade_link":
+                rec["tc"] = self.pod.degrade(
+                    f.host, latency_ms=f.latency_ms, loss_pct=f.loss_pct,
+                    rate_mbit=f.rate_mbit)
+                rec["host"] = f.host
+                if f.secs:
+                    host = f.host
+                    self.reversals.append(
+                        (now + f.secs, "degrade_clear",
+                         lambda h=host: self.pod.clear_degrade(h)))
+            elif f.kind == "kill_host":
+                rec["host"] = self.pod.kill_host(f.host)
+            self.applied.append(rec)
+
+    def window(self, kind: str, end_kind: str) -> Optional[Tuple[float, float]]:
+        """(t_start, t_end) wall-clock-monotonic bounds of the first
+        `kind`..`end_kind` episode in the applied log, or None."""
+        t0 = next((r["t"] for r in self.applied if r["kind"] == kind), None)
+        if t0 is None:
+            return None
+        t1 = next((r["t"] for r in self.applied
+                   if r["kind"] == end_kind and r["t"] >= t0), None)
+        return (t0, t1 if t1 is not None else float("inf"))

@@ -119,6 +119,15 @@ echo "== straggler drill: slow rank fingered, not killed (CPU) =="
 # instead of killing it — the job finishes at full size
 JAX_PLATFORMS=cpu python -m kungfu_tpu.chaos --straggler-drill --timeout 240
 
+echo "== pod drill smoke: 4 netns hosts, shaped links, kill_host + partition =="
+# the simulated-pod harness (docs/fault_tolerance.md "network failure
+# model"): schedule resize, then a whole-host SIGKILL that must heal as
+# EXACTLY ONE shrink CAS (all the host's ranks at once, recovery at rung
+# buddy), then a partition that must be suspected — never shrunk — and
+# rejoined at unchanged membership via reconvene bumps once it heals.
+# Auto-SKIPs (exit 0) without root/netns, same contract as the netns drills.
+python scripts/pod_drill.py --smoke --timeout 420
+
 echo "== SLO drill: chaos slow@ drives a sustained breach that clears (CPU) =="
 # 2-rank fleet under -telemetry -slo-exit-code with a tight step-latency
 # SLO: the slow window must journal a sustained slo_breach (/slo shows the

@@ -1,0 +1,637 @@
+"""Pod-scale robustness tests — no root, no netns, no subprocesses.
+
+Covers the simulated-pod machinery at the pure-logic layer: the network
+half of the chaos grammar, the PlanExecutor's step-keyed scheduling, the
+RemoteHostJudge partition-vs-death state machine, the config server's KV
+liveness plane + reconvene bump, cross-host buddy placement at 64/128
+ranks, straggler-monitor occurrence matching at synthetic pod scale, and
+journal rotation under heal storms.  The netns/tc/process layer is the
+business of scripts/pod_drill.py (root-gated, auto-skip).
+"""
+import os
+
+import pytest
+
+from kungfu_tpu.chaos.plan import parse_fault_plan
+from kungfu_tpu.plan import Cluster, HostList, PeerID, PeerList
+
+pytestmark = pytest.mark.pod
+
+
+# -- network fault grammar -------------------------------------------------------------
+
+
+class TestNetworkGrammar:
+    def test_partition_round_trip(self):
+        p = parse_fault_plan(
+            "partition@step=12:hosts=h1,h2|h3:heal_after=20s")
+        (f,) = p.network_faults()
+        assert f.kind == "partition" and f.step == 12
+        assert f.groups == (("h1", "h2"), ("h3",))
+        assert f.heal_after == 20.0
+
+    def test_partition_defaults_and_errors(self):
+        (f,) = parse_fault_plan("partition@hosts=a|b").network_faults()
+        assert f.step == 0 and f.heal_after == 0.0
+        with pytest.raises(ValueError):
+            parse_fault_plan("partition@step=1")  # no hosts
+        with pytest.raises(ValueError):
+            parse_fault_plan("partition@hosts=a|")  # empty side
+        with pytest.raises(ValueError):
+            parse_fault_plan("partition@hosts=a,b")  # one side only
+        with pytest.raises(ValueError):
+            parse_fault_plan("partition@hosts=a|a")  # overlap
+
+    def test_degrade_link(self):
+        (f,) = parse_fault_plan(
+            "degrade_link@host=h2:latency_ms=40:loss_pct=1.5"
+            ":rate_mbit=200:step=5:duration=15").network_faults()
+        assert (f.host, f.step) == ("h2", 5)
+        assert (f.latency_ms, f.loss_pct, f.rate_mbit) == (40.0, 1.5, 200.0)
+        assert f.secs == 15.0
+        with pytest.raises(ValueError):
+            parse_fault_plan("degrade_link@host=h2")  # no shape at all
+        with pytest.raises(ValueError):
+            parse_fault_plan("degrade_link@latency_ms=4")  # no host
+
+    def test_kill_host(self):
+        (f,) = parse_fault_plan("kill_host@step=30:host=h4").network_faults()
+        assert (f.kind, f.step, f.host) == ("kill_host", 30, "h4")
+        with pytest.raises(ValueError):
+            parse_fault_plan("kill_host@step=30")
+
+    def test_network_faults_sorted_and_disjoint_from_worker_faults(self):
+        p = parse_fault_plan(
+            "kill_host@step=30:host=h4;crash@step=7:rank=2;"
+            "partition@step=12:hosts=a|b;degrade_link@host=h1:latency_ms=1")
+        kinds = [f.kind for f in p.network_faults()]
+        assert kinds == ["degrade_link", "partition", "kill_host"]  # by step
+        assert [f.kind for f in p.worker_faults()] == ["crash"]
+
+
+# -- PlanExecutor (fault scheduling against a fake pod) --------------------------------
+
+
+class _FakePod:
+    def __init__(self, steps):
+        self._steps = list(steps)
+        self.calls = []
+
+    def progress_step(self):
+        return self._steps.pop(0) if self._steps else 10 ** 9
+
+    def partition(self, groups):
+        self.calls.append(("partition", tuple(tuple(g) for g in groups)))
+
+    def heal_partition(self):
+        self.calls.append(("heal_partition",))
+
+    def degrade(self, host, latency_ms=0.0, loss_pct=0.0, rate_mbit=0.0):
+        self.calls.append(("degrade", host, latency_ms, rate_mbit))
+        return "netem delay"
+
+    def clear_degrade(self, host):
+        self.calls.append(("clear_degrade", host))
+
+    def kill_host(self, host):
+        self.calls.append(("kill", host))
+        return "10.78.0.13"
+
+
+class TestPlanExecutor:
+    def _executor(self, plan, pod):
+        from kungfu_tpu.testing.pod import PlanExecutor
+
+        return PlanExecutor(pod, parse_fault_plan(plan).network_faults(),
+                            clock=lambda: 0.0)
+
+    def test_step_gating_one_fault_per_tick(self):
+        pod = _FakePod([])
+        ex = self._executor(
+            "kill_host@step=10:host=h3;partition@step=20:hosts=h1|h2", pod)
+        ex.tick(step=5, now=0.0)
+        assert pod.calls == []
+        # a beacon jump past BOTH steps still fires one fault per tick
+        ex.tick(step=25, now=1.0)
+        assert [c[0] for c in pod.calls] == ["kill"]
+        ex.tick(step=25, now=2.0)
+        assert [c[0] for c in pod.calls] == ["kill", "partition"]
+        assert ex.done()
+
+    def test_timed_reversals(self):
+        pod = _FakePod([])
+        ex = self._executor(
+            "partition@step=1:hosts=a|b:heal_after=10;"
+            "degrade_link@host=h1:step=2:latency_ms=5:duration=3", pod)
+        ex.tick(step=1, now=0.0)
+        ex.tick(step=2, now=1.0)
+        assert [c[0] for c in pod.calls] == ["partition", "degrade"]
+        ex.tick(step=3, now=5.0)  # degrade duration (3s) elapsed at t=4
+        assert pod.calls[-1] == ("clear_degrade", "h1")
+        assert not ex.done()  # partition heal still pending
+        ex.tick(step=3, now=11.0)
+        assert pod.calls[-1] == ("heal_partition",)
+        assert ex.done()
+        kinds = [r["kind"] for r in ex.applied]
+        assert kinds == ["partition", "degrade_link", "degrade_clear",
+                        "partition_heal"]
+        lo, hi = ex.window("partition", "partition_heal")
+        assert (lo, hi) == (0.0, 11.0)
+
+    def test_degrade_tc_spec_recorded(self):
+        pod = _FakePod([])
+        ex = self._executor("degrade_link@host=h1:latency_ms=5", pod)
+        ex.tick(step=0, now=0.0)
+        assert ex.applied[0]["tc"] == "netem delay"
+
+
+# -- RemoteHostJudge -------------------------------------------------------------------
+
+
+def _cluster(spec="h1:2,h2:2,h3:2", np=6):
+    return Cluster.from_hostlist(HostList.parse(spec), np)
+
+
+def _hb(now=100.0, **ages):
+    """Heartbeat table with per-host ages relative to `now`."""
+    return {f"runner-hb/{h}": {"t_server": now - a} for h, a in ages.items()}
+
+
+class TestRemoteHostJudge:
+    def _judge(self, events, self_host="h1", **kw):
+        from kungfu_tpu.run.launcher import RemoteHostJudge
+
+        kw.setdefault("suspicion_s", 5.0)
+        kw.setdefault("stale_after_s", 2.0)
+        return RemoteHostJudge(self_host,
+                               journal=lambda e, **f: events.append((e, f)),
+                               **kw)
+
+    def test_dead_host_shrinks_after_window(self):
+        ev = []
+        j = self._judge(ev)
+        cl = _cluster()
+        a = j.assess(cl, _hb(h2=0.5, h3=0.8), {}, 100.0)
+        assert not a["shrink"] and a["leader"]
+        a = j.assess(cl, _hb(104.0, h2=0.5, h3=4.0), {}, 104.0)  # h3 went silent
+        assert a["stale"] == {"h3": 4.0} and not a["shrink"]
+        assert ev[-1][0] == "host_suspected"
+        a = j.assess(cl, _hb(109.5, h2=0.5, h3=9.5), {}, 109.5)  # window elapsed
+        assert a["shrink"] == ["h3"]
+
+    def test_heartbeat_return_mid_window_clears(self):
+        ev = []
+        j = self._judge(ev)
+        cl = _cluster()
+        j.assess(cl, _hb(100.0, h2=0.5, h3=4.0), {}, 100.0)
+        a = j.assess(cl, _hb(103.0, h2=0.5, h3=0.2), {}, 103.0)
+        assert not a["shrink"] and ev[-1][0] == "host_suspect_cleared"
+        # the clock restarted: going silent again needs a FULL new window
+        a = j.assess(cl, _hb(107.0, h2=0.5, h3=4.0), {}, 107.0)
+        assert not a["shrink"]
+
+    def test_never_seen_host_gets_doubled_quiet_window(self):
+        ev = []
+        j = self._judge(ev)
+        cl = _cluster()
+        a = j.assess(cl, _hb(100.0, h2=0.5), {}, 100.0)  # h3 never beat (booting)
+        assert not a["shrink"]
+        assert ev == []  # boot staggering must not spam the journal
+        a = j.assess(cl, _hb(104.0, h2=0.5), {}, 104.0)  # < 2x window: still quiet
+        assert not a["shrink"]
+        a = j.assess(cl, _hb(110.5, h2=0.5), {}, 110.5)  # 2x window elapsed
+        assert a["shrink"] == ["h3"]
+        assert any(e == "host_suspected" for e, _ in ev)
+
+    def test_partition_needs_fresh_hbs_and_aged_evidence(self):
+        ev = []
+        j = self._judge(ev)
+        cl = _cluster()
+        suspects = {"suspect/h2:10000": {"t_server": 99.0,
+                                         "value": {"cluster_version": 7}}}
+        # evidence too young (< stale_after + 1): the dead host's heartbeat
+        # may still look fresh in this gap — no partition yet
+        a = j.assess(cl, _hb(100.0, h2=0.1, h3=0.1), suspects, 100.0, version=7)
+        assert not a["partition"]
+        a = j.assess(cl, _hb(103.0, h2=0.1, h3=0.1), suspects, 103.0, version=7)
+        assert a["partition"] and a["reconvene"]
+        assert any(e == "partition_suspected" for e, _ in ev)
+        # reconvene throttled inside the interval
+        a = j.assess(cl, _hb(104.0, h2=0.1, h3=0.1), suspects, 104.0, version=7)
+        assert a["partition"] and not a["reconvene"]
+        # suspects withdrawn -> cleared
+        a = j.assess(cl, _hb(105.0, h2=0.1, h3=0.1), {}, 105.0, version=7)
+        assert not a["partition"] and ev[-1][0] == "partition_cleared"
+
+    def test_stale_version_suspects_are_explained(self):
+        # a suspect filed BEFORE the last membership change is answered by
+        # that change (its filer is re-rendezvousing, not partitioned)
+        ev = []
+        j = self._judge(ev)
+        cl = _cluster()
+        suspects = {"suspect/h2:10000": {"t_server": 90.0,
+                                         "value": {"cluster_version": 4}}}
+        a = j.assess(cl, _hb(100.0, h2=0.1, h3=0.1), suspects, 100.0, version=5)
+        assert not a["partition"] and not a["reconvene"]
+
+    def test_partition_never_fires_with_a_stale_host(self):
+        ev = []
+        j = self._judge(ev)
+        cl = _cluster()
+        suspects = {"suspect/h2:10000": {"t_server": 90.0,
+                                         "value": {"cluster_version": 7}}}
+        a = j.assess(cl, _hb(100.0, h2=0.1, h3=5.0), suspects, 100.0, version=7)
+        assert not a["partition"]  # the stale host explains the suspects
+
+    def test_leader_is_first_fresh_runner_host(self):
+        ev = []
+        j2 = self._judge(ev, self_host="h2")
+        cl = _cluster()
+        # h1 fresh: h2 is not the leader
+        a = j2.assess(cl, _hb(100.0, h1=0.5, h3=0.5), {}, 100.0)
+        assert not a["leader"]
+        # h1 silent: leadership falls to h2
+        a = j2.assess(cl, _hb(100.0, h1=9.0, h3=0.5), {}, 100.0)
+        assert a["leader"]
+
+    def test_clear_forgets_state(self):
+        ev = []
+        j = self._judge(ev)
+        cl = _cluster()
+        j.assess(cl, _hb(100.0, h2=0.5, h3=4.0), {}, 100.0)
+        j.clear("h3")
+        a = j.assess(cl, _hb(104.9, h2=0.5, h3=9.0), {}, 104.9)
+        assert not a["shrink"]  # the window restarted at 104.9
+
+
+# -- config server KV plane + reconvene ------------------------------------------------
+
+
+class TestKVPlane:
+    @pytest.fixture()
+    def server(self):
+        from kungfu_tpu.elastic.config_server import ConfigServer
+
+        srv = ConfigServer(port=0, init=_cluster()).start()
+        yield srv
+        srv.stop()
+
+    def _client(self, srv):
+        from kungfu_tpu.elastic.config_client import ConfigClient
+
+        return ConfigClient(srv.url, retries=1, retry_deadline_s=2.0)
+
+    def test_put_get_list_delete(self, server):
+        c = self._client(server)
+        assert c.kv_put("runner-hb/h1", {"pid": 1})
+        got = c.kv_get("runner-hb/h1")
+        assert got["value"] == {"pid": 1} and got["t_server"] > 0
+        c.kv_put("runner-hb/h2", {"pid": 2})
+        c.kv_put("suspect/h1:10000", {"reason": "TimeoutError"})
+        lst = c.kv_list("runner-hb/")
+        assert set(lst["entries"]) == {"runner-hb/h1", "runner-hb/h2"}
+        assert lst["now"] >= got["t_server"]
+        assert c.kv_get("missing") is None
+        c.kv_delete("suspect/h1:10000")
+        assert c.kv_list("suspect/")["entries"] == {}
+
+    def test_reconvene_bumps_identical_doc_conditionally(self, server):
+        c = self._client(server)
+        cl, v0 = c.get_cluster()
+        # plain conditional PUT of the identical doc does NOT bump
+        assert c.put_cluster(cl, version=v0)
+        assert c.get_cluster()[1] == v0
+        # reconvene bumps at unchanged membership
+        assert c.reconvene_cluster(cl, version=v0)
+        assert c.get_cluster()[1] == v0 + 1
+        # and stays conditional: a stale version loses
+        assert not c.reconvene_cluster(cl, version=v0)
+        assert c.get_cluster()[1] == v0 + 1
+
+    def test_kv_served_inside_flap_window(self):
+        from kungfu_tpu.chaos.inject import ServerChaos
+        from kungfu_tpu.elastic.config_server import ConfigServer
+
+        chaos = ServerChaos(parse_fault_plan("flap@config_server=60:after=0"))
+        srv = ConfigServer(port=0, init=_cluster(), chaos=chaos).start()
+        try:
+            c = self._client(srv)
+            with pytest.raises(OSError):
+                c.get_cluster()  # the document plane flaps
+            assert c.kv_put("runner-hb/h1", {"pid": 1})  # liveness plane: up
+            assert c.kv_get("runner-hb/h1")["value"] == {"pid": 1}
+        finally:
+            srv.stop()
+
+
+# -- cross-host buddy placement at pod scale -------------------------------------------
+
+
+class TestRingBuddiesAtScale:
+    @pytest.mark.parametrize("hosts,wph", [(8, 8), (16, 8), (16, 16), (3, 21)])
+    def test_cross_host_at_scale(self, hosts, wph):
+        peers = HostList.parse(
+            ",".join(f"10.78.0.{10 + i}:{wph}" for i in range(hosts))
+        ).gen_peer_list(hosts * wph)
+        buddies = peers.ring_buddies()
+        assert len(buddies) == hosts * wph
+        for r, b in enumerate(buddies):
+            assert b != r
+            assert peers[b].host != peers[r].host  # kill_host keeps a copy
+
+    def test_uneven_hosts_stay_cross_host(self):
+        peers = HostList.parse("a:60,b:2,c:2").gen_peer_list(64)
+        for r, b in enumerate(peers.ring_buddies()):
+            assert peers[b].host != peers[r].host
+
+    def test_single_host_falls_back_to_plain_ring(self):
+        peers = HostList.parse("a:8").gen_peer_list(8)
+        assert peers.ring_buddies() == [(r + 1) % 8 for r in range(8)]
+
+    def test_deterministic_from_document(self):
+        peers = HostList.parse("a:4,b:4,c:4").gen_peer_list(12)
+        assert peers.ring_buddies() == PeerList(tuple(peers)).ring_buddies()
+
+    def test_colocated_assignment_journals(self, tmp_path, monkeypatch):
+        # defensive trail: IF an assignment ever produced a same-host buddy
+        # on a multi-host document, BuddySnapshots journals buddy_colocated
+        from kungfu_tpu.monitor import journal as J
+        from kungfu_tpu.resilience.buddy import BuddySnapshots
+
+        monkeypatch.setenv(J.JOURNAL_FILE_ENV,
+                           str(tmp_path / "journal.jsonl"))
+        J._reset_for_tests()
+
+        peers = PeerList([PeerID("a", 1), PeerID("a", 2), PeerID("b", 1)])
+
+        class _Cfg:
+            pass
+
+        class _Peer:
+            rank = 0
+            self_id = peers[0]
+            cluster_version = 1
+            config = _Cfg()
+
+        _Peer.config.peers = peers
+        monkeypatch.setattr(PeerList, "ring_buddies",
+                            lambda self: [1, 2, 0])  # a->a: colocated
+        b = BuddySnapshots(_Peer())
+        assert not b.cross_host
+        J._reset_for_tests()
+        events = J.read_journal(str(tmp_path / "journal.jsonl"))
+        assert [e["event"] for e in events] == ["buddy_colocated"]
+        assert events[0]["host"] == "a"
+
+    def test_healthy_assignment_never_journals(self, tmp_path, monkeypatch):
+        from kungfu_tpu.monitor import journal as J
+        from kungfu_tpu.resilience.buddy import BuddySnapshots
+
+        monkeypatch.setenv(J.JOURNAL_FILE_ENV,
+                           str(tmp_path / "journal.jsonl"))
+        J._reset_for_tests()
+        peers = HostList.parse("a:2,b:2").gen_peer_list(4)
+
+        class _Cfg:
+            pass
+
+        class _Peer:
+            rank = 0
+            self_id = peers[0]
+            cluster_version = 1
+            config = _Cfg()
+
+        _Peer.config.peers = peers
+        b = BuddySnapshots(_Peer())
+        assert b.cross_host
+        J._reset_for_tests()
+        # no event was emitted, so the journal file was never even created
+        assert not os.path.exists(str(tmp_path / "journal.jsonl"))
+
+
+# -- straggler monitor at synthetic pod scale ------------------------------------------
+
+
+def _synthetic_fleet_spans(ranks, steps, slow_rank=None, slow_ms=400.0,
+                           start_step=0):
+    """Per-rank step/step:train span feeds for a synthetic fleet."""
+    from kungfu_tpu.utils.trace import Span
+
+    per_rank = {}
+    t_step = 0.1
+    for r in range(ranks):
+        spans = []
+        for s in range(start_step, start_step + steps):
+            base = s * (t_step + (slow_ms / 1e3 if slow_rank is not None
+                                  else 0.0))
+            skew = (slow_ms / 1e3) if r == slow_rank else 0.0
+            arr = base + 0.02 + skew
+            spans.append(Span(name="step:train", t_start=arr,
+                              dur=t_step - 0.02, cat="train",
+                              args={"step": s, "t_arrive": arr}))
+            spans.append(Span(name="step", t_start=base, dur=t_step,
+                              cat="train", args={"step": s}))
+        per_rank[r] = spans
+    return per_rank
+
+
+class TestMonitorAtScale:
+    @pytest.mark.parametrize("ranks", [64, 128])
+    def test_matching_completes_and_flags_at_scale(self, ranks):
+        from kungfu_tpu.monitor.straggler import (StragglerDetector,
+                                                  StragglerMonitor)
+
+        events = []
+        det = StragglerDetector(journal=lambda e, **f: events.append((e, f)),
+                                min_skew_ms=50.0, arm_after=2)
+        mon = StragglerMonitor(detector=det)
+        victim = ranks - 1
+        for start in (0, 8, 16, 24):
+            feeds = _synthetic_fleet_spans(ranks, 8, slow_rank=victim,
+                                           start_step=start)
+            for r, spans in feeds.items():
+                mon.consume_spans(r, spans)
+            rep = mon.report(ranks_expected=set(range(ranks)))
+        assert rep["suspected"] == [victim]
+        assert mon.matched == 32  # every step matched exactly once
+        assert not mon._pending_steps  # nothing stranded
+        false_pos = [r for e, f in events if e == "straggler_suspected"
+                     for r in [f["rank"]] if r != victim]
+        assert false_pos == []
+
+    def test_report_latency_stays_linear_ish(self):
+        # the O(ranks) contract: doubling the fleet must not quadruple the
+        # evaluate cost.  Generous 6x bound — CI boxes are noisy; what this
+        # catches is the old O(ranks^2) leave-one-out coming back (16x).
+        import timeit
+
+        from kungfu_tpu.monitor.straggler import StragglerDetector
+
+        def build(n):
+            det = StragglerDetector(journal=lambda e, **f: None)
+            for r in range(n):
+                for _ in range(8):
+                    det.add_sample(r, 1.0 + r * 0.01, step_ms=100.0)
+            return det
+
+        d64, d256 = build(64), build(256)
+        t64 = min(timeit.repeat(d64.evaluate, number=20, repeat=3))
+        t256 = min(timeit.repeat(d256.evaluate, number=20, repeat=3))
+        assert t256 < t64 * 6 + 0.05
+
+    def test_pending_prune_is_single_pass(self):
+        from kungfu_tpu.monitor.straggler import StragglerMonitor
+
+        mon = StragglerMonitor(max_pending=64)
+        feeds = _synthetic_fleet_spans(2, 300)
+        # only rank 0 reports: every step stays pending and must be pruned
+        mon.consume_spans(0, feeds[0])
+        mon.report(ranks_expected={0, 1})
+        assert len(mon._pending_steps) == 64
+        assert min(mon._pending_steps) == 300 - 64  # oldest dropped first
+
+
+# -- journal rotation under heal storms ------------------------------------------------
+
+
+class TestJournalHealStorm:
+    def test_rotation_bounds_size_under_storm(self, tmp_path):
+        from kungfu_tpu.monitor.journal import (Journal, read_journal_segments,
+                                                segment_paths)
+
+        path = str(tmp_path / "journal-w1.jsonl")
+        cap = 64 * 1024
+        j = Journal(path, max_bytes=cap)
+        for i in range(4000):  # a 64-rank fleet's heal storm, one process
+            j.emit("heal", old_size=64, new_size=63, mttr_s=1.5, seq=i,
+                   phases={"detect_s": 0.1, "teardown_s": 0.5})
+        j.close()
+        assert j.rotations >= 2
+        total = sum(os.path.getsize(p) for p in segment_paths(path))
+        assert total <= 3.5 * cap  # live + 2 rotated segments, bounded
+        events = read_journal_segments(path)
+        assert events, "rotated journal must stay readable"
+        seqs = [e["seq"] for e in events]
+        assert seqs == sorted(seqs)  # oldest-first across segments
+        assert seqs[-1] == 3999  # the newest event survives rotation
+
+    def test_emit_never_lost_mid_rotation(self, tmp_path):
+        from kungfu_tpu.monitor.journal import Journal, read_journal_segments
+
+        path = str(tmp_path / "journal-w2.jsonl")
+        j = Journal(path, max_bytes=2048)
+        for i in range(200):
+            j.emit("resize", seq=i)
+        j.close()
+        got = {e["seq"] for e in read_journal_segments(path)}
+        # the newest window is intact (older ones legitimately dropped);
+        # 2 KiB x 3 segments holds ~35 of these ~60-byte records
+        assert set(range(180, 200)) <= got
+
+
+# -- pod harness pure helpers ----------------------------------------------------------
+
+
+class TestPodHelpers:
+    def test_link_shape_tc_specs(self):
+        from kungfu_tpu.testing.pod import LinkShape
+
+        full = LinkShape(latency_ms=2, jitter_ms=0.5, loss_pct=1,
+                         rate_mbit=200)
+        assert full.tc_spec("netem") == \
+            "netem delay 2ms 0.5ms loss 1% rate 200mbit"
+        assert full.tc_spec("tbf") == \
+            "tbf rate 200mbit burst 32kbit latency 400ms"
+        assert full.tc_spec("none") == ""
+        assert LinkShape(latency_ms=3).tc_spec("tbf") == ""  # inexpressible
+        assert LinkShape().tc_spec("netem") == ""
+        assert not LinkShape() and bool(full)
+
+    def test_pod_spec_addressing(self):
+        from kungfu_tpu.testing.pod import PodSpec
+
+        spec = PodSpec(hosts=8, workers_per_host=8)
+        assert spec.world == 64
+        assert spec.host_ip(0) == "10.78.0.10"
+        assert spec.host_ip(7) == "10.78.0.17"
+        assert spec.gateway == "10.78.0.1"
+        hl = HostList.parse(spec.hostlist())
+        assert hl.cap() == 64
+        cl = Cluster.from_hostlist(hl, 64)
+        assert cl.workers.host_count() == 8
+
+    def test_host_index_resolution(self):
+        from kungfu_tpu.testing.pod import Pod, PodSpec
+
+        pod = Pod(PodSpec(hosts=4))
+        assert pod.host_index("h1") == 0
+        assert pod.host_index("h4") == 3
+        assert pod.host_index("10.78.0.12") == 2
+        assert pod.host_index("2") == 2
+        with pytest.raises(ValueError):
+            pod.host_index("nope")
+
+    def test_drill_result_regex_accepts_old_and_new_lines(self):
+        # `seconds=` trails the RESULT line; older consumers match a prefix
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "pod_drill", os.path.join(os.path.dirname(__file__), "..", "..",
+                                      "scripts", "pod_drill.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        new = ("RESULT: fake-adaptive trained=5376 resizes=2 final_size=4 "
+               "mesh=dp:4 loss=0.0328 heals=0 seconds=5.113")
+        old = ("RESULT: fake-adaptive trained=5376 resizes=2 final_size=4 "
+               "mesh=dp:4 loss=0.0328 heals=0")
+        m = mod.RESULT_RE.search(new)
+        assert m and m.group(7) == "5.113"
+        m = mod.RESULT_RE.search(old)
+        assert m and m.group(7) is None
+
+
+# -- replan churn bound ----------------------------------------------------------------
+
+
+class TestReplanChurnBound:
+    def _policy(self, cooldown=2):
+        import kungfu_tpu.planner.replan as P
+
+        class _Sess:
+            size = 4
+
+        class _Planner:
+            session = _Sess()
+
+            def __init__(self):
+                self.calls = []
+
+            def replan(self, reason, **kw):
+                self.calls.append(reason)
+
+        fp = _Planner()
+        return fp, P.ReplanPolicy(fp, cooldown_steps=cooldown)
+
+    def test_sustained_trigger_backs_off_exponentially(self):
+        fp, pol = self._policy(cooldown=2)
+        steps_of = []
+        for step in range(200):
+            before = len(fp.calls)
+            pol.after_step({"straggler": True})
+            if len(fp.calls) > before:
+                steps_of.append(step)
+        # gaps double: 2, 2, 4, 8, 16 (capped at 8x = 16 steps)
+        gaps = [b - a for a, b in zip(steps_of, steps_of[1:])]
+        assert gaps[:4] == [2, 4, 8, 16]
+        assert max(gaps) <= 16
+        # far fewer replans than the fixed-cooldown 100
+        assert len(fp.calls) < 20
+
+    def test_cleared_signal_resets_backoff(self):
+        fp, pol = self._policy(cooldown=1)
+        for _ in range(6):
+            pol.after_step({"straggler": True})
+        n = len(fp.calls)
+        pol.after_step({})  # signal gone: streak resets
+        pol.after_step({"straggler": True})
+        assert len(fp.calls) == n + 1  # re-arms at the base cooldown
