@@ -668,7 +668,10 @@ def _measure_mttr_s():
 
 def _measure_serving():
     """The BENCH json's "serving" section: steady-state continuous-batching
-    throughput + latency percentiles from the in-process engine bench, and
+    throughput + latency percentiles from the in-process engine bench, the
+    serving-v2 A/B grid (spec on/off x prefix on/off in-process, disagg
+    on/off as two short fleets — `--bench serving --arms`, run through the
+    PR-8 probed runner with an honest per-record measured_this_run), and
     request-visible failover MTTR from two scripted serve drills (buddy
     weight rejoin vs KFT_BUDDY=0 seed re-init — the A/B of the in-memory
     tier, mirroring mttr_buddy_s vs mttr_disk_s).  Subprocess-only; opt out
@@ -682,19 +685,62 @@ def _measure_serving():
     repo = os.path.dirname(os.path.abspath(__file__))
     section = {}
     try:
-        with tempfile.NamedTemporaryFile(suffix=".json", mode="r") as f:
-            r = subprocess.run(
-                [sys.executable, "-m", "kungfu_tpu.benchmarks",
-                 "--bench", "serving", "--out", f.name],
-                capture_output=True, text=True, timeout=300, cwd=repo,
+        from kungfu_tpu.benchmarks import runner as bench_runner
+
+        with tempfile.NamedTemporaryFile(suffix=".json") as f:
+            rec = bench_runner.run_section(
+                bench_runner.Section(
+                    name="serving",
+                    argv=[sys.executable, "-m", "kungfu_tpu.benchmarks",
+                          "--bench", "serving", "--out", f.name],
+                    out_json=f.name, timeout_s=300.0, cwd=repo,
+                    env={"JAX_PLATFORMS": "cpu"},
+                ),
+                probe_timeout_s=60.0, retries=1, interval_s=2.0,
             )
-            if r.returncode == 0:
-                rec = json.load(f)
-                for k in ("tokens_per_sec", "ttft_p50_ms", "ttft_p99_ms",
-                          "decode_p50_ms", "decode_p99_ms", "slots",
-                          "requests", "kv_cache_dtype"):
-                    section[k] = rec.get(k)
+        if rec.get("measured_this_run"):
+            for k in ("tokens_per_sec", "ttft_p50_ms", "ttft_p99_ms",
+                      "decode_p50_ms", "decode_p99_ms", "slots",
+                      "requests", "kv_cache_dtype"):
+                section[k] = rec.get(k)
+            section["measured_this_run"] = True
+        else:
+            section["measured_this_run"] = False
+            section["error"] = rec.get("error")
     except Exception:  # never let the serving probe sink the headline
+        pass
+
+    try:
+        from kungfu_tpu.benchmarks import runner as bench_runner
+
+        with tempfile.NamedTemporaryFile(suffix=".json") as f:
+            rec = bench_runner.run_section(
+                bench_runner.Section(
+                    name="serving_arms",
+                    argv=[sys.executable, "-m", "kungfu_tpu.benchmarks",
+                          "--bench", "serving", "--arms", "--out", f.name],
+                    out_json=f.name, timeout_s=600.0, cwd=repo,
+                    env={"JAX_PLATFORMS": "cpu"},
+                ),
+                probe_timeout_s=60.0, retries=1, interval_s=2.0,
+            )
+        if rec.get("measured_this_run"):
+            section["arms"] = {
+                "measured_this_run": True,
+                "greedy_parity_across_arms":
+                    rec.get("greedy_parity_across_arms"),
+                "spec_k": rec.get("spec_k"),
+                "spec_speedup": rec.get("spec_speedup"),
+                "prefix_speedup": rec.get("prefix_speedup"),
+                "prefix_ttft_speedup": rec.get("prefix_ttft_speedup"),
+                "disagg_ttft_ratio": rec.get("disagg_ttft_ratio"),
+                "grid": rec.get("arms"),
+                "fleet": rec.get("fleet_arms"),
+            }
+        else:
+            section["arms"] = {"measured_this_run": False,
+                               "error": rec.get("error")}
+    except Exception:
         pass
 
     def one_drill(buddy):

@@ -402,6 +402,87 @@ def _b_bench_compression(scheme: str):
     return build
 
 
+def _b_serving_verify_k():
+    """The serving engine's speculative verify-k decode program
+    (serving/engine.py _verify_accept): a [slots, k] decode-mode forward
+    with per-slot cache cursors, in-program greedy acceptance, and the
+    per-slot cursor rollback — the ONE extra compiled decode signature of
+    speculative serving."""
+
+    def build():
+        import jax
+        import jax.numpy as jnp
+        import flax.linen as nn
+
+        from ..models.transformer import TransformerConfig, TransformerLM
+
+        cfg = TransformerConfig(
+            vocab_size=32, d_model=16, n_layers=1, n_heads=2, d_ff=32,
+            max_len=32, rope=True, attention="full", dtype=jnp.float32,
+            decode=True,
+        )
+        model = TransformerLM(cfg)
+        slots, k = 2, 4
+        variables = model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((slots, 1), jnp.int32))
+        params = nn.meta.unbox(variables["params"])
+        cache = variables["cache"]
+
+        def verify(params, cache, toks, proposals):
+            logits, st = model.apply(
+                {"params": params, "cache": cache}, toks, mutable=["cache"]
+            )
+            g = jnp.argmax(
+                logits.astype(jnp.float32), axis=-1
+            ).astype(jnp.int32)
+            ok = (proposals == g[:, : k - 1]).astype(jnp.int32)
+            n_acc = jnp.cumprod(ok, axis=1).sum(axis=1)
+
+            def roll(path, leaf):
+                if getattr(path[-1], "key", None) == "idx":
+                    return leaf - (k - 1 - n_acc).astype(leaf.dtype)
+                return leaf
+
+            cache2 = jax.tree_util.tree_map_with_path(roll, st["cache"])
+            return g, n_acc, cache2
+
+        toks = _sds((slots, k), "int32")
+        proposals = _sds((slots, k - 1), "int32")
+        return verify, (_abstract(params), _abstract(cache), toks,
+                        proposals), {}
+
+    return build
+
+
+def _b_serving_kv_ship():
+    """The disaggregation KV-ship program (ops/kv_ship.ship_kv_rows): every
+    cache leaf rotates to the paired decode rank — one remote DMA per hop
+    on the PR-12 plane, the bit-identical ppermute lowering (linted here)
+    off it."""
+
+    def build():
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from ..compat import shard_map
+        from ..ops.kv_ship import ship_kv_rows
+
+        mesh = _mesh({"dp": 8})
+
+        def body(rows):
+            shipped = ship_kv_rows(
+                {"cached_k": jnp.squeeze(rows, 0)}, "dp", 1
+            )
+            return shipped["cached_k"][None]
+
+        fn = shard_map(body, mesh, in_specs=P("dp"), out_specs=P("dp"),
+                       check_vma=False)
+        x = _sds((8, 16, 2, 8))
+        return fn, (x,), {"mesh": mesh}
+
+    return build
+
+
 def builtin_programs() -> List[Program]:
     return [
         # optimizers — every shipped family in its trainer harness
@@ -492,6 +573,14 @@ def builtin_programs() -> List[Program]:
         Program("bench-compression-bf16", ("bench", "compression"),
                 _b_bench_compression("bf16"),
                 "benchmarks/compression.py bf16 allreduce arm"),
+        # serving v2 compiled programs (docs/serving.md)
+        Program("serving-verify-k", ("serving",), _b_serving_verify_k(),
+                "speculative decoding's [slots, k] verify step: decode-mode "
+                "forward + in-program acceptance + per-slot cursor rollback"),
+        Program("serving-kv-ship", ("serving",), _b_serving_kv_ship(),
+                "disaggregation's KV ship: per-leaf rotation to the paired "
+                "decode rank (ring_shift DMA on TPU, the ppermute lowering "
+                "linted here)"),
     ]
 
 

@@ -47,6 +47,13 @@ def main(argv=None) -> int:
     p.add_argument("--kv-cache-dtype", default="model",
                    choices=["model", "int8"],
                    help="KV cache storage dtype for --bench serving")
+    p.add_argument("--arms", action="store_true",
+                   help="serving: run the v2 A/B grid instead (spec on/off "
+                        "x prefix on/off in-process + disagg on/off fleets)")
+    p.add_argument("--spec-k", type=int, default=8,
+                   help="serving arms: speculative verify width")
+    p.add_argument("--no-fleet-arms", action="store_true",
+                   help="serving arms: skip the subprocess disagg fleets")
     p.add_argument("--preset", default="tiny",
                    help="serving model preset (see serving.worker.PRESETS)")
     p.add_argument("--size", type=int, default=1 << 22,
@@ -80,6 +87,22 @@ def main(argv=None) -> int:
         return 0
 
     if args.bench == "serving":
+        if args.arms:
+            from .serving import bench_serving_arms
+
+            # the arms grid has its own decode-heavy defaults (requests=24,
+            # max_new=48); only explicit flags override them — the generic
+            # --requests/--max-new defaults belong to the v1 record
+            kw = {}
+            if args.requests != 64:
+                kw["requests"] = args.requests
+            if args.max_new != 32:
+                kw["max_new"] = args.max_new
+            bench_serving_arms(
+                slots=args.slots, preset=args.preset, spec_k=args.spec_k,
+                skip_fleet=args.no_fleet_arms, out=args.out, **kw,
+            )
+            return 0
         from .serving import bench_serving
 
         bench_serving(

@@ -10,17 +10,28 @@ request stream (mixed prompt lengths over the prefill buckets) and reports
                       slot shares)
   prefill_p50/p99_ms  one bucketed prefill dispatch
 
-The fleet-level numbers (failover_requeue_s, rejoin latency) come from the
-subprocess serve drill (kungfu_tpu.serving.drill) — bench.py composes both
-into the BENCH json's "serving" section.
+Serving v2 A/B arms (`--arms`): spec on/off x prefix on/off in-process
+(the request stream carries a shared system-prompt prefix, so the radix
+cache has something to hit; speculation self-drafts — same params as the
+target, acceptance ~= 1 — measuring the mechanics: k committed tokens per
+verify dispatch instead of one per decode dispatch), plus disagg on/off as
+two short subprocess fleets at identical worker count.  Every arm reports
+tokens/sec + TTFT p50/p99; the record lands in the BENCH json "serving"
+section through the PR-8 probed runner with honest measured_this_run
+stamps.
+
+The fleet-level failover numbers (failover_requeue_s, rejoin latency) come
+from the subprocess serve drill (kungfu_tpu.serving.drill) — bench.py
+composes both into the BENCH json's "serving" section.
 
     python -m kungfu_tpu.benchmarks --bench serving [--out serving.json]
+    python -m kungfu_tpu.benchmarks --bench serving --arms   # the A/B grid
 """
 from __future__ import annotations
 
 import json
 import time
-from typing import Optional
+from typing import Dict, List, Optional
 
 
 def bench_serving(requests: int = 64, max_new: int = 32, slots: int = 4,
@@ -94,6 +105,274 @@ def bench_serving(requests: int = 64, max_new: int = 32, slots: int = 4,
         "prefill_p99_ms": pct("prefill_ms", "p99"),
         "wall_s": round(wall, 3),
     }
+    print("RESULT: " + json.dumps(record), flush=True)
+    if out:
+        with open(out, "w") as f:
+            json.dump(record, f, indent=2)
+    return record
+
+
+def _one_arm(cfg, params, reqs, slots: int, spec_on: bool,
+             prefix_on: bool, spec_k: int) -> dict:
+    """One in-process arm: fresh engine (fresh jit caches are shared via
+    jax's global cache, so compile cost amortizes across arms), the SAME
+    request list replayed, greedy output asserted identical to the first
+    arm by the caller."""
+    from ..monitor.counters import Counters
+    from ..serving.engine import ServingEngine
+    from ..serving.prefix import PrefixCache
+    from ..serving.request import Request
+    from ..serving.spec import SpecDecoder
+
+    counters = Counters()
+    prefix = PrefixCache(budget_bytes=256 << 20, counters=counters) \
+        if prefix_on else None
+    spec = SpecDecoder(cfg, params, slots=slots, k=spec_k,
+                       counters=counters) if spec_on else None
+    engine = ServingEngine(cfg, params, slots=slots,
+                           queue_capacity=len(reqs) + slots + 4,
+                           counters=counters,
+                           prefix_cache=prefix, spec=spec)
+    # warmup: compile EVERY prefill bucket any arm request (or its
+    # prefix-hit suffix) can land in, plus decode/draft/verify — a compile
+    # inside the measured window would swamp the arm it lands in
+    for b in engine.buckets:
+        n = min(b, cfg.max_len - 8 - 1)
+        engine.submit(Request(prompt=tuple(1 + (i % 7) for i in range(n)),
+                              max_new_tokens=4))
+    engine.run_until_idle()
+    if prefix is not None:
+        prefix.invalidate(reason="bench_warmup")  # arms start cold
+    counters2 = Counters()
+    engine.counters = counters2
+    if prefix is not None:
+        prefix.counters = counters2
+    if spec is not None:
+        spec.counters = counters2
+
+    pend = []
+    t0 = time.perf_counter()
+    tok0 = engine.total_tokens
+    for r in reqs:
+        pend.append(engine.submit(
+            Request(prompt=r["prompt"], max_new_tokens=r["max_new"])))
+    engine.run_until_idle(timeout_s=600.0)
+    wall = time.perf_counter() - t0
+    hists = counters2.hist_summaries()
+
+    def pct(metric, key):
+        v = hists.get(metric, {}).get("", {}).get(key)
+        return round(v, 3) if v is not None else None
+
+    arm = {
+        "spec": spec_on,
+        "prefix": prefix_on,
+        "tokens_per_sec": round((engine.total_tokens - tok0) / wall, 2),
+        "ttft_p50_ms": pct("ttft_ms", "p50"),
+        "ttft_p99_ms": pct("ttft_ms", "p99"),
+        "wall_s": round(wall, 3),
+        "tokens": [list(p.result.tokens) for p in pend],
+    }
+    if spec is not None:
+        arm["spec_accept_rate"] = round(spec.accept_rate(), 4)
+        arm["spec_rounds"] = spec.rounds
+        arm["spec_engaged"] = spec.rounds > 0
+    if prefix is not None:
+        st = prefix.stats()
+        arm["prefix_hit_rate"] = st["hit_rate"]
+        arm["prefix_cache_bytes"] = st["bytes"]
+    return arm
+
+
+def _fleet_arm(prefill_ranks: int, requests: int, max_new: int,
+               timeout_s: float = 150.0) -> Optional[dict]:
+    """One subprocess fleet arm at 3 workers: monolithic (prefill_ranks=0)
+    vs disaggregated 1 prefill + 2 decode.  Client-side tokens/sec + TTFT
+    proxy (first-byte isn't exposed over the blocking API, so TTFT here is
+    the engine-reported per-request ttft_ms)."""
+    import os
+    import re
+    import signal
+    import subprocess
+    import sys
+    import threading
+    import urllib.request
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    env.pop("KFT_FAULT_PLAN", None)
+    cmd = [sys.executable, "-m", "kungfu_tpu.serving", "-np", "3",
+           "--max-size", "3", "--platform", "cpu", "--preset", "tiny",
+           "--slots", "2", "--no-autoscale",
+           "--timeout", str(int(timeout_s)), "-q"]
+    if prefill_ranks:
+        cmd += ["--prefill-ranks", str(prefill_ranks)]
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    lines: List[str] = []
+    threading.Thread(target=lambda: [lines.append(x) for x in proc.stdout],
+                     daemon=True).start()
+    try:
+        url = None
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 30 and url is None:
+            for line in list(lines):
+                m = re.search(r"SERVE_URL: (\S+)", line)
+                if m:
+                    url = m.group(1)
+            time.sleep(0.1)
+        if url is None:
+            return None
+        t0 = time.monotonic()
+        healthy = 0
+        while time.monotonic() - t0 < 90:
+            try:
+                with urllib.request.urlopen(url + "/stats", timeout=3) as r:
+                    st = json.loads(r.read().decode())
+                healthy = sum(1 for w in st["workers"].values()
+                              if w["healthy"])
+                if healthy >= 3:
+                    break
+            except (OSError, ValueError):
+                pass
+            time.sleep(0.25)
+        if healthy < 3:
+            return None
+
+        import numpy as np
+
+        rs = np.random.RandomState(0)
+        shared = [int(t) for t in rs.randint(1, 64, (12,))]
+        prompts = [shared + [int(t) for t in rs.randint(1, 64,
+                                                        (2 + i % 6,))]
+                   for i in range(requests)]
+        results: List[Optional[dict]] = [None] * requests
+        lat = [0.0] * requests
+
+        def one(i):
+            body = json.dumps({"prompt": prompts[i],
+                               "max_new_tokens": max_new}).encode()
+            rq = urllib.request.Request(
+                url + "/v1/generate", data=body, method="POST",
+                headers={"Content-Type": "application/json"})
+            t = time.monotonic()
+            try:
+                with urllib.request.urlopen(rq, timeout=timeout_s) as r:
+                    results[i] = json.loads(r.read().decode())
+            except OSError:
+                pass
+            lat[i] = time.monotonic() - t
+
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(requests)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=timeout_s)
+        wall = time.perf_counter() - t0
+        done = [r for r in results if r is not None
+                and r.get("status") == "ok"]
+        ttfts = sorted(r["ttft_ms"] for r in done
+                       if r.get("ttft_ms") is not None)
+
+        def p(xs, q):
+            if not xs:
+                return None
+            return round(xs[min(len(xs) - 1,
+                                int(round(q * (len(xs) - 1))))], 3)
+
+        return {
+            "disagg": bool(prefill_ranks),
+            "np": 3,
+            "prefill_ranks": prefill_ranks,
+            "completed": len(done),
+            "requests": requests,
+            "tokens_per_sec": round(len(done) * max_new / wall, 2),
+            "ttft_p50_ms": p(ttfts, 0.5),
+            "ttft_p99_ms": p(ttfts, 0.99),
+            "wall_s": round(wall, 3),
+        }
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+
+
+def bench_serving_arms(requests: int = 24, max_new: int = 48,
+                       slots: int = 4, preset: str = "tiny",
+                       spec_k: int = 8, fleet_requests: int = 12,
+                       skip_fleet: bool = False,
+                       out: Optional[str] = None) -> dict:
+    """The serving v2 A/B grid: spec on/off x prefix on/off (in-process,
+    identical request stream with a shared 8-token system prefix, greedy
+    output asserted IDENTICAL across arms — the features must be free) and
+    disagg on/off (two short 3-worker fleets).  Headline ratios:
+    spec_speedup, prefix_ttft_speedup, disagg_ttft_ratio.
+
+    The stream is deliberately decode-heavy (max_new >> prompt len):
+    speculation is a DECODE accelerator, and the self-draft stand-in pays a
+    full-size draft prefill per admission that a production small-draft
+    would not — a prefill-bound stream would measure that artifact, not
+    the verify-k mechanics."""
+    import numpy as np
+
+    from ..serving.worker import build_config, seed_params
+
+    cfg = build_config(preset)
+    params = seed_params(cfg, seed=0)
+    rs = np.random.RandomState(0)
+    shared = tuple(int(t) for t in rs.randint(1, cfg.vocab_size, (8,)))
+    reqs = []
+    for i in range(requests):
+        tail = tuple(int(t) for t in rs.randint(
+            1, cfg.vocab_size, (2 + i % 6,)))
+        reqs.append({"prompt": shared + tail, "max_new": max_new})
+
+    arms: Dict[str, dict] = {}
+    for name, spec_on, prefix_on in (
+        ("base", False, False),
+        ("prefix", False, True),
+        ("spec", True, False),
+        ("spec_prefix", True, True),
+    ):
+        arms[name] = _one_arm(cfg, params, reqs, slots, spec_on, prefix_on,
+                              spec_k)
+    # parity across arms: the multipliers must change nothing observable
+    toks = {a: arms[a].pop("tokens") for a in arms}
+    parity = all(toks[a] == toks["base"] for a in arms)
+
+    record = {
+        "bench": "serving",
+        "mode": "arms",
+        "preset": preset,
+        "slots": slots,
+        "requests": requests,
+        "max_new_tokens": max_new,
+        "spec_k": spec_k,
+        "greedy_parity_across_arms": parity,
+        "arms": arms,
+        "spec_speedup": round(
+            arms["spec"]["tokens_per_sec"] / arms["base"]["tokens_per_sec"],
+            3),
+        "prefix_speedup": round(
+            arms["prefix"]["tokens_per_sec"]
+            / arms["base"]["tokens_per_sec"], 3),
+    }
+    if (arms["prefix"]["ttft_p50_ms"] or 0) > 0:
+        record["prefix_ttft_speedup"] = round(
+            (arms["base"]["ttft_p50_ms"] or 0)
+            / arms["prefix"]["ttft_p50_ms"], 3)
+    if not skip_fleet:
+        mono = _fleet_arm(0, fleet_requests, max_new)
+        disagg = _fleet_arm(1, fleet_requests, max_new)
+        record["fleet_arms"] = {"mono": mono, "disagg": disagg}
+        if mono and disagg and mono.get("ttft_p50_ms"):
+            record["disagg_ttft_ratio"] = round(
+                (disagg.get("ttft_p50_ms") or 0) / mono["ttft_p50_ms"], 3)
     print("RESULT: " + json.dumps(record), flush=True)
     if out:
         with open(out, "w") as f:

@@ -500,6 +500,12 @@ def main(argv=None) -> int:
     ap.add_argument("--serve-requests", type=int, default=12)
     ap.add_argument("--serve-p99-bound", type=float, default=60.0,
                     help="client-visible p99 latency bound for the drill")
+    ap.add_argument("--tier", choices=("prefill", "decode"), default="",
+                    help="serve drill: run the DISAGGREGATED fleet "
+                         "(1 prefill + 2 decode ranks) and crash a rank of "
+                         "this pool instead of a monolithic worker — "
+                         "asserts zero drops + bounded p99 + rank_rejoined "
+                         "per tier (docs/serving.md)")
     ap.add_argument("--no-autoscale-drill", action="store_true",
                     help="serve drill: skip the autoscale phase (failover "
                          "only — the bench A/B uses this)")
@@ -566,19 +572,21 @@ def main(argv=None) -> int:
             np=args.np if args.np != 3 else 2,  # serve default is 2 ranks
             buddy=args.buddy, timeout_s=args.timeout,
             requests=args.serve_requests, p99_bound_s=args.serve_p99_bound,
-            skip_autoscale=args.no_autoscale_drill,
+            skip_autoscale=args.no_autoscale_drill, tier=args.tier,
         )
         if args.json:
             with open(args.json, "w") as f:
                 json.dump(summary, f, indent=2)
         if not summary["ok"]:
-            print("SERVE DRILL FAILED: " + "; ".join(summary["failures"]),
-                  file=sys.stderr)
+            print("SERVE DRILL FAILED"
+                  + (f" (tier={args.tier})" if args.tier else "") + ": "
+                  + "; ".join(summary["failures"]), file=sys.stderr)
             if summary.get("output_tail"):
                 print("--- output tail ---\n" + summary["output_tail"],
                       file=sys.stderr)
             return 1
-        print("SERVE DRILL OK: "
+        print("SERVE DRILL OK"
+              + (f" (tier={args.tier})" if args.tier else "") + ": "
               f"{summary['completed']}/{summary['requests']} requests, "
               f"0 dropped, {summary['requeued_requests']} requeued "
               f"(warm resumes {summary.get('warm_resumes', 0)}), "
@@ -587,7 +595,7 @@ def main(argv=None) -> int:
               f"failover_requeue_s={summary.get('failover_requeue_s')}, "
               f"p99={summary['latency_p99_s']}s, "
               f"tokens/s={summary['tokens_per_sec']}"
-              + ("" if args.no_autoscale_drill else
+              + ("" if (args.no_autoscale_drill or args.tier) else
                  f", scale_down in {summary.get('scale_down_s')}s, "
                  f"scale_up in {summary.get('scale_up_s')}s"))
         return 0

@@ -92,18 +92,27 @@ class ChaosInjector:
                                   steps=f.steps)
                 self._sleep(f.ms / 1e3)
 
-    def on_serve_tokens(self, total_tokens: int, rank: int) -> None:
+    def on_serve_tokens(self, total_tokens: int, rank: int,
+                        tier: str = "") -> None:
         """Fire `crash_serve` once the serving engine has generated
         `total_tokens` tokens — called by the serving worker after every
-        decode iteration (serving/worker.py), so the kill lands MID-STREAM
-        with requests in flight."""
+        decode iteration (and, on the prefill tier, after every prefill
+        with the prefilled-token counter), so the kill lands MID-STREAM
+        with requests in flight.  A fault carrying `tier=` fires only on
+        workers of that tier; `rank=-1` then matches the first such worker
+        to cross the threshold."""
         for f in self.plan.serve_faults():
-            if f in self._fired or rank != f.rank or total_tokens < f.tokens:
+            if f in self._fired or total_tokens < f.tokens:
+                continue
+            if f.tier and f.tier != tier:
+                continue
+            if f.rank >= 0 and rank != f.rank:
                 continue
             self._fired.add(f)
-            log.warning("CHAOS: crash_serve at %d generated tokens rank %d "
-                        "(exit %d)", total_tokens, rank, f.code)
-            self._journal("chaos_crash_serve", total_tokens, rank, code=f.code)
+            log.warning("CHAOS: crash_serve at %d tokens rank %d tier=%s "
+                        "(exit %d)", total_tokens, rank, tier or "-", f.code)
+            self._journal("chaos_crash_serve", total_tokens, rank,
+                          code=f.code, tier=tier)
             self._exit(f.code)
 
     @staticmethod

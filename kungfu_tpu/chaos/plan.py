@@ -22,12 +22,19 @@ Kinds (see docs/fault_tolerance.md for the full grammar):
 
 Serving faults (docs/serving.md, serve drills):
 
-  crash_serve@tokens=N:rank=R[:code=C]
+  crash_serve@tokens=N:rank=R[:code=C][:tier=prefill|decode]
                                     serving worker R calls os._exit(C) once
                                     its engine has generated >= N tokens
                                     total (default code 45) — a mid-stream
                                     rank kill with requests in flight; the
-                                    router must re-queue them, never drop
+                                    router must re-queue them, never drop.
+                                    With tier= the kill targets a
+                                    disaggregated pool: the fault fires only
+                                    on a worker of that tier (rank=-1 = the
+                                    first such worker to cross the
+                                    threshold), and prefill-tier workers
+                                    count PREFILLED tokens instead of
+                                    generated ones
 
 Checkpoint-integrity faults (docs/fault_tolerance.md, recovery ladder):
 
@@ -119,6 +126,7 @@ class Fault:
     after: int = DEFAULT_FLAP_AFTER  # flap: requests served before outage
     ckpt_step: int = -1             # corrupt_ckpt: target step; -1 = latest
     tokens: int = -1                # crash_serve: generated-token trigger
+    tier: str = ""                  # crash_serve: pool filter (disagg fleets)
     # network faults (pod harness; hosts/host name netns "hosts", not ranks)
     host: str = ""                  # degrade_link/kill_host target host
     groups: Tuple[Tuple[str, ...], ...] = ()  # partition: the two host sides
@@ -165,14 +173,22 @@ def _parse_one(spec: str) -> Fault:
         )
 
     if kind == "crash_serve":
-        if "tokens" not in kv or "rank" not in kv:
-            raise ValueError(f"crash_serve fault needs tokens= and rank=: {spec!r}")
+        if "tokens" not in kv or ("rank" not in kv and "tier" not in kv):
+            raise ValueError(
+                f"crash_serve fault needs tokens= and rank= (or tier=): {spec!r}"
+            )
         code = int(kv.pop("code", DEFAULT_CRASH_SERVE_CODE))
         if code == 0:
             raise ValueError(f"crash_serve code must be non-zero: {spec!r}")
+        tier = kv.pop("tier", "")
+        if tier and tier not in ("prefill", "decode"):
+            raise ValueError(f"crash_serve tier must be prefill|decode: {spec!r}")
+        rank = int(kv.pop("rank", -1))
+        if rank < 0 and not tier:
+            raise ValueError(f"crash_serve rank=-1 needs a tier=: {spec!r}")
         return Fault(
             kind="crash_serve", tokens=int(kv.pop("tokens")),
-            rank=int(kv.pop("rank")), code=code,
+            rank=rank, code=code, tier=tier,
             **_reject_leftovers(kv, spec),
         )
 

@@ -126,6 +126,16 @@ class TransformerConfig:
     # collection) of max_len positions and consumes 1..n new tokens per
     # call.  Training parallelism axes don't apply; requires rope (the
     # cache index supplies absolute positions).  See `generate`.
+    #
+    # verify-k contract (speculative serving, serving/spec.py): a decode
+    # call with L = k tokens is EXACTLY k chained 1-token calls — per-slot
+    # cursors place each token at its own absolute position, the causal
+    # mask (`c_pos <= q_pos`) lets position j attend the k/v written at
+    # positions <= j within the same call, and every position's logits
+    # come back.  That makes one [slots, k] apply a batched verify step
+    # whose greedy argmax run is bit-identical to k sequential [slots, 1]
+    # steps — the property the serving engine's ONE extra compiled
+    # signature (and its in-program acceptance) is built on.
     decode: bool = False
     # KV-cache storage dtype (decode only): "model" stores cfg.dtype;
     # "int8" stores per-(position, kv-head) symmetric-quantized int8 plus

@@ -1,0 +1,81 @@
+"""KV ship path — moving finished prefill KV to a decode slot.
+
+Disaggregated serving (serving/disagg.py) splits prefill and decode onto
+different ranks; the prefill result — per-request KV rows plus the first
+token — has to land in a decode slot.  Two transports, one contract:
+
+  * `ship_kv_rows(rows, axis_name, offset)` — the IN-MESH path: when both
+    tiers live in one jax mesh (co-meshed TPU serving), every leaf rides
+    the PR-12 DMA plane as one remote copy per hop
+    (`ops.fused_matmul.ring_shift` — `make_async_remote_copy` under the
+    hood on compiled TPU), rotating each prefill rank's rows to its paired
+    decode rank `offset` ranks ahead.  Off-TPU (and whenever the kernels
+    gate off) it falls back to the identical `lax.ppermute` XLA transfer —
+    installing the ship path is always safe, the PR-9 contract.
+  * `pack_kv` / `unpack_kv` — the CROSS-PROCESS path: serving workers are
+    separate processes (always on CPU fleets, usually across hosts), so the
+    rows travel as one pickled blob over the worker HTTP plane
+    (`POST /kv_ship`); the decode side grafts them through the same
+    `slots.warm_small_cache` + `write_slot` programs a prefix-cache hit
+    uses.  Unpack returns None on torn/foreign bytes — a bad ship is a
+    retryable miss, never a crash.
+
+`kv_graft` is the compiled graft program (build the warm batch-1 cache,
+write it into the slot) registered in the kf-lint corpus
+(analysis/programs.py "serving-kv-ship") alongside the in-mesh rotation.
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+
+def ship_kv_rows(rows, axis_name: str, offset: int = 1,
+                 interpret: Optional[bool] = None):
+    """Rotate every leaf of `rows` to the rank `offset` ahead on
+    `axis_name` — the per-slot remote copy of the in-mesh ship path.  One
+    remote DMA per leaf per hop on compiled TPU / interpret mode, the
+    bit-identical ppermute lowering everywhere else."""
+    from .fused_matmul import ring_shift
+
+    return jax.tree.map(
+        lambda x: ring_shift(x, axis_name, offset, interpret), rows
+    )
+
+
+def pack_kv(meta: dict, rows: Dict[tuple, np.ndarray]) -> bytes:
+    """One blob: JSON-able metadata (request, first token, cursor, origin)
+    plus the numpy row blocks keyed by cache-leaf path."""
+    return pickle.dumps(
+        {"kv_ship": 1, "meta": dict(meta),
+         "rows": {"|".join(k): np.ascontiguousarray(v)
+                  for k, v in rows.items()}},
+        protocol=4,
+    )
+
+
+def unpack_kv(blob: bytes) -> Optional[tuple]:
+    """(meta, rows) from a pack_kv blob, or None on any decode failure —
+    a torn or foreign blob must read as a retryable miss."""
+    try:
+        payload = pickle.loads(blob)
+        if not isinstance(payload, dict) or payload.get("kv_ship") != 1:
+            return None
+        rows = {tuple(k.split("|")): np.asarray(v)
+                for k, v in payload["rows"].items()}
+        return payload["meta"], rows
+    except Exception:  # noqa: BLE001 - untrusted bytes by definition
+        return None
+
+
+def kv_graft(big, small, slot):
+    """Graft a warm batch-1 cache (rows + cursor already in place —
+    slots.warm_small_cache) into the decode cache at `slot`: the compiled
+    receive half of the ship path.  Thin alias over slots.write_slot so the
+    corpus program lints exactly what the decode worker runs."""
+    from ..serving.slots import write_slot
+
+    return write_slot(big, small, slot)

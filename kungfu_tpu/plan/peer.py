@@ -269,6 +269,9 @@ class HostList(tuple):
         return ",".join(str(h) for h in self)
 
 
+SERVING_TIERS = ("prefill", "decode")
+
+
 @dataclass
 class Cluster:
     """The elastic cluster document: runners (one per host) + workers.
@@ -277,10 +280,18 @@ class Cluster:
     (reference srcs/go/plan/cluster.go, configserver.go:42-110). Workers are
     the ranked PeerList used to build the device mesh; runners are the
     per-host supervisors that receive update notifications.
+
+    `tiers` is the serving-era extension (docs/serving.md "disaggregated
+    prefill/decode"): an optional map of worker "host:port" -> tier name
+    ("prefill" | "decode").  It serializes ONLY when present, so untier'd
+    documents keep their exact bytes/digests and every pre-serving consumer
+    round-trips unchanged.  Workers read their tier from the document at
+    boot; the tiered autoscaler edits the map alongside the worker list.
     """
 
     runners: PeerList
     workers: PeerList
+    tiers: Optional[Dict[str, str]] = None
 
     def validate(self) -> None:
         # every worker's host must have a runner (cluster.go:75-87)
@@ -292,6 +303,44 @@ class Cluster:
             raise ValueError("duplicate workers")
         if len(set(self.runners)) != len(self.runners):
             raise ValueError("duplicate runners")
+        if self.tiers is not None:
+            workers = {str(w) for w in self.workers}
+            for spec, tier in self.tiers.items():
+                if spec not in workers:
+                    raise ValueError(f"tier entry {spec!r} is not a worker")
+                if tier not in SERVING_TIERS:
+                    raise ValueError(f"unknown tier {tier!r} for {spec!r}")
+
+    def tier_of(self, peer: PeerID) -> str:
+        """The worker's serving tier, or "" on an untier'd document (every
+        worker then runs the monolithic prefill+decode engine)."""
+        if self.tiers is None:
+            return ""
+        return self.tiers.get(str(peer), "decode")
+
+    def assign_tiers(self, prefill_ranks: int) -> "Cluster":
+        """Tier the document: the first `prefill_ranks` workers (document
+        order) become the prefill pool, the rest the decode pool."""
+        if not 0 < prefill_ranks < len(self.workers):
+            raise ValueError(
+                f"prefill_ranks={prefill_ranks} must leave both pools "
+                f"non-empty out of {len(self.workers)} workers"
+            )
+        tiers = {
+            str(w): ("prefill" if i < prefill_ranks else "decode")
+            for i, w in enumerate(self.workers)
+        }
+        c = Cluster(runners=self.runners, workers=self.workers, tiers=tiers)
+        c.validate()
+        return c
+
+    def tier_counts(self) -> Dict[str, int]:
+        out = {t: 0 for t in SERVING_TIERS}
+        for w in self.workers:
+            t = self.tier_of(w)
+            if t:
+                out[t] += 1
+        return out
 
     def size(self) -> int:
         return len(self.workers)
@@ -304,12 +353,25 @@ class Cluster:
         if new_size < 0:
             raise ValueError("negative size")
         workers = list(self.workers)
+        grown: List[PeerID] = []
         if new_size <= len(workers):
             workers = workers[:new_size]
         else:
             while len(workers) < new_size:
-                workers.append(self._grow_one(PeerList(workers)))
-        c = Cluster(runners=self.runners, workers=PeerList(workers))
+                p = self._grow_one(PeerList(workers))
+                workers.append(p)
+                grown.append(p)
+        tiers = None
+        if self.tiers is not None:
+            # keep retained workers' tiers, drop removed ones, default
+            # grown workers into the decode pool (the tiered autoscaler
+            # edits the map explicitly when it wants a prefill grow)
+            alive = {str(w) for w in workers}
+            tiers = {s: t for s, t in self.tiers.items() if s in alive}
+            for p in grown:
+                tiers.setdefault(str(p), "decode")
+        c = Cluster(runners=self.runners, workers=PeerList(workers),
+                    tiers=tiers)
         c.validate()
         return c
 
@@ -334,13 +396,19 @@ class Cluster:
         return hashlib.sha256(self.bytes()).hexdigest()[:16]
 
     def to_json(self) -> dict:
-        return {"runners": self.runners.to_json(), "workers": self.workers.to_json()}
+        out = {"runners": self.runners.to_json(),
+               "workers": self.workers.to_json()}
+        if self.tiers is not None:
+            out["tiers"] = dict(self.tiers)
+        return out
 
     @classmethod
     def from_json(cls, d: dict) -> "Cluster":
+        tiers = d.get("tiers")
         return cls(
             runners=PeerList.from_json(d["runners"]),
             workers=PeerList.from_json(d["workers"]),
+            tiers=dict(tiers) if tiers is not None else None,
         )
 
     @classmethod

@@ -9,14 +9,24 @@ runs a production serving fleet here:
                  decode mode: bucketed prefill + one fixed-shape decode
                  program, per-slot KV-cache cursors, int8 cache dtype from
                  the model config, optional tp-sharded weights
+  prefix.py      radix prefix KV cache: shared prompt prefixes graft cached
+                 rows into fresh slots instead of re-prefilling (LRU under
+                 KFT_PREFIX_CACHE_MB, ref-counted, invalidated on reload)
+  spec.py        speculative decoding: a draft model proposes, the target
+                 verifies k tokens in ONE [slots, k] step — bit-identical
+                 greedy output, per-slot accept cursors
+  disagg.py      disaggregated prefill/decode pools: tiered dispatch, the
+                 KV ship path (ops/kv_ship.py), composition-driven
+                 per-pool autoscaling
   queue.py       bounded admission queue with deadlines, re-queue-to-front,
                  and backpressure
-  slots.py       KV-slot ledger + jitted cache graft/reset
-  worker.py      one serving rank: HTTP /generate + buddy weight/warm-state
-                 snapshots + telemetry + chaos injection
-  router.py      fleet front door: admission, dispatch, re-queue on worker
-                 loss (zero drops), queue-depth autoscaler driving the
-                 config server's conditional-PUT document
+  slots.py       KV-slot ledger + jitted cache graft/reset/cursor surgery
+  worker.py      one serving rank: HTTP /generate (+/kv_ship on the decode
+                 tier) + buddy weight/warm-state snapshots + telemetry +
+                 chaos injection
+  router.py      fleet front door: admission, tier-aware dispatch, re-queue
+                 on worker loss (zero drops), queue-depth autoscaler
+                 driving the config server's conditional-PUT document
   __main__.py    `python -m kungfu_tpu.serving` / `kungfu-run -serve`: the
                  supervisor gluing config server + workers + router +
                  autoscaler + fleet telemetry into one process tree
@@ -24,16 +34,20 @@ runs a production serving fleet here:
 See docs/serving.md for the architecture and failure semantics.
 """
 from .engine import BackpressureError, ServingEngine, default_buckets
+from .prefix import PrefixCache
 from .queue import AdmissionQueue
 from .request import Request, Result
 from .slots import SlotManager
+from .spec import SpecDecoder
 
 __all__ = [
     "AdmissionQueue",
     "BackpressureError",
+    "PrefixCache",
     "Request",
     "Result",
     "ServingEngine",
     "SlotManager",
+    "SpecDecoder",
     "default_buckets",
 ]

@@ -76,10 +76,18 @@ class ServeSupervisor:
             "--queue-capacity", str(a.worker_queue_capacity),
             "--seed", str(a.seed),
         ]
+        tier = self.cluster.tier_of(peer)
+        if tier:
+            cmd += ["--tier", tier]
         if a.model_json:
             cmd += ["--model-json", a.model_json]
         if a.weights_file:
             cmd += ["--weights-file", a.weights_file]
+        if a.prefix_cache != "auto":
+            cmd += ["--prefix-cache", a.prefix_cache]
+        if a.spec_draft:
+            cmd += ["--spec-draft", a.spec_draft,
+                    "--spec-k", str(a.spec_k)]
         return cmd
 
     def _spawn(self, peer: PeerID, incarnation: int) -> None:
@@ -114,14 +122,16 @@ class ServeSupervisor:
     def reconcile(self, cluster: Cluster, version: int) -> None:
         want = set(cluster.workers)
         have = set(self.procs)
+        # adopt the document BEFORE spawning: _worker_cmd reads each new
+        # worker's tier from it (a tiered autoscale grow names the pool)
+        self.cluster = cluster
+        self.version = version
         for peer in sorted(have - want):
             r = self.procs.pop(peer)
             r.terminate()
             log.info("- serving worker %s (scaled away at v%d)", peer, version)
         for peer in sorted(want - have):
             self._spawn(peer, self.incarnations.get(peer, -1) + 1)
-        self.cluster = cluster
-        self.version = version
 
     def collect_dead(self) -> None:
         """A dead worker still in the document respawns in place — the
@@ -169,6 +179,19 @@ def main(argv=None) -> int:
     ap.add_argument("--preset", default="tiny")
     ap.add_argument("--model-json", default="")
     ap.add_argument("--weights-file", default="")
+    ap.add_argument("--prefill-ranks", type=int, default=0,
+                    help="disaggregate: the first N workers form the "
+                         "prefill pool, the rest decode (0: monolithic "
+                         "workers, the v1 topology)")
+    ap.add_argument("--prefix-cache", default="auto",
+                    choices=("auto", "on", "off"),
+                    help="radix prefix KV cache on the prefill side "
+                         "(auto: KFT_PREFIX_CACHE_MB decides)")
+    ap.add_argument("--spec-draft", default="",
+                    help="arm speculative decoding on decode/monolithic "
+                         "workers: a worker PRESETS name or 'same' "
+                         "(self-draft)")
+    ap.add_argument("--spec-k", type=int, default=4)
     ap.add_argument("--slots", type=int, default=4,
                     help="KV slots (concurrent requests) per worker")
     ap.add_argument("--seed", type=int, default=0)
@@ -198,6 +221,8 @@ def main(argv=None) -> int:
 
     hosts = HostList.parse(f"127.0.0.1:{args.max_size}")
     cluster = Cluster.from_hostlist(hosts, args.np)
+    if args.prefill_ranks:
+        cluster = cluster.assign_tiers(args.prefill_ranks)
 
     cs: Optional[ConfigServer] = None
     if args.config_server:
@@ -234,14 +259,22 @@ def main(argv=None) -> int:
 
     scaler = None
     if not args.no_autoscale:
-        scaler = Autoscaler(
-            client, router, min_size=args.min_size, max_size=args.max_size,
+        scale_kw = dict(
             hi_depth=int(os.environ.get("KFT_SERVE_SCALE_UP_DEPTH", "4")),
             up_after=int(os.environ.get("KFT_SERVE_SCALE_UP_TICKS", "2")),
             down_after=int(os.environ.get("KFT_SERVE_SCALE_DOWN_TICKS", "12")),
             tick_s=float(os.environ.get("KFT_SERVE_TICK_S", "0.5")),
             counters=counters,
         )
+        if args.prefill_ranks:
+            # tiered pools size themselves from queue COMPOSITION
+            from .disagg import TieredAutoscaler
+
+            scaler = TieredAutoscaler(client, router,
+                                      max_size=args.max_size, **scale_kw)
+        else:
+            scaler = Autoscaler(client, router, min_size=args.min_size,
+                                max_size=args.max_size, **scale_kw)
         scaler.start()
 
     from ..run.launcher import install_signal_trap
@@ -254,7 +287,7 @@ def main(argv=None) -> int:
         sup.reconcile(cluster, 0)
         while True:
             sup.step()
-            router.set_workers(sup.cluster.workers)
+            router.set_workers(sup.cluster.workers, sup.cluster.tiers)
             if args.timeout and time.monotonic() - t0 > args.timeout:
                 log.info("serve timeout after %.0fs; clean shutdown",
                          args.timeout)

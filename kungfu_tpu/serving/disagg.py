@@ -1,0 +1,281 @@
+"""Disaggregated prefill/decode pools — tiered serving over one document.
+
+The MLPerf TPU-pod study's lesson applies to inference: heterogeneous
+phases interfere when co-scheduled.  Prefill is compute-bound and bursty;
+decode is cache-read-bound and steady — on shared chips a long prefill
+stalls every decoding stream's TPOT.  Serving v2 splits them:
+
+  * the cluster document carries a `tiers` map (plan/peer.py): each worker
+    boots as tier "prefill" (stateless: the engine's `prefill_only`
+    surface, the radix prefix cache lives here) or "decode" (slot batch,
+    speculative decoding; admissions arrive as shipped KV, never local
+    prefill)
+  * the router dispatches by tier — requests go to the prefill pool, which
+    ships finished KV to a decode slot (ops/kv_ship.py: the PR-12 DMA
+    plane when tiers share a mesh, the packed-blob HTTP path across
+    processes — always the case on CPU fleets) and proxies the final
+    result back
+  * the `TieredAutoscaler` sizes the pools separately from queue
+    COMPOSITION: normalized prefill backlog (queued prompt tokens per
+    prefill rank) vs decode backlog (owed new tokens per decode rank)
+    decides WHICH pool grows; both commit through the same conditional-PUT
+    document path, journaled `scale_up`/`scale_down` with a `tier` field.
+
+Failure semantics are unchanged from v1 (docs/serving.md): a dead prefill
+rank fails the router's dispatch -> requeue-front; a dead decode rank fails
+the prefill worker's ship -> 502 -> requeue-front; warm progress still
+ships to ring buddies, so re-queued requests resume mid-output.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import List, Optional, Tuple
+
+from ..elastic.config_client import ConfigClient
+from ..monitor.journal import journal_event
+from ..ops.kv_ship import pack_kv
+from ..plan import Cluster, PeerList
+from ..utils import get_logger
+
+log = get_logger("kungfu.serving")
+
+
+class DecodePool:
+    """Prefill-worker-side view of the decode tier: resolves live decode
+    peers from the cluster document and picks the one with the most free
+    slots (cheap /healthz probe, cached briefly)."""
+
+    def __init__(self, client: ConfigClient, self_spec: str,
+                 probe_timeout_s: float = 1.0, cache_s: float = 1.0):
+        self.client = client
+        self.self_spec = self_spec
+        self.probe_timeout_s = probe_timeout_s
+        self.cache_s = cache_s
+        self._cache: Tuple[float, List[str]] = (0.0, [])
+
+    def decode_urls(self) -> List[str]:
+        t, urls = self._cache
+        if time.monotonic() - t < self.cache_s:
+            return urls
+        try:
+            got = self.client.poll_cluster()
+        except OSError:
+            return urls
+        if got is None:
+            return urls
+        cluster = got[0]
+        urls = [f"http://{p.host}:{p.port}" for p in cluster.workers
+                if cluster.tier_of(p) == "decode" and str(p) != self.self_spec]
+        self._cache = (time.monotonic(), urls)
+        return urls
+
+    def pick(self) -> List[str]:
+        """Decode URLs ordered best-first: most free slots according to a
+        quick health probe; unprobeable peers go last (they may still be
+        booting — a ship attempt decides)."""
+        urls = self.decode_urls()
+        scored: List[Tuple[float, str]] = []
+        for u in urls:
+            free = -1.0
+            try:
+                with urllib.request.urlopen(
+                    u + "/healthz", timeout=self.probe_timeout_s
+                ) as r:
+                    doc = json.loads(r.read().decode())
+                free = float(doc.get("free_slots", 0)) - float(
+                    doc.get("queue_depth", 0))
+            except (OSError, ValueError):
+                pass
+            scored.append((-free, u))
+        scored.sort(key=lambda x: x[0])
+        return [u for _, u in scored]
+
+
+def ship_to_decode(urls: List[str], req, first_token: int, rows,
+                   cursor: int, origin_rank: int,
+                   ship_timeout_s: float = 10.0,
+                   result_timeout_s: float = 120.0,
+                   counters=None) -> Tuple[Optional[dict], str]:
+    """Ship finished prefill KV to the first decode rank that accepts it,
+    then block for the request's final result (the prefill worker proxies
+    it back to the router).  Returns (result_json | None, error).  The
+    ship POST and the result GET are separate calls so `kv_ship_ms`
+    measures transfer + graft-admission, not the decode itself."""
+    blob = pack_kv(
+        {"cursor": int(cursor), "first_token": int(first_token),
+         "origin_rank": int(origin_rank), "request": req.to_json()},
+        rows,
+    )
+    last_err = "no decode workers"
+    for url in urls:
+        t0 = time.monotonic()
+        post = urllib.request.Request(
+            url + "/kv_ship", data=blob, method="POST",
+            headers={"Content-Type": "application/octet-stream"},
+        )
+        try:
+            with urllib.request.urlopen(post, timeout=ship_timeout_s) as r:
+                ack = json.loads(r.read().decode())
+        except urllib.error.HTTPError as e:
+            last_err = f"ship HTTP {e.code} from {url}"
+            if e.code == 503:  # decode backpressure: try the next peer
+                continue
+            continue
+        except OSError as e:
+            last_err = f"ship to {url} failed: {str(e)[:120]}"
+            continue
+        ship_ms = (time.monotonic() - t0) * 1e3
+        if counters is not None:
+            counters.observe_hist("kv_ship_ms", ship_ms)
+        if not ack.get("ok"):
+            last_err = f"ship rejected by {url}: {ack}"
+            continue
+        try:
+            with urllib.request.urlopen(
+                url + f"/kv_result?id={req.req_id}",
+                timeout=result_timeout_s,
+            ) as r:
+                return json.loads(r.read().decode()), ""
+        except (OSError, ValueError) as e:
+            # the decode rank died mid-decode: surface as a dispatch
+            # failure so the router re-queues (warm resume included)
+            return None, f"decode at {url} lost mid-stream: {str(e)[:120]}"
+    return None, last_err
+
+
+class TieredAutoscaler(threading.Thread):
+    """Separate prefill/decode pool sizing from queue composition.
+
+    Every tick reads the router's queue composition (queued prompt tokens
+    vs owed decode tokens) and each pool's size from the document.  A
+    sustained backlog grows the pool with the larger NORMALIZED pressure
+    (backlog tokens per rank of that tier); a sustained idle fleet shrinks
+    the larger pool.  Pools never drop below one rank each.  Commits are
+    conditional PUTs editing the worker list AND the tier map together —
+    the same optimistic-concurrency discipline as the flat autoscaler.
+    """
+
+    def __init__(self, client: ConfigClient, router,
+                 max_size: int = 4,
+                 hi_depth: int = 4, up_after: int = 2, down_after: int = 12,
+                 tick_s: float = 0.5, counters=None):
+        super().__init__(daemon=True, name="tiered-autoscaler")
+        self.client = client
+        self.router = router
+        self.max_size = max_size
+        self.hi_depth = hi_depth
+        self.up_after = up_after
+        self.down_after = down_after
+        self.tick_s = tick_s
+        self.counters = counters
+        self.events: List[dict] = []
+        self._stop = threading.Event()
+        self._up_streak = 0
+        self._idle_streak = 0
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            self._tick()
+            self._stop.wait(self.tick_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _tick(self) -> None:
+        comp = self.router.queue_composition()
+        depth = comp["depth"]
+        busy = self.router.active_requests()
+        health = self.client.get_health()
+        if health is None:
+            return
+        size = int(health.get("size", 0))
+        self._up_streak = self._up_streak + 1 if depth >= self.hi_depth else 0
+        idle = depth == 0 and busy == 0 and self.router.completed > 0
+        self._idle_streak = self._idle_streak + 1 if idle else 0
+        if self._up_streak >= self.up_after and size < self.max_size:
+            if self._commit(comp, grow=True):
+                self._up_streak = 0
+        elif self._idle_streak >= self.down_after:
+            if self._commit(comp, grow=False):
+                self._idle_streak = 0
+
+    def _pick_tier(self, cluster: Cluster, comp: dict, grow: bool) -> str:
+        counts = cluster.tier_counts()
+        n_p = max(1, counts.get("prefill", 0))
+        n_d = max(1, counts.get("decode", 0))
+        prefill_pressure = comp["prefill_tokens"] / n_p
+        decode_pressure = comp["decode_tokens"] / n_d
+        if grow:
+            return "prefill" if prefill_pressure > decode_pressure else "decode"
+        # shrink the pool with more headroom; keep both pools >= 1
+        if counts.get("prefill", 0) > 1 and (
+                counts.get("decode", 0) <= 1
+                or prefill_pressure <= decode_pressure):
+            return "prefill"
+        if counts.get("decode", 0) > 1:
+            return "decode"
+        return ""
+
+    def _commit(self, comp: dict, grow: bool) -> bool:
+        got = self.client.poll_cluster()
+        if got is None:
+            return False
+        cluster, version = got
+        if cluster.tiers is None:
+            return False  # not a tiered document: the flat autoscaler's job
+        tier = self._pick_tier(cluster, comp, grow)
+        if not tier:
+            return False
+        try:
+            resized = (self._grow(cluster, tier) if grow
+                       else self._shrink(cluster, tier))
+        except ValueError as e:
+            log.warning("tiered autoscale impossible: %s", e)
+            return False
+        if resized is None:
+            return False
+        if not self.client.put_cluster(resized, version=version):
+            return False  # lost the CAS race: re-read next tick
+        kind = "scale_up" if grow else "scale_down"
+        event = {"kind": kind, "tier": tier,
+                 "old_size": cluster.size(), "new_size": resized.size(),
+                 "queue_depth": comp["depth"],
+                 "prefill_tokens": comp["prefill_tokens"],
+                 "decode_tokens": comp["decode_tokens"],
+                 "cluster_version": version + 1}
+        self.events.append(event)
+        journal_event(kind, **event)
+        log.info("AUTOSCALE %s (%s tier): %d -> %d workers (depth %d)",
+                 kind, tier, cluster.size(), resized.size(), comp["depth"])
+        if self.counters is not None:
+            self.counters.inc_event("autoscale_events")
+            self.counters.inc_event(f"autoscale_{kind}_{tier}")
+        return True
+
+    @staticmethod
+    def _grow(cluster: Cluster, tier: str) -> Cluster:
+        grown = cluster.resize(cluster.size() + 1)
+        new_peer = grown.workers[-1]
+        tiers = dict(grown.tiers or {})
+        tiers[str(new_peer)] = tier
+        c = Cluster(runners=grown.runners, workers=grown.workers, tiers=tiers)
+        c.validate()
+        return c
+
+    @staticmethod
+    def _shrink(cluster: Cluster, tier: str) -> Optional[Cluster]:
+        victims = [p for p in cluster.workers
+                   if cluster.tier_of(p) == tier]
+        if len(victims) <= 1:
+            return None
+        victim = victims[-1]
+        workers = PeerList(p for p in cluster.workers if p != victim)
+        tiers = {s: t for s, t in (cluster.tiers or {}).items()
+                 if s != str(victim)}
+        c = Cluster(runners=cluster.runners, workers=workers, tiers=tiers)
+        c.validate()
+        return c

@@ -83,17 +83,40 @@ class _Client:
 def run_serve_drill(np: int = 2, buddy: str = "on", timeout_s: float = 300.0,
                     requests: int = 12, max_new: int = 16,
                     crash_tokens: int = 24, p99_bound_s: float = 60.0,
-                    skip_autoscale: bool = False) -> Dict:
-    """Run the drill; returns {"ok": bool, "failures": [...], metrics...}."""
+                    skip_autoscale: bool = False, tier: str = "") -> Dict:
+    """Run the drill; returns {"ok": bool, "failures": [...], metrics...}.
+
+    `tier="prefill"|"decode"` runs the DISAGGREGATED variant: a 3-rank
+    fleet (1 prefill + 2 decode), with the scripted kill targeting a rank
+    of that pool (`crash_serve@...:tier=...`).  A prefill kill fires on the
+    prefilled-token counter mid-burst (the router's dispatch dies and
+    re-queues); a decode kill fires mid-stream with shipped-KV requests
+    decoding (the prefill worker's proxy read dies, surfaces as a failed
+    dispatch, re-queues).  Either way: zero drops, bounded p99,
+    `rank_rejoined` journaled by the respawned victim."""
     failures: List[str] = []
-    metrics: Dict = {"np": np, "buddy": buddy, "requests": requests}
+    metrics: Dict = {"np": np, "buddy": buddy, "requests": requests,
+                     "tier": tier}
+
+    prefill_ranks = 0
+    if tier:
+        assert tier in ("prefill", "decode"), tier
+        np = max(np, 3)
+        prefill_ranks = 1
+        skip_autoscale = True  # the tier drill is a failover drill
+        # prefill workers count PREFILLED tokens (one bucketed prompt per
+        # request); decode workers count generated tokens
+        crash_tokens = 15 if tier == "prefill" else crash_tokens
+        plan = f"crash_serve@tokens={crash_tokens}:tier={tier}:rank=-1"
+    else:
+        plan = f"crash_serve@tokens={crash_tokens}:rank=1"
 
     tmp = tempfile.mkdtemp(prefix="kft-serve-drill-")
     jdir = os.path.join(tmp, "journal")
     env = dict(os.environ)
     env.update(
         JAX_PLATFORMS="cpu",
-        KFT_FAULT_PLAN=f"crash_serve@tokens={crash_tokens}:rank=1",
+        KFT_FAULT_PLAN=plan,
         KFT_JOURNAL_DIR=jdir,
         # aggressive autoscale windows so the drill finishes in seconds
         KFT_SERVE_SCALE_UP_DEPTH="3",
@@ -110,6 +133,8 @@ def run_serve_drill(np: int = 2, buddy: str = "on", timeout_s: float = 300.0,
         "--preset", "tiny", "--slots", "2", "--telemetry",
         "--timeout", str(int(timeout_s)), "-q",
     ]
+    if prefill_ranks:
+        cmd += ["--prefill-ranks", str(prefill_ranks)]
     if skip_autoscale:
         cmd.append("--no-autoscale")
     proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
@@ -297,12 +322,23 @@ def run_serve_drill(np: int = 2, buddy: str = "on", timeout_s: float = 300.0,
 
     if stats.get("dropped", 0) != 0:
         failures.append(f"router reports dropped={stats.get('dropped')}")
-    if not by_kind.get("chaos_crash_serve"):
+    crashes = by_kind.get("chaos_crash_serve", [])
+    if not crashes:
         failures.append("crash_serve fault never fired")
+    elif tier:
+        crash_tiers = {e.get("tier") for e in crashes}
+        if crash_tiers != {tier}:
+            failures.append(f"crash fired on tier {sorted(crash_tiers)}, "
+                            f"expected {tier}")
     if not by_kind.get("request_requeued"):
         failures.append("no request_requeued journal events (kill missed "
                         "the in-flight window?)")
     rejoins = by_kind.get("rank_rejoined", [])
+    if tier and rejoins:
+        rejoin_tiers = {e.get("tier") for e in rejoins}
+        if tier not in rejoin_tiers:
+            failures.append(f"rank_rejoined tiers {sorted(rejoin_tiers)}, "
+                            f"expected a {tier} rejoin")
     if not rejoins:
         failures.append("victim never journaled rank_rejoined")
     else:

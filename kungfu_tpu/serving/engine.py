@@ -20,6 +20,23 @@ fixed-shape slot batch:
     the next admission (slots.reset_slot keeps the free row's ride-along
     cursor at 0)
 
+Serving v2 composes three multipliers onto that loop, each at bit-identical
+greedy output (docs/serving.md):
+
+  * prefix reuse (`prefix_cache=` — serving/prefix.py): admission matches
+    the request's tokens against the radix KV cache and prefills only the
+    un-cached SUFFIX from a warm batch-1 cache (cursor = hit length); the
+    same bucketed prefill programs serve warm and cold starts, so the
+    compile count is unchanged
+  * speculative decoding (`spec=` — serving/spec.py): a draft model
+    proposes, the target verifies k tokens in ONE [slots, k] forward — the
+    single new compiled decode signature — and per-slot accept cursors roll
+    back through `slots.set_cursors`
+  * disaggregation (serving/disagg.py): `prefill_only` runs the prefill
+    half with no slot at all (the prefill-tier surface), and
+    `submit_prefilled` admits shipped KV rows straight into a slot with no
+    local prefill (the decode-tier surface)
+
 The per-slot cache cursors this relies on live in models/transformer.py
 (decode mode).  The int8 KV-cache storage dtype comes straight from the
 model config (`kv_cache_dtype="int8"`): the serving cache stores quantized
@@ -48,7 +65,13 @@ from ..utils import get_logger
 from ..utils.trace import trace_scope
 from .queue import AdmissionQueue
 from .request import Request, Result
-from .slots import SlotManager, reset_slot, write_slot
+from .slots import (
+    SlotManager,
+    extract_rows,
+    reset_slot,
+    warm_small_cache,
+    write_slot,
+)
 
 log = get_logger("kungfu.serving")
 
@@ -92,6 +115,8 @@ class ServingEngine:
         mesh=None,
         rules=None,
         counters=None,
+        prefix_cache=None,
+        spec=None,
     ):
         assert cfg.rope, "serving decode requires a rope config (cache cursors)"
         # decode overrides mirror generate(): full attention on the cache, a
@@ -126,11 +151,18 @@ class ServingEngine:
 
         # host-side per-slot decode state (fixed [slots] arrays)
         self._next_tok = np.zeros(slots, np.int32)
+        self._cursor = np.zeros(slots, np.int64)  # mirror of cache idx
         self._rng = np.random.default_rng(0)
         self._pending: Dict[str, _Pending] = {}
         self._completed_lock = threading.Lock()
         self.total_tokens = 0      # generated tokens, engine lifetime
+        self.total_prefill_tokens = 0  # prefilled tokens (prefill tier signal)
         self.total_completed = 0
+        # serving v2 composition
+        self.prefix = prefix_cache
+        self.spec = spec
+        self._grafts: Dict[str, tuple] = {}  # req_id -> (meta, rows) shipped KV
+        self.params_version = 0
 
         model = self.model
 
@@ -146,16 +178,20 @@ class ServingEngine:
             return jax.tree_util.tree_map_with_path(fix, cache)
 
         @jax.jit
-        def _prefill(params, cache0, tokens, true_len):
+        def _prefill(params, cache_small, tokens, n_new, total_len):
             # tokens [1, bucket]; right-padding is causally invisible to the
-            # real positions, so logits at true_len-1 are exact
+            # real positions, so logits at n_new-1 are exact.  cache_small is
+            # the zeroed template on a cold start, or a warm cache whose
+            # cursor sits at the prefix-cache hit length — the forward reads
+            # positions from the cursor, so ONE program serves both.
             logits, st = model.apply(
-                {"params": params, "cache": cache0}, tokens, mutable=["cache"]
+                {"params": params, "cache": cache_small}, tokens,
+                mutable=["cache"]
             )
             last = jax.lax.dynamic_index_in_dim(
-                logits, true_len - 1, axis=1, keepdims=False
+                logits, n_new - 1, axis=1, keepdims=False
             )[0].astype(jnp.float32)  # [V]
-            return last, _fix_cursor(st["cache"], true_len)
+            return last, _fix_cursor(st["cache"], total_len)
 
         @jax.jit
         def _decode(params, cache, toks):
@@ -166,12 +202,40 @@ class ServingEngine:
             )
             return logits[:, -1].astype(jnp.float32), st["cache"]
 
+        @jax.jit
+        def _verify_accept(params, cache, toks, proposals):
+            # toks [slots, k] — the ONE extra compiled decode signature of
+            # speculative decoding: per-slot cursors make a k-token call
+            # exactly k chained 1-token calls.  Greedy acceptance and the
+            # per-slot cursor rollback fold into the same program: one
+            # dispatch, one host sync per speculative round.
+            k = toks.shape[1]
+            logits, st = model.apply(
+                {"params": params, "cache": cache}, toks, mutable=["cache"]
+            )
+            g = jnp.argmax(
+                logits.astype(jnp.float32), axis=-1
+            ).astype(jnp.int32)  # [slots, k]: the target's own greedy run
+            ok = (proposals == g[:, : k - 1]).astype(jnp.int32)
+            n_acc = jnp.cumprod(ok, axis=1).sum(axis=1)  # accepted prefix
+
+            def roll(path, leaf):
+                # the apply advanced every cursor by k; committed length is
+                # n_acc + 1 (accepted drafts + the correction token)
+                if getattr(path[-1], "key", None) == "idx":
+                    return leaf - (k - 1 - n_acc).astype(leaf.dtype)
+                return leaf
+
+            cache2 = jax.tree_util.tree_map_with_path(roll, st["cache"])
+            return g, n_acc, cache2
+
         self._prefill = _prefill
         self._decode = _decode
+        self._verify = _verify_accept
 
     # -- submission ----------------------------------------------------------------
 
-    def submit(self, req: Request) -> _Pending:
+    def submit(self, req: Request, _grafted: bool = False) -> _Pending:
         """Admit a request; raises ValueError when it can never fit, returns
         a handle whose wait() yields the Result.  A full queue raises
         BackpressureError — the HTTP layer's 503."""
@@ -180,7 +244,7 @@ class ServingEngine:
             raise ValueError(
                 f"request needs {need} cache rows > max_len={self.dcfg.max_len}"
             )
-        if len(req.prefill_tokens) > self.buckets[-1]:
+        if not _grafted and len(req.prefill_tokens) > self.buckets[-1]:
             raise ValueError("prompt longer than the largest prefill bucket")
         pending = _Pending(req)
         with self._completed_lock:
@@ -191,6 +255,24 @@ class ServingEngine:
             raise BackpressureError(f"queue full ({self.queue.capacity})")
         self._gauge()
         return pending
+
+    def submit_prefilled(self, req: Request, meta: dict,
+                         rows: Dict[tuple, Any]) -> _Pending:
+        """Admit a request whose prefill already ran on another rank: the
+        shipped KV rows + first token graft straight into a slot when one
+        frees (the decode-tier half of disaggregation).  Re-ships of an
+        already-known request (a prefill rank died mid-wait and the retry
+        re-shipped) return the existing handle — the double-serve guard."""
+        with self._completed_lock:
+            existing = self._pending.get(req.req_id)
+        if existing is not None:
+            return existing
+        self._grafts[req.req_id] = (dict(meta), rows)
+        try:
+            return self.submit(req, _grafted=True)
+        except Exception:
+            self._grafts.pop(req.req_id, None)
+            raise
 
     # -- the scheduler iteration ---------------------------------------------------
 
@@ -237,26 +319,99 @@ class ServingEngine:
     def _admit(self, req: Request) -> None:
         slot = self.slot_mgr.allocate(req)
         assert slot is not None
+        graft = self._grafts.pop(req.req_id, None)
+        if graft is not None:
+            self._admit_prefilled(slot, req, *graft)
+            return
         toks = req.prefill_tokens
-        bucket = self._bucket_for(len(toks))
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, : len(toks)] = toks
-        with trace_scope("serve:prefill", cat="serving",
-                         args={"tokens": len(toks), "bucket": bucket}):
-            t0 = time.monotonic()
-            last_logits, small = self._prefill(
-                self.params, self._small_cache0, jnp.asarray(padded),
-                len(toks),
-            )
-            self.cache = write_slot(self.cache, small, slot)
-            first = self._pick(np.asarray(last_logits), req.temperature)
-            dt = time.monotonic() - t0
+        first, small, total, hit = self._run_prefill(toks, req.temperature)
+        self.cache = write_slot(self.cache, small, slot)
+        self._cursor[slot] = total
+        if self.spec is not None:
+            self.spec.prefill_slot(slot, toks)
         req.ttft_s = time.monotonic() - req.submitted_t
         self._observe("ttft_ms", req.ttft_s * 1e3)
-        self._observe("prefill_ms", dt * 1e3)
         self._push_token(slot, req, int(first))
 
+    def _run_prefill(self, toks, temperature: float):
+        """The shared prefill: prefix-cache match -> warm/cold batch-1
+        forward over the suffix bucket -> insert the new rows back into the
+        radix tree.  Returns (first_token, small_cache, total_len, hit)."""
+        total = len(toks)
+        hit, lease = 0, None
+        if self.prefix is not None:
+            hit, lease = self.prefix.match(toks)
+        suffix = toks[hit:]
+        bucket = self._bucket_for(len(suffix))
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, : len(suffix)] = suffix
+        with trace_scope("serve:prefill", cat="serving",
+                         args={"tokens": total, "hit": hit,
+                               "bucket": bucket}):
+            t0 = time.monotonic()
+            small_in = self._small_cache0
+            if lease is not None:
+                # device-resident, memoized per (prefix, hit): repeat hits
+                # of a hot prefix skip the host assembly entirely
+                small_in = self.prefix.warm_small(self._small_cache0, lease)
+            last_logits, small = self._prefill(
+                self.params, small_in, jnp.asarray(padded),
+                len(suffix), total,
+            )
+            if self.prefix is not None:
+                # lazy rows: the device->host copy only happens when the
+                # insert actually creates a node (cache-hot admissions skip)
+                self.prefix.insert(tuple(toks),
+                                   lambda: extract_rows(small, total))
+            if lease is not None:
+                lease.release()
+            first = self._pick(np.asarray(last_logits), temperature)
+            dt = time.monotonic() - t0
+        self.total_prefill_tokens += len(suffix)
+        self._observe("prefill_ms", dt * 1e3)
+        return first, small, total, hit
+
+    def prefill_only(self, req: Request):
+        """The prefill-tier surface: run the (prefix-cache-aware) prefill
+        with NO slot and return what the decode tier needs — the first
+        token, the KV rows, and the cursor.  Raises ValueError exactly as
+        submit() would on a request that can never fit."""
+        need = len(req.prefill_tokens) + req.remaining_new_tokens
+        if need > self.dcfg.max_len:
+            raise ValueError(
+                f"request needs {need} cache rows > max_len={self.dcfg.max_len}"
+            )
+        if len(req.prefill_tokens) > self.buckets[-1]:
+            raise ValueError("prompt longer than the largest prefill bucket")
+        toks = req.prefill_tokens
+        first, small, total, hit = self._run_prefill(toks, req.temperature)
+        return int(first), extract_rows(small, total), total, hit
+
+    def _admit_prefilled(self, slot: int, req: Request, meta: dict,
+                         rows: Dict[tuple, Any]) -> None:
+        """Graft shipped KV rows into `slot` (no local prefill): build the
+        warm batch-1 cache and write it through the same compiled program a
+        prefix hit uses."""
+        total = int(meta["cursor"])
+        first = int(meta["first_token"])
+        t0 = time.monotonic()
+        with trace_scope("serve:kv_graft", cat="serving",
+                         args={"tokens": total}):
+            small = warm_small_cache(self._small_cache0, rows, total)
+            self.cache = write_slot(self.cache, small, slot)
+        self._cursor[slot] = total
+        if self.spec is not None:
+            self.spec.prefill_slot(slot, req.prefill_tokens)
+        # TTFT: the first token was produced on the prefill rank; local
+        # queue wait still counts (submitted_t is decode-side receipt)
+        req.ttft_s = time.monotonic() - req.submitted_t
+        self._observe("ttft_ms", req.ttft_s * 1e3)
+        self._observe("kv_graft_ms", (time.monotonic() - t0) * 1e3)
+        self._push_token(slot, req, first)
+
     def _decode_step(self) -> List[Result]:
+        if self._spec_step_ok():
+            return self._spec_decode_step()
         toks = jnp.asarray(self._next_tok[:, None])
         with trace_scope("serve:decode", cat="serving",
                          args={"active": self.slot_mgr.active_count}):
@@ -265,12 +420,87 @@ class ServingEngine:
             logits = np.asarray(logits)
             dt = time.monotonic() - t0
         self._observe("tok_latency_ms", dt * 1e3)
+        self._cursor += 1  # every row consumed one token (free rows too)
+        active = sorted(self.slot_mgr.active().items())
+        if self.spec is not None:
+            # the target advanced without the draft: those slots' draft
+            # caches are behind until their next admission
+            self.spec.on_plain_step([s for s, _ in active])
         done: List[Result] = []
-        for slot, req in sorted(self.slot_mgr.active().items()):
+        for slot, req in active:
             nxt = self._pick(logits[slot], req.temperature)
             finished = self._push_token(slot, req, int(nxt), from_decode=True)
             if finished is not None:
                 done.append(finished)
+        return done
+
+    def _spec_step_ok(self) -> bool:
+        """Speculate this iteration?  Needs: a decoder, at least one active
+        slot with a fresh draft cache and healthy acceptance, every active
+        request greedy (temperature 0 — acceptance is an argmax identity),
+        and k rows of cache headroom on EVERY active slot (a verify that
+        wrote past max_len would poison that slot's whole row, engine
+        overflow semantics)."""
+        if self.spec is None:
+            return False
+        active = self.slot_mgr.active()
+        if not active:
+            return False
+        any_ready = False
+        for slot, req in active.items():
+            if req.temperature > 0.0:
+                return False
+            if not self.spec.headroom_ok(int(self._cursor[slot])):
+                return False
+            if self.spec.slot_ready(slot):
+                any_ready = True
+        return any_ready
+
+    def _spec_decode_step(self) -> List[Result]:
+        """One speculative round: draft k-1 proposals (one dispatch, draft
+        cursor re-anchored in-program), verify + accept + roll back
+        [slots, k] (one dispatch), commit each slot's accepted run + the
+        target's correction token.  Acceptance is self-validating — a
+        proposal commits only when it equals the target's own greedy token
+        — so stale or garbage proposals can cost speed, never
+        correctness."""
+        k = self.spec.k
+        t0_toks = self._next_tok.copy()
+        with trace_scope("serve:draft", cat="serving", args={"k": k}):
+            proposals = self.spec.propose(t0_toks, self._cursor)
+        ver = np.concatenate([t0_toks[:, None], proposals], axis=1)
+        with trace_scope("serve:verify", cat="serving",
+                         args={"active": self.slot_mgr.active_count,
+                               "k": k}):
+            t0 = time.monotonic()
+            g_dev, n_acc_dev, self.cache = self._verify(
+                self.params, self.cache, jnp.asarray(ver.astype(np.int32)),
+                jnp.asarray(proposals.astype(np.int32)),
+            )
+            g = np.asarray(g_dev)
+            n_acc = np.asarray(n_acc_dev)
+            dt = time.monotonic() - t0
+        self._observe("tok_latency_ms", dt * 1e3)
+        # every slot's cursor (free rows included) moved to committed
+        # length: + accepted drafts + the correction token
+        self._cursor = self._cursor + n_acc + 1
+        done: List[Result] = []
+        for slot, req in sorted(self.slot_mgr.active().items()):
+            budget = req.remaining_new_tokens - len(req.generated)
+            run: List[int] = []
+            for j in range(int(n_acc[slot]) + 1):
+                tok = int(g[slot, j])
+                run.append(tok)
+                if len(run) >= budget or (req.eos_id >= 0
+                                          and tok == req.eos_id):
+                    break
+            if self.spec.slot_ready(slot):
+                self.spec.observe(slot, int(n_acc[slot]), len(run))
+            for tok in run:
+                finished = self._push_token(slot, req, tok, from_decode=True)
+                if finished is not None:
+                    done.append(finished)
+                    break
         return done
 
     def _push_token(self, slot: int, req: Request, tok: int,
@@ -284,6 +514,9 @@ class ServingEngine:
             self.slot_mgr.release(slot)
             self.cache = reset_slot(self.cache, slot)
             self._next_tok[slot] = 0
+            self._cursor[slot] = 0
+            if self.spec is not None:
+                self.spec.release_slot(slot)
             return self._finish(req, status="ok")
         self._next_tok[slot] = tok
         return None
@@ -298,6 +531,7 @@ class ServingEngine:
         return int(self._rng.choice(len(p), p=p))
 
     def _finish(self, req: Request, status: str) -> Result:
+        self._grafts.pop(req.req_id, None)  # expired-before-admission ship
         req.finished_t = time.monotonic()
         lat = (req.finished_t - req.submitted_t) * 1e3
         result = Result(
@@ -319,6 +553,17 @@ class ServingEngine:
             pending._finish(result)
         return result
 
+    def set_params(self, params: Any) -> None:
+        """Install reloaded weights.  The radix prefix cache is a pure
+        function of the params, so every cached row is invalidated; the
+        per-slot KV of in-flight requests stays (their earlier tokens were
+        produced by the old weights — the stream finishes consistently and
+        fresh admissions use the new weights end to end)."""
+        self.params = params
+        self.params_version += 1
+        if self.prefix is not None:
+            self.prefix.invalidate(reason="weight_reload")
+
     def in_flight(self) -> List[dict]:
         """Queued + slotted requests with their progress — the warm-resume
         snapshot a worker ships to its buddy (worker.py)."""
@@ -330,13 +575,19 @@ class ServingEngine:
         return out
 
     def stats(self) -> Dict[str, Any]:
-        return {
+        out = {
             "queue_depth": self.queue.depth(),
             "active_slots": self.slot_mgr.active_count,
             "free_slots": self.slot_mgr.free_count,
             "total_tokens": self.total_tokens,
+            "total_prefill_tokens": self.total_prefill_tokens,
             "total_completed": self.total_completed,
         }
+        if self.prefix is not None:
+            out["prefix"] = self.prefix.stats()
+        if self.spec is not None:
+            out["spec"] = self.spec.stats()
+        return out
 
     def _observe(self, metric: str, value: float) -> None:
         if self.counters is not None:

@@ -1,0 +1,364 @@
+"""Radix prefix KV cache — prefill reuse for shared prompt prefixes.
+
+Production traffic shares prompt structure (system prompts, few-shot
+preambles, chat history), so most prefill work recomputes KV rows some
+earlier request already produced.  This module keeps those rows in a
+ref-counted radix tree keyed by token sequence:
+
+  * MATCH at admission: walk the tree with the request's prefill tokens and
+    return the longest cached prefix (capped at len(tokens) - 1 — the last
+    token always re-runs so the admission still yields the first-token
+    logits).  The hit's rows graft into the engine's batch-1 prefill cache
+    via `slots.warm_small_cache`, the suffix prefills from cursor=hit, and
+    the result lands in the slot through the existing `slots.write_slot`
+    path.  Greedy output is bit-identical to a cold prefill: cached K/V rows
+    are pure per-position functions of (params, tokens) — rope positions are
+    absolute and causal attention reads only rows at or below the cursor —
+    so the grafted rows equal the recomputed ones bit for bit.
+  * INSERT after prefill: the freshly computed rows extend the tree, storing
+    only the suffix beyond the deepest existing match (shared prefixes share
+    storage — the radix property).  Nodes split on mid-edge divergence.
+  * EVICT under a byte budget (`KFT_PREFIX_CACHE_MB`, default 64): LRU over
+    childless, unreferenced nodes, deepest-last-used first, journaled as
+    `prefix_evicted`.  Matches in flight pin their path via refcounts
+    (`_Lease`), so eviction can never free rows an admission is grafting.
+  * INVALIDATE on weight reload: cached rows are a pure function of the
+    params, so `ServingEngine.set_params` clears the tree
+    (`prefix_invalidated` journaled).
+
+Telemetry: `prefix_hit_tokens` / `prefix_lookup_tokens` counters,
+`prefix_hit_rate` + `prefix_cache_bytes` gauges, `prefix_evicted` journal
+events.  See docs/serving.md "Radix prefix cache".
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils import get_logger
+
+log = get_logger("kungfu.serving")
+
+DEFAULT_BUDGET_MB = 64.0
+
+
+def prefix_cache_budget_bytes() -> int:
+    """The byte budget from KFT_PREFIX_CACHE_MB (<= 0 disables the cache)."""
+    mb = float(os.environ.get("KFT_PREFIX_CACHE_MB", str(DEFAULT_BUDGET_MB)))
+    return int(mb * (1 << 20))
+
+
+class _Node:
+    """One radix edge: `edge` tokens and their KV rows (per-leaf numpy
+    blocks of shape [len(edge), ...], keyed like slots.extract_rows).
+    `warm` memoizes fully-assembled DEVICE warm caches per hit length
+    ending at this node — repeat hits of a hot prefix (the dominant
+    production pattern) then cost zero host work and zero transfers."""
+
+    __slots__ = ("edge", "rows", "children", "parent", "refs", "last_used",
+                 "nbytes", "warm")
+
+    def __init__(self, edge: Tuple[int, ...],
+                 rows: Optional[Dict[tuple, np.ndarray]],
+                 parent: Optional["_Node"]):
+        self.edge = edge
+        self.rows = rows or {}
+        self.children: Dict[int, _Node] = {}
+        self.parent = parent
+        self.refs = 0
+        self.last_used = 0
+        self.nbytes = sum(a.nbytes for a in self.rows.values())
+        self.warm: Dict[int, tuple] = {}  # hit -> (device tree, nbytes)
+
+    def slice_rows(self, lo: int, hi: int) -> Dict[tuple, np.ndarray]:
+        return {k: a[lo:hi] for k, a in self.rows.items()}
+
+    def drop_warm(self) -> int:
+        freed = sum(nb for _, nb in self.warm.values())
+        self.warm.clear()
+        return freed
+
+
+class _Lease:
+    """Pin on a matched path: (node, rows_taken) pairs, released after the
+    graft copies the rows out.  Holding a lease blocks eviction of every
+    node on the path."""
+
+    def __init__(self, cache: "PrefixCache", path: List[Tuple[_Node, int]]):
+        self._cache = cache
+        self._path = path
+        self.hit = sum(take for _, take in path)
+
+    def rows(self) -> Dict[tuple, np.ndarray]:
+        """Concatenated row blocks along the path: [hit, ...] per leaf."""
+        assert self._path, "rows() on an empty lease"
+        keys = self._path[0][0].rows.keys()
+        return {
+            k: np.concatenate([node.rows[k][:take]
+                               for node, take in self._path])
+            for k in keys
+        }
+
+    def release(self) -> None:
+        with self._cache._lock:
+            for node, _ in self._path:
+                node.refs -= 1
+        self._path = []
+
+
+class PrefixCache:
+    def __init__(self, budget_bytes: Optional[int] = None, counters=None,
+                 min_tokens: int = 1):
+        self.budget = (prefix_cache_budget_bytes()
+                       if budget_bytes is None else int(budget_bytes))
+        self.counters = counters
+        self.min_tokens = max(1, int(min_tokens))
+        self._lock = threading.Lock()
+        self._root = _Node((), None, None)
+        self._clock = 0
+        self.total_bytes = 0
+        self.hit_tokens = 0
+        self.lookup_tokens = 0
+        self.evictions = 0
+
+    # -- match ---------------------------------------------------------------------
+
+    def match(self, tokens: Tuple[int, ...]) -> Tuple[int, Optional[_Lease]]:
+        """Longest cached prefix of `tokens`, capped at len(tokens) - 1.
+        Returns (hit_len, lease) — lease is None on a miss; on a hit the
+        caller must release() it after grafting."""
+        cap = len(tokens) - 1
+        with self._lock:
+            self._clock += 1
+            self.lookup_tokens += len(tokens)
+            path: List[Tuple[_Node, int]] = []
+            node, i = self._root, 0
+            while i < cap:
+                child = node.children.get(tokens[i])
+                if child is None:
+                    break
+                e = child.edge
+                m = 0
+                lim = min(len(e), cap - i)
+                while m < lim and e[m] == tokens[i + m]:
+                    m += 1
+                if m == 0:
+                    break
+                child.last_used = self._clock
+                path.append((child, m))
+                i += m
+                if m < len(e):
+                    break  # partial edge: the divergence point
+                node = child
+            hit = i
+            if hit < self.min_tokens or not path:
+                self._telemetry()
+                return 0, None
+            for n, _ in path:
+                n.refs += 1
+            self.hit_tokens += hit
+            self._telemetry()
+        self._count("prefix_hits")
+        self._count("prefix_hit_tokens", hit)
+        return hit, _Lease(self, path)
+
+    # -- warm-tree memoization --------------------------------------------------------
+
+    def warm_small(self, template, lease: _Lease):
+        """The device-resident warm batch-1 cache for a hit: rows[0:hit]
+        in place, cursor at hit.  Memoized per (deepest node, hit): the
+        first hit of a prefix assembles it from the stored numpy rows
+        (slots.warm_small_cache — host concat + one upload), every repeat
+        hit reuses the device tree as-is.  The engine's jitted prefill
+        consumes it without donation, so sharing is safe."""
+        from .slots import warm_small_cache
+
+        node, _take = lease._path[-1]
+        hit = lease.hit
+        with self._lock:
+            memo = node.warm.get(hit)
+            if memo is not None:
+                return memo[0]
+        tree = warm_small_cache(template, lease.rows(), hit)
+        import jax
+
+        nbytes = sum(
+            leaf.size * leaf.dtype.itemsize for leaf in jax.tree.leaves(tree)
+        )
+        with self._lock:
+            raced = node.warm.get(hit)
+            if raced is not None:  # a concurrent builder won: use theirs
+                return raced[0]
+            node.warm[hit] = (tree, nbytes)
+            self.total_bytes += nbytes
+            self._evict_locked()
+        return tree
+
+    # -- insert --------------------------------------------------------------------
+
+    def insert(self, tokens: Tuple[int, ...], rows) -> None:
+        """Store the full prefix rows for `tokens` ([len(tokens), ...] per
+        leaf), deduplicating against everything already cached along the
+        path (only new suffix rows allocate).  `rows` may be a dict or a
+        zero-arg callable returning one — the callable is only invoked
+        when the insert actually creates a node, so fully-covered (cache
+        hot) admissions skip the device->host row copy entirely."""
+        n = len(tokens)
+        if n == 0 or self.budget <= 0:
+            return
+        rows_mat: Optional[Dict[tuple, np.ndarray]] = (
+            None if callable(rows) else rows)
+
+        def mat() -> Dict[tuple, np.ndarray]:
+            nonlocal rows_mat
+            if rows_mat is None:
+                rows_mat = rows()
+            return rows_mat
+
+        with self._lock:
+            self._clock += 1
+            node, i = self._root, 0
+            while i < n:
+                child = node.children.get(tokens[i])
+                if child is None:
+                    new = _Node(tuple(tokens[i:n]),
+                                {k: np.ascontiguousarray(a[i:n])
+                                 for k, a in mat().items()}, node)
+                    new.last_used = self._clock
+                    node.children[tokens[i]] = new
+                    self.total_bytes += new.nbytes
+                    break
+                e = child.edge
+                m = 0
+                lim = min(len(e), n - i)
+                while m < lim and e[m] == tokens[i + m]:
+                    m += 1
+                child.last_used = self._clock
+                if m == len(e):
+                    node, i = child, i + m
+                    continue
+                if m < len(e) and i + m < n:
+                    self._split(child, m)
+                    node, i = child, i + m
+                    continue
+                # tokens exhausted mid-edge (i + m == n): the cached edge
+                # already covers the new prefix — nothing to store
+                break
+            self._evict_locked()
+            self._telemetry()
+
+    def _split(self, node: _Node, at: int) -> None:
+        """Split `node`'s edge at `at`: node keeps the upper half, a new
+        child inherits the lower half + the children.  Refcounts stay on
+        the upper node (leases pin whole-path prefixes, and a lease's
+        rows_taken on this node is <= at only when the matcher stopped
+        mid-edge; splitting below a pinned range never moves pinned rows
+        because row identity is preserved — arrays are sliced views of the
+        same data)."""
+        lower = _Node(node.edge[at:], node.slice_rows(at, len(node.edge)),
+                      node)
+        lower.children = node.children
+        for c in lower.children.values():
+            c.parent = lower
+        lower.last_used = node.last_used
+        lower.refs = node.refs
+        node.children = {node.edge[at]: lower}
+        node.rows = node.slice_rows(0, at)
+        node.edge = node.edge[:at]
+        node.nbytes = sum(a.nbytes for a in node.rows.values())
+        # warm trees keyed on hits that now end inside `lower` would be
+        # orphaned on this node — drop them all (splits are rare)
+        self.total_bytes -= node.drop_warm()
+
+    # -- eviction ------------------------------------------------------------------
+
+    def _evict_locked(self) -> None:
+        evicted_tokens = 0
+        evicted_bytes = 0
+        while self.total_bytes > self.budget:
+            victim = None
+            stack = [self._root]
+            while stack:
+                nd = stack.pop()
+                stack.extend(nd.children.values())
+                if nd is self._root or nd.children or nd.refs > 0:
+                    continue
+                if victim is None or nd.last_used < victim.last_used:
+                    victim = nd
+            if victim is None:
+                break  # everything left is pinned or interior
+            parent = victim.parent
+            del parent.children[victim.edge[0]]
+            freed = victim.nbytes + victim.drop_warm()
+            self.total_bytes -= freed
+            evicted_bytes += freed
+            evicted_tokens += len(victim.edge)
+            self.evictions += 1
+        if evicted_tokens:
+            from ..monitor.journal import journal_event
+
+            journal_event("prefix_evicted", tokens=evicted_tokens,
+                          bytes=evicted_bytes,
+                          cache_bytes=self.total_bytes, budget=self.budget)
+            self._count("prefix_evicted")
+
+    # -- invalidation ---------------------------------------------------------------
+
+    def invalidate(self, reason: str = "weight_reload") -> None:
+        """Drop everything: cached rows are a pure function of the params,
+        so a weight reload makes every entry wrong."""
+        with self._lock:
+            dropped = self.total_bytes
+            self._root = _Node((), None, None)
+            self.total_bytes = 0
+            self._telemetry()
+        from ..monitor.journal import journal_event
+
+        journal_event("prefix_invalidated", reason=reason, bytes=dropped)
+        log.info("prefix cache invalidated (%s): %d bytes dropped",
+                 reason, dropped)
+
+    # -- stats ----------------------------------------------------------------------
+
+    def hit_rate(self) -> float:
+        return self.hit_tokens / self.lookup_tokens if self.lookup_tokens else 0.0
+
+    def stats(self) -> dict:
+        with self._lock:
+            nodes = -1  # exclude root
+            stack = [self._root]
+            while stack:
+                nd = stack.pop()
+                nodes += 1
+                stack.extend(nd.children.values())
+            return {
+                "bytes": self.total_bytes,
+                "budget": self.budget,
+                "nodes": nodes,
+                "hit_tokens": self.hit_tokens,
+                "lookup_tokens": self.lookup_tokens,
+                "hit_rate": round(self.hit_rate(), 4),
+                "evictions": self.evictions,
+            }
+
+    def _telemetry(self) -> None:
+        if self.counters is not None:
+            self.counters.set_gauge("prefix_cache_bytes",
+                                    float(self.total_bytes))
+            self.counters.set_gauge("prefix_hit_rate", self.hit_rate())
+
+    def _count(self, event: str, n: int = 1) -> None:
+        if self.counters is not None:
+            self.counters.inc_event(event, n)
+
+
+def prefix_cache_if_enabled(counters=None) -> Optional[PrefixCache]:
+    """A PrefixCache under the env budget, or None when disabled
+    (KFT_PREFIX_CACHE_MB <= 0)."""
+    budget = prefix_cache_budget_bytes()
+    if budget <= 0:
+        return None
+    return PrefixCache(budget_bytes=budget, counters=counters)

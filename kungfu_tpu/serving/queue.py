@@ -41,12 +41,15 @@ class AdmissionQueue:
             self._lock.notify()
             return True
 
-    def requeue(self, req: Request) -> None:
+    def requeue(self, req: Request, count: bool = True) -> None:
         """Push a failed-dispatch request back to the FRONT (it has already
         waited its turn once; capacity is not re-checked — a re-queue must
-        never drop).  Bumps the request's requeue count."""
+        never drop).  Bumps the request's requeue count unless
+        `count=False` (backpressure re-queues are flow control, not
+        failures — they must not pollute the failover MTTR anchors)."""
         with self._lock:
-            req.requeues += 1
+            if count:
+                req.requeues += 1
             self._q.appendleft(req)
             self._lock.notify()
 
@@ -78,6 +81,13 @@ class AdmissionQueue:
     def depth(self) -> int:
         with self._lock:
             return len(self._q)
+
+    def items(self) -> List[Request]:
+        """Snapshot of the queued requests (front first) — the tiered
+        autoscaler's composition signal (prefill-bound vs decode-bound
+        backlog); read-only, the queue itself is untouched."""
+        with self._lock:
+            return list(self._q)
 
     def snapshot(self) -> Tuple[int, int]:
         """(queued, expired-pending-rejection) sizes."""

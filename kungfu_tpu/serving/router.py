@@ -44,6 +44,7 @@ class WorkerRef:
         self.in_flight = 0
         self.healthy = False  # a worker must pass one probe before dispatch
         self.last_error = ""
+        self.tier = ""  # "" = monolithic; "prefill"/"decode" = disagg pools
 
 
 class Router:
@@ -70,13 +71,18 @@ class Router:
 
     # -- worker table (reconciled from the cluster document) -----------------------
 
-    def set_workers(self, workers) -> None:
+    def set_workers(self, workers, tiers=None) -> None:
         """Adopt the document's worker list; keeps health/in-flight state of
-        peers that survived, computes ring buddies for warm recovery."""
+        peers that survived, computes ring buddies for warm recovery.
+        `tiers` (the document's map) marks each worker's pool: on a tiered
+        fleet the router dispatches ONLY to the prefill pool — decode ranks
+        receive work as shipped KV from prefill ranks, never a dispatch."""
         with self._lock:
             new: Dict[PeerID, WorkerRef] = {}
             for p in workers:
-                new[p] = self._workers.get(p) or WorkerRef(p)
+                ref = self._workers.get(p) or WorkerRef(p)
+                ref.tier = (tiers or {}).get(str(p), "")
+                new[p] = ref
             self._workers = new
             buddies = workers.ring_buddies() if len(workers) else []
             self._buddy_of = {
@@ -156,13 +162,32 @@ class Router:
 
     def _pick_worker(self) -> Optional[WorkerRef]:
         with self._lock:
+            # dispatch targets: the prefill pool on a tiered fleet (decode
+            # ranks get work as shipped KV, not dispatches), everyone on a
+            # flat one.  A prefill worker fronts the WHOLE decode pool, so
+            # its in-flight cap is the pool's slot budget, not its own.
+            tiered = any(w.tier for w in self._workers.values())
+            decode_n = sum(1 for w in self._workers.values()
+                           if w.tier == "decode")
+            cap = self.slots_per_worker * (max(1, decode_n) if tiered else 1)
             candidates = [w for w in self._workers.values()
-                          if w.healthy and w.in_flight < self.slots_per_worker]
+                          if w.healthy and w.in_flight < cap
+                          and (not tiered or w.tier == "prefill")]
             if not candidates:
                 return None
             w = min(candidates, key=lambda w: w.in_flight)
             w.in_flight += 1
             return w
+
+    def queue_composition(self) -> dict:
+        """Backlog decomposition for the tiered autoscaler: queued prompt
+        tokens (prefill-bound work) vs owed new tokens (decode-bound)."""
+        items = self.queue.items()
+        return {
+            "depth": len(items),
+            "prefill_tokens": sum(len(r.prefill_tokens) for r in items),
+            "decode_tokens": sum(r.remaining_new_tokens for r in items),
+        }
 
     def _dispatch_loop(self) -> None:
         while not self._stop.is_set():
@@ -216,6 +241,26 @@ class Router:
                     req_id=req.req_id, tokens=tuple(req.prompt),
                     status="expired", requeues=req.requeues))
                 return
+            if e.code == 503:
+                # backpressure (a saturated decode pool on tiered fleets,
+                # a full worker queue otherwise): the worker is healthy,
+                # the request just waits its turn again — requeue without
+                # the failure bookkeeping, with a beat for the pool to
+                # drain before a dispatcher picks it back up
+                self.queue.requeue(req, count=False)
+                self._count("requests_backpressured")
+                time.sleep(0.05)
+                return
+            if e.code == 502:
+                # a prefill proxy reporting its DECODE rank died mid-stream:
+                # the proxy itself is healthy — recover warm progress from
+                # the dead decode rank's buddy and requeue
+                try:
+                    err = json.loads(e.read().decode()).get("error", "")
+                except (OSError, ValueError):
+                    err = "decode lost"
+                self._requeue_after_decode_loss(w, req, err)
+                return
             self._requeue_after_failure(w, req, f"HTTP {e.code}")
             return
         except OSError as e:
@@ -227,6 +272,34 @@ class Router:
             latency_ms=doc.get("latency_ms"),
             requeues=req.requeues,
         ))
+
+    def _requeue_after_decode_loss(self, proxy: WorkerRef, req: Request,
+                                   err: str) -> None:
+        """A tiered dispatch failed DOWNSTREAM: the decode rank died while
+        the prefill proxy waited on it.  The proxy stays healthy; warm
+        progress is recovered from the DEAD decode rank's ring buddy (it
+        was the one decoding), then requeue-front as usual."""
+        dead: Optional[PeerID] = None
+        # ship_to_decode stamps the victim url into the error message
+        for token in err.split():
+            if token.startswith("http://"):
+                try:
+                    dead = PeerID.parse(token[len("http://"):].rstrip("/"))
+                except ValueError:
+                    pass
+                break
+        resumed = False
+        if dead is not None:
+            resumed = self._recover_warm(dead, req)
+            journal_event("worker_unhealthy", peer=str(dead), error=err)
+            self._count("serve_worker_failures")
+        self.requeued += 1
+        self._count("requests_requeued")
+        journal_event("request_requeued", req_id=req.req_id,
+                      peer=str(dead) if dead is not None else "?",
+                      error=err, decode_loss=True,
+                      warm_tokens=len(req.prior_tokens) if resumed else 0)
+        self.queue.requeue(req)
 
     def _requeue_after_failure(self, w: WorkerRef, req: Request,
                                err: str) -> None:

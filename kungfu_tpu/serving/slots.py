@@ -11,9 +11,22 @@ the cache surgery:
   reset_slot   zero a released slot's cursor + overflow flag so a free row's
                ride-along decode writes restart from row 0 instead of
                marching toward max_len
+  set_cursors  write every slot's cursor at once from a host [slots] array —
+               the speculative-decoding rollback (serving/spec.py): a verify
+               step advances every cursor by k, then per-slot acceptance
+               rolls each back to its true committed length.  Rows above a
+               cursor are never attended, so the rolled-back rows go stale
+               harmlessly (the reset_slot precedent)
 
-Both compile once per cache shape (the shapes never change at runtime — that
+All compile once per cache shape (the shapes never change at runtime — that
 is the no-recompile contract of the fixed-shape slot batch).
+
+The host-side row helpers (`extract_rows` / `warm_small_cache`) move KV rows
+between the device cache layout and plain numpy: the radix prefix cache
+(serving/prefix.py) stores matched prefixes as row blocks, and the
+disaggregation ship path (serving/disagg.py, ops/kv_ship.py) moves the same
+blocks between prefill and decode ranks.  Position-indexed leaves are every
+cache leaf except the `idx`/`overflowed` cursor state.
 """
 from __future__ import annotations
 
@@ -23,8 +36,15 @@ from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .request import Request
+
+CURSOR_LEAVES = ("idx", "overflowed")
+
+
+def _leaf_name(path) -> Optional[str]:
+    return getattr(path[-1], "key", None)
 
 
 @partial(jax.jit, donate_argnums=(0,))
@@ -45,7 +65,7 @@ def reset_slot(big, slot):
     (never attended: the mask only reads rows at or below the cursor)."""
 
     def fix(path, leaf):
-        name = getattr(path[-1], "key", None)
+        name = _leaf_name(path)
         if name == "idx":
             return leaf.at[slot].set(0)
         if name == "overflowed":
@@ -53,6 +73,62 @@ def reset_slot(big, slot):
         return leaf
 
     return jax.tree_util.tree_map_with_path(fix, big)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def set_cursors(big, cursors):
+    """Write every slot's cursor from `cursors` [slots] int32 — the per-slot
+    speculative rollback.  K/V rows and overflow flags are untouched: rows
+    above a cursor are never attended (reset_slot's contract), and the
+    engine only speculates on slots with `cursor + k <= max_len`, so a
+    rollback can never need to clear an overflow."""
+
+    def fix(path, leaf):
+        if _leaf_name(path) == "idx":
+            return cursors.astype(leaf.dtype)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(fix, big)
+
+
+def extract_rows(small, n: int) -> Dict[tuple, np.ndarray]:
+    """Host-copy the first `n` KV rows of a batch-1 cache tree: every
+    position-indexed leaf (cached_k/v + int8 scales) sliced to [n, ...],
+    keyed by its flattened path.  The storage format of the radix prefix
+    cache and the cross-rank KV ship.  Whole leaves move in one batched
+    device_get and the row slice happens on the HOST: an eager device
+    slice (`leaf[0, :n]`) would compile one slice program per distinct
+    prefix length — a compile storm on mixed traffic."""
+    out = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(small)[0]:
+        if _leaf_name(path) in CURSOR_LEAVES:
+            continue
+        out[tuple(str(p) for p in path)] = leaf
+    return {k: np.ascontiguousarray(v[0, :n])
+            for k, v in jax.device_get(out).items()}
+
+
+def warm_small_cache(template, rows: Dict[tuple, np.ndarray], n: int):
+    """Build a batch-1 cache whose first `n` rows are `rows` and whose
+    cursor sits at `n` — the graft input for a prefix-cache hit (prefill
+    continues from the cached rows) or a shipped-KV admission (no prefill
+    at all).  `template` is the engine's zeroed [1, max_len, ...] tree;
+    output shapes/dtypes match it exactly, so the jitted prefill/graft
+    programs never retrace."""
+
+    def fill(path, leaf):
+        name = _leaf_name(path)
+        if name == "idx":
+            return jnp.full_like(leaf, n)
+        if name == "overflowed":
+            return jnp.zeros_like(leaf)
+        arr = np.zeros(leaf.shape, np.dtype(leaf.dtype))
+        block = rows[tuple(str(p) for p in path)]
+        assert block.shape[0] == n, (block.shape, n)
+        arr[0, :n] = block
+        return jnp.asarray(arr)
+
+    return jax.tree_util.tree_map_with_path(fill, template)
 
 
 class SlotManager:

@@ -5,8 +5,20 @@ A worker owns one ServingEngine replica and exposes it over HTTP:
   POST /generate   one Request in, blocks until its Result (the router holds
                    one connection per in-flight request, so worker-side
                    concurrency == open connections == busy slots); 503 on
-                   backpressure, 400 on a request that can never fit
-  GET  /healthz    engine stats — the router's health probe
+                   backpressure, 400 on a request that can never fit.  On a
+                   PREFILL-tier worker this runs the prefill half only and
+                   proxies the rest: finished KV ships to a decode rank
+                   (ops/kv_ship packed blob -> POST /kv_ship) and the final
+                   result comes back through GET /kv_result
+  POST /kv_ship    shipped prefill KV in (decode tier): graft-admit into a
+                   slot when one frees; acks {ok} immediately so the ship
+                   latency (`kv_ship_ms`) measures transfer + admission,
+                   not the decode.  503 on backpressure; re-ships of a
+                   known request dedupe (double-serve guard)
+  GET  /kv_result?id=R   blocks until request R's Result (the prefill
+                   worker's proxy read)
+  GET  /healthz    engine stats + tier — the router's health probe and the
+                   prefill tier's decode-pool picker signal
   GET  /weights    this replica's params as a resilience.buddy snapshot blob
                    (the sub-second rejoin path: a respawned rank pulls
                    weights from a live peer instead of re-initializing)
@@ -16,6 +28,14 @@ A worker owns one ServingEngine replica and exposes it over HTTP:
   GET  /warm?origin=R   the warm set shipped by rank R (the router reads a
                    dead rank's buddy to resume its streams mid-output)
 
+Serving v2 flags: `--tier prefill|decode` joins a disaggregated fleet (the
+supervisor reads the document's tier map); `--prefix-cache on|off|auto`
+arms the radix prefix KV cache (auto = the KFT_PREFIX_CACHE_MB budget,
+prefill + monolithic tiers only); `--spec-draft PRESET --spec-k K` arms
+speculative decoding with a draft model from the zoo presets ("same" =
+self-draft with the target's own params — the mechanics A/B used by the
+bench; decode + monolithic tiers only).
+
 Weight resolution at boot climbs a serving flavor of the recovery ladder
 (docs/serving.md): buddy (live peer fetch over HTTP, rejoins only) ->
 file (--weights-file pickle, e.g. exported from a training checkpoint) ->
@@ -23,8 +43,9 @@ seed (deterministic init).  The rung lands in the `rank_rejoined` journal
 event, the acceptance signal of the serve drill.
 
 Chaos: the decode loop calls ChaosInjector.on_serve_tokens after every
-engine iteration, so `crash_serve@tokens=N:rank=R` kills this process
-mid-stream with requests in flight.
+engine iteration — and the prefill handler after every prefill, with the
+prefilled-token counter — so `crash_serve@tokens=N:rank=R[:tier=T]` kills
+this process mid-stream with requests in flight on either tier.
 """
 from __future__ import annotations
 
@@ -118,12 +139,15 @@ class ServingWorker:
         self.args = args
         self.rank = args.launch_rank
         self.incarnation = args.incarnation
+        self.tier = getattr(args, "tier", "") or ""
         set_journal_context(rank=self.rank, identity=f"serve-{self.rank}")
         self.counters = counters_if_enabled()
         self.injector = injector_from_env()
         self.warm = WarmStore()
         self._stop = threading.Event()
         self._peer_cache: tuple = (0.0, [])  # (fetched_at, urls)
+        self._ship_pending: Dict[str, Any] = {}  # req_id -> engine _Pending
+        self._ship_lock = threading.Lock()
 
         cfg = build_config(args.preset, args.model_json)
         t0 = time.monotonic()
@@ -133,19 +157,53 @@ class ServingWorker:
         if self.incarnation > 0:
             journal_event("rank_rejoined", rank=self.rank,
                           incarnation=self.incarnation, recovery_rung=rung,
-                          restore_s=round(restore_s, 3))
+                          tier=self.tier, restore_s=round(restore_s, 3))
             if self.counters is not None:
                 self.counters.inc_event(f"serve_rejoin_{rung}")
                 self.counters.set_gauge("serve_restore_s", restore_s)
-        log.info("worker rank=%d incarnation=%d weights=%s (%.2fs)",
-                 self.rank, self.incarnation, rung, restore_s)
+        log.info("worker rank=%d incarnation=%d tier=%s weights=%s (%.2fs)",
+                 self.rank, self.incarnation, self.tier or "-", rung,
+                 restore_s)
 
         from .engine import ServingEngine
 
+        prefix = None
+        if self.tier != "decode" and getattr(args, "prefix_cache", "auto") != "off":
+            from .prefix import PrefixCache, prefix_cache_if_enabled
+
+            if args.prefix_cache == "on":
+                prefix = PrefixCache(counters=self.counters)
+            else:  # auto: the env budget decides
+                prefix = prefix_cache_if_enabled(counters=self.counters)
+        spec = None
+        draft_name = getattr(args, "spec_draft", "") or ""
+        if draft_name and self.tier != "prefill":
+            from .spec import SpecDecoder, build_draft
+
+            if draft_name == "same":
+                draft_cfg, draft_params = cfg, params
+            else:
+                draft_cfg, draft_params = build_draft(draft_name,
+                                                      seed=args.seed)
+            assert draft_cfg.vocab_size == cfg.vocab_size, (
+                "draft and target must share a vocab")
+            spec = SpecDecoder(draft_cfg, draft_params, slots=args.slots,
+                               k=args.spec_k, counters=self.counters)
         self.engine = ServingEngine(
             cfg, params, slots=args.slots,
             queue_capacity=args.queue_capacity, counters=self.counters,
+            prefix_cache=prefix, spec=spec,
         )
+        self.decode_pool = None
+        if self.tier == "prefill" and args.config_server:
+            from ..elastic.config_client import ConfigClient
+            from .disagg import DecodePool
+
+            self.decode_pool = DecodePool(
+                ConfigClient(args.config_server, retries=2,
+                             retry_deadline_s=3.0),
+                self_spec=f"{args.host}:{args.port}",
+            )
         # the blob served on /weights: packed once (params are immutable)
         from ..resilience.buddy import pack_snapshot
 
@@ -227,13 +285,21 @@ class ServingWorker:
 
     # -- loops ---------------------------------------------------------------------
 
+    def _chaos_tick(self) -> None:
+        """Feed the injector the tier-appropriate progress counter: decode
+        and monolithic workers count generated tokens, prefill workers
+        count prefilled tokens (they generate only the first token)."""
+        if self.injector is None:
+            return
+        total = (self.engine.total_prefill_tokens if self.tier == "prefill"
+                 else self.engine.total_tokens)
+        self.injector.on_serve_tokens(total, self.rank, tier=self.tier)
+
     def _engine_loop(self) -> None:
         last_ship = 0.0
         while not self._stop.is_set():
             done = self.engine.step()
-            if self.injector is not None:
-                self.injector.on_serve_tokens(self.engine.total_tokens,
-                                              self.rank)
+            self._chaos_tick()
             now = time.monotonic()
             if (self.args.config_server
                     and now - last_ship > self.args.warm_ship_s):
@@ -287,11 +353,30 @@ class ServingWorker:
                     stats = dict(outer.engine.stats())
                     stats.update(ok=True, rank=outer.rank,
                                  incarnation=outer.incarnation,
-                                 weight_rung=outer.weight_rung)
+                                 weight_rung=outer.weight_rung,
+                                 tier=outer.tier)
                     self._send(200, json.dumps(stats).encode())
                 elif path == "/weights":
                     self._send(200, outer._weights_blob,
                                "application/octet-stream")
+                elif path == "/kv_result":
+                    q = self.path.partition("?")[2]
+                    req_id = ""
+                    for part in q.split("&"):
+                        if part.startswith("id="):
+                            req_id = part[len("id="):]
+                    with outer._ship_lock:
+                        pending = outer._ship_pending.get(req_id)
+                    if pending is None:
+                        self._send(404, b'{"error": "unknown request"}')
+                        return
+                    result = pending.wait(outer.args.request_timeout_s)
+                    with outer._ship_lock:
+                        outer._ship_pending.pop(req_id, None)
+                    if result is None:
+                        self._send(504, b'{"error": "request timed out"}')
+                        return
+                    self._send(200, json.dumps(result.to_json()).encode())
                 elif path == "/warm":
                     q = self.path.partition("?")[2]
                     origin = -1
@@ -303,14 +388,80 @@ class ServingWorker:
                 else:
                     self._send(404, b'{"error": "not found"}')
 
-            def do_POST(self):
-                n = int(self.headers.get("Content-Length", "0"))
+            def _handle_kv_ship(self, blob: bytes) -> None:
+                from ..monitor.journal import journal_event
+                from ..ops.kv_ship import unpack_kv
+                from .engine import BackpressureError
+                from .request import Request
+
+                got = unpack_kv(blob)
+                if got is None:
+                    self._send(400, b'{"error": "bad kv blob"}')
+                    return
+                meta, rows = got
+                t0 = time.monotonic()
                 try:
-                    doc = json.loads(self.rfile.read(n).decode())
+                    req = Request.from_json(meta["request"])
+                    pending = outer.engine.submit_prefilled(req, meta, rows)
+                except BackpressureError as e:
+                    self._send(503, json.dumps({"error": str(e)}).encode())
+                    return
+                except (ValueError, KeyError) as e:
+                    self._send(400, json.dumps({"error": str(e)}).encode())
+                    return
+                with outer._ship_lock:
+                    outer._ship_pending[req.req_id] = pending
+                journal_event("kv_shipped", req_id=req.req_id,
+                              tokens=int(meta.get("cursor", 0)),
+                              origin_rank=int(meta.get("origin_rank", -1)),
+                              rank=outer.rank,
+                              admit_ms=round((time.monotonic() - t0) * 1e3, 3))
+                if outer.counters is not None:
+                    outer.counters.inc_event("kv_ships_received")
+                self._send(200, b'{"ok": true}')
+
+            def _handle_prefill_generate(self, doc: dict) -> None:
+                """Prefill tier: run the prefill half, ship KV to a decode
+                rank, proxy the final result back to the router."""
+                from .disagg import ship_to_decode
+                from .request import Request
+
+                try:
+                    req = Request.from_json(doc)
+                    first, rows, total, hit = outer.engine.prefill_only(req)
                 except ValueError as e:
                     self._send(400, json.dumps({"error": str(e)}).encode())
                     return
+                outer._chaos_tick()
+                urls = (outer.decode_pool.pick()
+                        if outer.decode_pool is not None else [])
+                if not urls:
+                    self._send(503, b'{"error": "no decode workers"}')
+                    return
+                result, err = ship_to_decode(
+                    urls, req, first, rows, total, outer.rank,
+                    result_timeout_s=outer.args.request_timeout_s,
+                    counters=outer.counters,
+                )
+                if result is None:
+                    # a dead decode rank reads as a failed dispatch at the
+                    # router (502 -> requeue-front, warm resume included)
+                    self._send(502, json.dumps({"error": err}).encode())
+                    return
+                self._send(200, json.dumps(result).encode())
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", "0"))
+                body = self.rfile.read(n)
                 path = self.path.rstrip("/")
+                if path == "/kv_ship":
+                    self._handle_kv_ship(body)
+                    return
+                try:
+                    doc = json.loads(body.decode())
+                except ValueError as e:
+                    self._send(400, json.dumps({"error": str(e)}).encode())
+                    return
                 if path == "/warm":
                     outer.warm.put(int(doc.get("origin", -1)),
                                    doc.get("items", []))
@@ -318,6 +469,9 @@ class ServingWorker:
                     return
                 if path != "/generate":
                     self._send(404, b'{"error": "not found"}')
+                    return
+                if outer.tier == "prefill":
+                    self._handle_prefill_generate(doc)
                     return
                 from .engine import BackpressureError
                 from .request import Request
@@ -342,7 +496,8 @@ class ServingWorker:
         loop.start()
         print(f"SERVE_WORKER_READY: rank={self.rank} "
               f"url=http://{self.args.host}:{self.args.port} "
-              f"rung={self.weight_rung}", flush=True)
+              f"rung={self.weight_rung}"
+              + (f" tier={self.tier}" if self.tier else ""), flush=True)
         try:
             httpd.serve_forever()
         except KeyboardInterrupt:
@@ -366,6 +521,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--preset", default="tiny", choices=sorted(PRESETS))
     ap.add_argument("--model-json", default="",
                     help="TransformerConfig field overrides as JSON")
+    ap.add_argument("--tier", default="", choices=("", "prefill", "decode"),
+                    help="disaggregated pool membership (empty: monolithic "
+                         "prefill+decode engine)")
+    ap.add_argument("--prefix-cache", default="auto",
+                    choices=("auto", "on", "off"),
+                    help="radix prefix KV cache (auto: the "
+                         "KFT_PREFIX_CACHE_MB budget decides; decode-tier "
+                         "workers never prefill, so never cache)")
+    ap.add_argument("--spec-draft", default="",
+                    help="speculative decoding draft: a PRESETS name, or "
+                         "'same' for self-draft (the target's own params — "
+                         "the mechanics A/B); empty disables speculation")
+    ap.add_argument("--spec-k", type=int, default=4,
+                    help="verify width: the [slots, k] target step commits "
+                         "up to k tokens per round")
     ap.add_argument("--slots", type=int, default=4)
     ap.add_argument("--queue-capacity", type=int, default=64)
     ap.add_argument("--seed", type=int, default=0)

@@ -111,6 +111,19 @@ echo "== serving smoke: rank kill + buddy rejoin + autoscale drill (CPU) =="
 # scale-up both commit through the config server (docs/serving.md)
 JAX_PLATFORMS=cpu python -m kungfu_tpu.chaos --serve-drill --timeout 300
 
+echo "== serving v2: prefill-tier rank kill drill (CPU, disaggregated) =="
+# the disaggregated fleet (1 prefill + 2 decode) survives a prefill-rank
+# crash mid-burst: the router's dispatch dies and re-queues (zero drops,
+# p99 bounded), the victim respawns and journals a tier-stamped
+# rank_rejoined (docs/serving.md "Disaggregated pools")
+JAX_PLATFORMS=cpu python -m kungfu_tpu.chaos --serve-drill --tier prefill --timeout 300
+
+echo "== serving v2: decode-tier rank kill drill (CPU, disaggregated) =="
+# same fleet, decode-rank crash mid-stream: the prefill proxy's 502
+# surfaces as a failed dispatch, warm progress recovers from the DEAD
+# decode rank's ring buddy, every request completes
+JAX_PLATFORMS=cpu python -m kungfu_tpu.chaos --serve-drill --tier decode --timeout 300
+
 echo "== straggler drill: slow rank fingered, not killed (CPU) =="
 # a slow@-injected rank (per-step sleep > heartbeat timeout) must be
 # flagged by the fleet /stragglers detector (journal straggler_suspected

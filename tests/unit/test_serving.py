@@ -1,15 +1,22 @@
 """Elastic inference serving subsystem (kungfu_tpu/serving/).
 
 Fast tier: admission-queue semantics (FIFO, deadlines, backpressure,
-re-queue-to-front), slot ledger, continuous-batching engine parity against
-the full-sequence forward (greedy tokens identical under interleaved
-admissions and slot reuse), warm-resume determinism, int8 KV serving, the
-crash_serve chaos grammar, the config server's /health endpoint, and the
-queue-depth autoscaler against a real config server.  Slow tier (`faults`
-+ `slow`): the multi-process CPU drill — a serving rank killed mid-stream,
-zero dropped requests, buddy-weight rejoin, scale-down/up commits.
+re-queue-to-front, the requeue-vs-expiry race), slot ledger,
+continuous-batching engine parity against the full-sequence forward
+(greedy tokens identical under interleaved admissions and slot reuse),
+warm-resume determinism, int8 KV serving, the serving-v2 multipliers —
+radix prefix cache (parity, radix semantics, LRU eviction, weight-reload
+invalidation), speculative decoding (bit-exact parity, ONE extra compiled
+signature, acceptance collapse), disaggregation (KV ship round trip,
+prefill_only/submit_prefilled parity, tiered documents, the tiered
+autoscaler) — the crash_serve chaos grammar incl. tier targeting, the
+config server's /health endpoint, and the queue-depth autoscaler against a
+real config server.  Slow tier (`faults` + `slow`): the multi-process CPU
+drills — a serving rank killed mid-stream (monolithic and per-tier), zero
+dropped requests, buddy-weight rejoin, scale-down/up commits.
 """
 import dataclasses
+import threading
 import time
 
 import numpy as np
@@ -23,9 +30,11 @@ from kungfu_tpu.models.transformer import TransformerConfig, TransformerLM, gene
 from kungfu_tpu.serving import (
     AdmissionQueue,
     BackpressureError,
+    PrefixCache,
     Request,
     ServingEngine,
     SlotManager,
+    SpecDecoder,
     default_buckets,
 )
 
@@ -89,6 +98,78 @@ class TestAdmissionQueue:
         swept = q.drain_expired()
         assert swept == [dead]
         assert q.drain_expired() == []
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_requeue_racing_expiry_never_reorders_or_double_serves(self, seed):
+        """Property test: requeue-to-front threads racing concurrent
+        poppers (whose pops sweep the deadline-expired aside) must never
+        (a) hand the same request to two dispatchers, (b) lose a live
+        request — everything either serves or comes back as an explicit
+        expiry — or (c) wedge a re-queued victim (every victim re-serves
+        and its requeue count bumps exactly once)."""
+        rng = np.random.default_rng(seed)
+        q = AdmissionQueue(capacity=512)
+        n = 60
+        reqs = [Request(prompt=(i + 1,), max_new_tokens=1,
+                        deadline_s=(0.02 if rng.random() < 0.3 else 0.0))
+                for i in range(n)]
+        victims = [r for r in reqs if rng.random() < 0.25
+                   and not r.deadline_s]
+        for r in reqs:
+            assert q.put(r)
+        served = []
+        expired_seen = []
+        served_lock = threading.Lock()
+        stop = threading.Event()
+
+        def popper():
+            while not stop.is_set() or q.depth():
+                r = q.pop(timeout_s=0.01)
+                swept = q.drain_expired()
+                with served_lock:
+                    expired_seen.extend(swept)
+                if r is not None:
+                    with served_lock:
+                        served.append(r)
+                    time.sleep(rng.random() * 0.003)
+
+        def requeuer():
+            for v in victims:
+                # a victim re-queues only once it was popped (a dispatch
+                # failed) — mirror that: wait until it shows up served,
+                # then push it back to the front exactly once
+                while not stop.is_set():
+                    with served_lock:
+                        if v in served:
+                            served.remove(v)
+                            break
+                    time.sleep(0.001)
+                q.requeue(v)
+
+        threads = [threading.Thread(target=popper) for _ in range(3)]
+        rt = threading.Thread(target=requeuer)
+        for t in threads:
+            t.start()
+        rt.start()
+        rt.join(timeout=20)
+        time.sleep(0.05)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert not rt.is_alive(), "requeuer wedged: a victim never re-served"
+        # (a) no double-serves (a swept expiry is a rejection, not a serve)
+        ids = [r.req_id for r in served]
+        assert len(ids) == len(set(ids)), "a request was served twice"
+        assert not (set(ids) & {r.req_id for r in expired_seen})
+        # (b) nothing lost: every request either served or swept expired
+        swept = {r.req_id for r in expired_seen} | {
+            r.req_id for r in q.drain_expired()}
+        all_out = set(ids) | swept
+        for v in victims:  # requeued victims were removed from `served`
+            all_out.add(v.req_id)
+        assert all_out == {r.req_id for r in reqs}, "a request vanished"
+        # requeue bookkeeping: every victim's requeue count bumped once
+        assert all(v.requeues == 1 for v in victims)
 
 
 class TestSlotManager:
@@ -217,6 +298,501 @@ class TestEngine:
     def test_default_buckets_cover_max_len(self):
         assert default_buckets(96) == (16, 32, 64, 96)
         assert default_buckets(16) == (16,)
+
+
+# -- radix prefix cache ----------------------------------------------------------------
+
+
+class TestPrefixCache:
+    def _rows(self, tokens):
+        """Synthetic rows keyed like extract_rows: one leaf whose row i is
+        filled with token i (row identity is checkable by value)."""
+        return {("k",): np.asarray(tokens, np.float32)[:, None]
+                * np.ones((1, 4), np.float32)}
+
+    def test_radix_match_insert_split_semantics(self):
+        pc = PrefixCache(budget_bytes=1 << 20)
+        a = (1, 2, 3, 4, 5)
+        pc.insert(a, self._rows(a))
+        # exact-prefix hit capped at len - 1
+        hit, lease = pc.match((1, 2, 3, 4, 5))
+        assert hit == 4
+        np.testing.assert_array_equal(
+            lease.rows()[("k",)][:, 0], [1, 2, 3, 4])
+        lease.release()
+        # divergence mid-edge: shared prefix only
+        b = (1, 2, 9, 9)
+        hit, lease = pc.match(b)
+        assert hit == 2
+        lease.release()
+        pc.insert(b, self._rows(b))  # splits at 2
+        hit, lease = pc.match((1, 2, 9, 9, 7))
+        assert hit == 4
+        np.testing.assert_array_equal(
+            lease.rows()[("k",)][:, 0], [1, 2, 9, 9])
+        lease.release()
+        # the original path still matches after the split
+        hit, lease = pc.match((1, 2, 3, 4, 5, 6))
+        assert hit == 5
+        lease.release()
+        # miss: nothing shared
+        hit, lease = pc.match((8, 8))
+        assert hit == 0 and lease is None
+        # dedup: re-inserting a covered prefix allocates nothing
+        before = pc.total_bytes
+        called = []
+        pc.insert(a, lambda: called.append(1) or self._rows(a))
+        assert pc.total_bytes == before and not called
+
+    def test_lru_eviction_under_budget_journaled(self, tmp_path,
+                                                 monkeypatch):
+        from kungfu_tpu.monitor import journal as J
+
+        path = str(tmp_path / "j.jsonl")
+        monkeypatch.setenv(J.JOURNAL_FILE_ENV, path)
+        J._reset_for_tests()
+        try:
+            row_bytes = 4 * 4  # one row = [1, 4] f32
+            pc = PrefixCache(budget_bytes=8 * row_bytes)
+            pc.insert((1, 2, 3, 4), self._rows((1, 2, 3, 4)))
+            hit, lease = pc.match((1, 2, 3))  # touch the old entry
+            if lease:
+                lease.release()
+            pc.insert((9, 8, 7, 6, 5, 4), self._rows((9, 8, 7, 6, 5, 4)))
+            assert pc.total_bytes <= pc.budget
+            assert pc.evictions >= 1
+            kinds = {e["event"] for e in J.read_journal(path)}
+            assert "prefix_evicted" in kinds
+        finally:
+            J._reset_for_tests()
+
+    def test_refcounted_lease_blocks_eviction(self):
+        row_bytes = 16
+        pc = PrefixCache(budget_bytes=4 * row_bytes)
+        pc.insert((1, 2, 3, 4), self._rows((1, 2, 3, 4)))
+        hit, lease = pc.match((1, 2, 3, 4, 9))
+        assert hit == 4
+        # over-budget insert while the path is pinned: the pinned node
+        # must survive
+        pc.insert((5, 6, 7, 8), self._rows((5, 6, 7, 8)))
+        hit2, lease2 = pc.match((1, 2, 3, 4, 9))
+        assert hit2 == 4  # still there
+        if lease2:
+            lease2.release()
+        lease.release()
+
+    def test_engine_parity_with_shared_prefixes(self, model_and_params):
+        """Prefix-grafted output == generate() bit-exact over interleaved
+        admissions + slot reuse, with real hits."""
+        cfg, _, params = model_and_params
+        pc = PrefixCache(budget_bytes=64 << 20)
+        eng = ServingEngine(cfg, params, slots=2, prefill_buckets=(8, 16),
+                            prefix_cache=pc)
+        rs = np.random.RandomState(3)
+        shared = tuple(rs.randint(1, 64, (6,)))
+        prompts = [shared + tuple(rs.randint(1, 64, (n,)))
+                   for n in (3, 5, 2, 4)]
+        prompts.append(shared + prompts[1][6:])  # exact duplicate tail
+        pend = [eng.submit(Request(prompt=p, max_new_tokens=6))
+                for p in prompts]
+        eng.run_until_idle()
+        for p, pd in zip(prompts, pend):
+            ref = np.asarray(generate(cfg, params, jnp.asarray(p)[None],
+                                      6))[0]
+            np.testing.assert_array_equal(np.asarray(pd.result.tokens), ref)
+        assert pc.hit_tokens > 0
+        assert 0.0 < pc.hit_rate() < 1.0
+        assert eng.stats()["prefix"]["nodes"] >= 2
+
+    def test_int8_cache_rows_graft(self, model_and_params):
+        """The radix cache stores and grafts quantized rows + scales when
+        the engine serves an int8 KV cache."""
+        cfg, _, params = model_and_params
+        icfg = dataclasses.replace(cfg, kv_cache_dtype="int8")
+        pc = PrefixCache(budget_bytes=64 << 20)
+        eng = ServingEngine(icfg, params, slots=1, prefill_buckets=(8,),
+                            prefix_cache=pc)
+        p1 = (7, 3, 5, 2)
+        r1 = eng.submit(Request(prompt=p1, max_new_tokens=4))
+        eng.run_until_idle()
+        r2 = eng.submit(Request(prompt=p1, max_new_tokens=4))
+        eng.run_until_idle()
+        assert list(r1.result.tokens) == list(r2.result.tokens)
+        assert pc.hit_tokens >= 3
+
+    def test_invalidated_on_weight_reload(self, model_and_params):
+        cfg, _, params = model_and_params
+        pc = PrefixCache(budget_bytes=64 << 20)
+        eng = ServingEngine(cfg, params, slots=1, prefill_buckets=(8,),
+                            prefix_cache=pc)
+        eng.submit(Request(prompt=(1, 2, 3, 4), max_new_tokens=2))
+        eng.run_until_idle()
+        assert pc.total_bytes > 0
+        params2 = jax.tree.map(lambda x: x * 1.01, params)
+        eng.set_params(params2)
+        assert pc.total_bytes == 0 and eng.params_version == 1
+        # post-reload output matches fresh generate with the new weights
+        pd = eng.submit(Request(prompt=(1, 2, 3, 4), max_new_tokens=4))
+        eng.run_until_idle()
+        ref = np.asarray(generate(cfg, params2,
+                                  jnp.asarray((1, 2, 3, 4))[None], 4))[0]
+        np.testing.assert_array_equal(np.asarray(pd.result.tokens), ref)
+
+    def test_counters_telemetry(self, model_and_params):
+        from kungfu_tpu.monitor.counters import Counters
+
+        cfg, _, params = model_and_params
+        c = Counters()
+        pc = PrefixCache(budget_bytes=64 << 20, counters=c)
+        eng = ServingEngine(cfg, params, slots=1, prefill_buckets=(8,),
+                            prefix_cache=pc, counters=c)
+        eng.submit(Request(prompt=(5, 6, 7, 8), max_new_tokens=2))
+        eng.run_until_idle()
+        eng.submit(Request(prompt=(5, 6, 7, 8), max_new_tokens=2))
+        eng.run_until_idle()
+        assert c.events().get("prefix_hit_tokens", 0) >= 3
+        g = c.gauges()
+        assert g.get("prefix_hit_rate", 0) > 0
+        assert g.get("prefix_cache_bytes", 0) > 0
+
+
+# -- speculative decoding --------------------------------------------------------------
+
+
+class TestSpeculative:
+    def test_parity_self_draft(self, model_and_params):
+        """Spec output == generate() bit-exact over interleaved admissions
+        and slot reuse; acceptance engaged (self-draft ~= 1.0)."""
+        cfg, _, params = model_and_params
+        spec = SpecDecoder(cfg, params, slots=2, k=4,
+                           prefill_buckets=(8, 16))
+        eng = ServingEngine(cfg, params, slots=2, prefill_buckets=(8, 16),
+                            spec=spec)
+        rs = np.random.RandomState(1)
+        prompts = [tuple(rs.randint(1, 64, (n,))) for n in (4, 7, 3, 6, 5)]
+        pend = [eng.submit(Request(prompt=p, max_new_tokens=7))
+                for p in prompts]
+        eng.run_until_idle()
+        for p, pd in zip(prompts, pend):
+            ref = np.asarray(generate(cfg, params, jnp.asarray(p)[None],
+                                      7))[0]
+            np.testing.assert_array_equal(np.asarray(pd.result.tokens), ref)
+        assert spec.rounds > 0
+        assert spec.accept_rate() > 0.5  # self-draft: near-total acceptance
+
+    def test_parity_truncated_draft(self, model_and_params):
+        """A genuinely different (1-layer truncated) draft: lower
+        acceptance, IDENTICAL tokens — acceptance is self-validating."""
+        cfg, _, params = model_and_params
+        dcfg = dataclasses.replace(cfg, n_layers=1)
+        dparams = {k: v for k, v in params.items()
+                   if not k.startswith("block_") or k == "block_0"}
+        spec = SpecDecoder(dcfg, dparams, slots=2, k=4,
+                           prefill_buckets=(8, 16))
+        eng = ServingEngine(cfg, params, slots=2, prefill_buckets=(8, 16),
+                            spec=spec)
+        rs = np.random.RandomState(2)
+        prompts = [tuple(rs.randint(1, 64, (n,))) for n in (5, 3, 6)]
+        pend = [eng.submit(Request(prompt=p, max_new_tokens=8))
+                for p in prompts]
+        eng.run_until_idle()
+        for p, pd in zip(prompts, pend):
+            ref = np.asarray(generate(cfg, params, jnp.asarray(p)[None],
+                                      8))[0]
+            np.testing.assert_array_equal(np.asarray(pd.result.tokens), ref)
+        assert spec.rounds > 0
+
+    def test_one_extra_compiled_signature_across_mixes(self,
+                                                       model_and_params):
+        """Across wildly different request mixes the verify program stays
+        ONE compiled signature and the plain decode program never joins in
+        while speculation is healthy."""
+        cfg, _, params = model_and_params
+        spec = SpecDecoder(cfg, params, slots=3, k=4,
+                           prefill_buckets=(8, 16))
+        eng = ServingEngine(cfg, params, slots=3, prefill_buckets=(8, 16),
+                            spec=spec)
+        rs = np.random.RandomState(4)
+        for batch in ((3, 9), (1,), (6, 2, 8, 4)):
+            pend = [eng.submit(Request(
+                prompt=tuple(rs.randint(1, 64, (n,))),
+                max_new_tokens=int(rs.randint(2, 9))))
+                for n in batch]
+            eng.run_until_idle()
+            assert all(p.result.status == "ok" for p in pend)
+        assert eng._verify._cache_size() == 1
+        assert eng._decode._cache_size() == 0  # spec stayed engaged
+
+    def test_acceptance_collapse_disables_and_falls_back(
+            self, model_and_params, tmp_path, monkeypatch):
+        """A useless draft (params from a different seed) collapses
+        acceptance: slots journal spec_disabled, the engine drops to the
+        plain program, output stays bit-exact."""
+        from kungfu_tpu.monitor import journal as J
+
+        path = str(tmp_path / "j.jsonl")
+        monkeypatch.setenv(J.JOURNAL_FILE_ENV, path)
+        J._reset_for_tests()
+        try:
+            cfg, model, params = model_and_params
+            bad = nn.meta.unbox(model.init(jax.random.PRNGKey(9),
+                                           jnp.zeros((1, 4), jnp.int32))
+                                )["params"]
+            spec = SpecDecoder(cfg, bad, slots=1, k=4, prefill_buckets=(8,),
+                               disable_after=2, disable_below=0.3)
+            eng = ServingEngine(cfg, params, slots=1, prefill_buckets=(8,),
+                                spec=spec)
+            pd = eng.submit(Request(prompt=(2, 4, 6), max_new_tokens=16))
+            eng.run_until_idle()
+            ref = np.asarray(generate(cfg, params,
+                                      jnp.asarray((2, 4, 6))[None], 16))[0]
+            np.testing.assert_array_equal(np.asarray(pd.result.tokens), ref)
+            assert spec._disabled.any()
+            assert eng._decode._cache_size() == 1  # plain fallback engaged
+            events = J.read_journal(path)
+            assert any(e["event"] == "spec_disabled" for e in events)
+        finally:
+            J._reset_for_tests()
+
+    def test_temperature_request_forces_plain_path(self, model_and_params):
+        """Sampling requests can't speculate (acceptance is an argmax
+        identity): a mixed batch runs plain and still completes."""
+        cfg, _, params = model_and_params
+        spec = SpecDecoder(cfg, params, slots=2, k=4, prefill_buckets=(8,))
+        eng = ServingEngine(cfg, params, slots=2, prefill_buckets=(8,),
+                            spec=spec)
+        hot = eng.submit(Request(prompt=(1, 2, 3), max_new_tokens=5,
+                                 temperature=0.8))
+        cold = eng.submit(Request(prompt=(4, 5, 6), max_new_tokens=5))
+        eng.run_until_idle()
+        assert hot.result.status == "ok" and cold.result.status == "ok"
+        ref = np.asarray(generate(cfg, params,
+                                  jnp.asarray((4, 5, 6))[None], 5))[0]
+        np.testing.assert_array_equal(np.asarray(cold.result.tokens), ref)
+        assert spec.rounds == 0  # never speculated under sampling
+
+    def test_eos_mid_accepted_run(self, model_and_params):
+        """An eos landing inside an accepted run stops the stream exactly
+        there — same tokens as the plain engine with the same eos."""
+        cfg, _, params = model_and_params
+        prompt = (3, 1, 4)
+        ref_eng = ServingEngine(cfg, params, slots=1, prefill_buckets=(8,))
+        full = ref_eng.submit(Request(prompt=prompt, max_new_tokens=12))
+        ref_eng.run_until_idle()
+        toks = list(full.result.tokens)
+        eos = toks[len(prompt) + 4]  # force a stop mid-stream
+        ref2 = ServingEngine(cfg, params, slots=1, prefill_buckets=(8,))
+        want = ref2.submit(Request(prompt=prompt, max_new_tokens=12,
+                                   eos_id=int(eos)))
+        ref2.run_until_idle()
+        spec = SpecDecoder(cfg, params, slots=1, k=4, prefill_buckets=(8,))
+        eng = ServingEngine(cfg, params, slots=1, prefill_buckets=(8,),
+                            spec=spec)
+        got = eng.submit(Request(prompt=prompt, max_new_tokens=12,
+                                 eos_id=int(eos)))
+        eng.run_until_idle()
+        assert list(got.result.tokens) == list(want.result.tokens)
+
+    def test_spec_telemetry(self, model_and_params):
+        from kungfu_tpu.monitor.counters import Counters
+
+        cfg, _, params = model_and_params
+        c = Counters()
+        spec = SpecDecoder(cfg, params, slots=1, k=4, prefill_buckets=(8,),
+                           counters=c)
+        eng = ServingEngine(cfg, params, slots=1, prefill_buckets=(8,),
+                            spec=spec, counters=c)
+        eng.submit(Request(prompt=(1, 2, 3), max_new_tokens=8))
+        eng.run_until_idle()
+        assert c.events().get("spec_rounds", 0) >= 1
+        assert c.hist_summaries()["spec_accept_rate"][""]["count"] >= 1
+        assert "spec" in eng.stats()
+
+
+# -- disaggregation --------------------------------------------------------------------
+
+
+class TestDisagg:
+    def test_pack_unpack_round_trip_and_torn_blob(self):
+        from kungfu_tpu.ops.kv_ship import pack_kv, unpack_kv
+
+        rows = {("block_0", "attn", "cached_k"):
+                np.arange(24, dtype=np.float32).reshape(3, 2, 4)}
+        meta = {"cursor": 3, "first_token": 7, "request": {"id": "r1"}}
+        blob = pack_kv(meta, rows)
+        got = unpack_kv(blob)
+        assert got is not None
+        m2, r2 = got
+        assert m2["cursor"] == 3 and m2["first_token"] == 7
+        np.testing.assert_array_equal(
+            r2[("block_0", "attn", "cached_k")],
+            rows[("block_0", "attn", "cached_k")])
+        assert unpack_kv(blob[:10]) is None
+        assert unpack_kv(b"garbage") is None
+
+    def test_prefill_only_ship_parity(self, model_and_params):
+        """prefill_only on one engine + submit_prefilled on another ==
+        generate(), incl. the prior-token warm path and int8 rows."""
+        cfg, _, params = model_and_params
+        from kungfu_tpu.ops.kv_ship import pack_kv, unpack_kv
+
+        for kv_dtype in ("model", "int8"):
+            c = dataclasses.replace(cfg, kv_cache_dtype=kv_dtype)
+            pre = ServingEngine(c, params, slots=1, prefill_buckets=(8, 16))
+            dec = ServingEngine(c, params, slots=2, prefill_buckets=(8, 16))
+            rs = np.random.RandomState(5)
+            for n in (4, 7, 3):
+                p = tuple(rs.randint(1, 64, (n,)))
+                req = Request(prompt=p, max_new_tokens=6)
+                first, rows, total, hit = pre.prefill_only(req)
+                blob = pack_kv({"cursor": total, "first_token": first,
+                                "request": req.to_json()}, rows)
+                meta, rows2 = unpack_kv(blob)
+                pd = dec.submit_prefilled(Request.from_json(meta["request"]),
+                                          meta, rows2)
+                dec.run_until_idle()
+                if kv_dtype == "model":
+                    ref = np.asarray(generate(cfg, params,
+                                              jnp.asarray(p)[None], 6))[0]
+                    np.testing.assert_array_equal(
+                        np.asarray(pd.result.tokens), ref)
+                else:
+                    assert pd.result.status == "ok"
+
+    def test_double_ship_dedupes(self, model_and_params):
+        cfg, _, params = model_and_params
+        pre = ServingEngine(cfg, params, slots=1, prefill_buckets=(8,))
+        dec = ServingEngine(cfg, params, slots=1, prefill_buckets=(8,))
+        req = Request(prompt=(1, 2, 3), max_new_tokens=4)
+        first, rows, total, _ = pre.prefill_only(req)
+        meta = {"cursor": total, "first_token": first}
+        p1 = dec.submit_prefilled(req, meta, rows)
+        p2 = dec.submit_prefilled(req, meta, rows)  # the re-ship
+        assert p1 is p2
+        dec.run_until_idle()
+        assert p1.result.status == "ok"
+        assert dec.total_completed == 1  # served exactly once
+
+    def test_cluster_tiers_document(self):
+        from kungfu_tpu.plan import Cluster, HostList
+
+        c = Cluster.from_hostlist(HostList.parse("127.0.0.1:4"), 3)
+        assert c.tiers is None and c.tier_of(c.workers[0]) == ""
+        # untier'd documents keep their exact serialized bytes
+        assert "tiers" not in c.to_json()
+        t = c.assign_tiers(1)
+        assert t.tier_of(t.workers[0]) == "prefill"
+        assert t.tier_of(t.workers[1]) == "decode"
+        assert t.tier_counts() == {"prefill": 1, "decode": 2}
+        rt = Cluster.from_json(t.to_json())
+        assert rt.tiers == t.tiers
+        # resize preserves retained tiers, defaults grown workers to decode
+        grown = t.resize(4)
+        assert grown.tier_of(grown.workers[3]) == "decode"
+        shrunk = t.resize(2)
+        assert set(shrunk.tiers) == {str(w) for w in shrunk.workers}
+        # validation: tier entries must name workers
+        bad = Cluster(runners=c.runners, workers=c.workers,
+                      tiers={"1.2.3.4:1": "prefill"})
+        with pytest.raises(ValueError):
+            bad.validate()
+        with pytest.raises(ValueError):
+            c.assign_tiers(3)  # would leave the decode pool empty
+
+    def test_ship_kv_rows_rotation(self):
+        """The in-mesh ship path: every leaf lands on the rank offset
+        ahead (the ppermute lowering off-TPU, bit-identical contract)."""
+        from jax.sharding import PartitionSpec as P
+
+        from kungfu_tpu.compat import shard_map
+        from kungfu_tpu.ops.kv_ship import ship_kv_rows
+
+        if len(jax.devices()) < 2:
+            pytest.skip("needs 2 devices")
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array(jax.devices()[:2]), ("dp",))
+        x = jnp.arange(2 * 3 * 4, dtype=jnp.float32).reshape(2, 3, 4)
+
+        def body(rows):
+            return ship_kv_rows({"k": jnp.squeeze(rows, 0)}, "dp", 1)["k"][None]
+
+        out = shard_map(body, mesh, in_specs=P("dp"), out_specs=P("dp"),
+                        check_vma=False)(x)
+        np.testing.assert_array_equal(np.asarray(out)[0], np.asarray(x)[1])
+        np.testing.assert_array_equal(np.asarray(out)[1], np.asarray(x)[0])
+
+    def test_tiered_autoscaler_grows_the_right_pool(self):
+        from kungfu_tpu.elastic.config_client import ConfigClient
+        from kungfu_tpu.elastic.config_server import ConfigServer
+        from kungfu_tpu.plan import Cluster, HostList
+        from kungfu_tpu.serving.disagg import TieredAutoscaler
+
+        cluster = Cluster.from_hostlist(
+            HostList.parse("127.0.0.1:6"), 3).assign_tiers(1)
+        srv = ConfigServer(host="127.0.0.1", port=0, init=cluster).start()
+        try:
+            class _R:
+                completed = 0
+
+                def __init__(self, comp):
+                    self._comp = comp
+
+                def queue_composition(self):
+                    return self._comp
+
+                def active_requests(self):
+                    return 0
+
+            # prefill-bound backlog: queued prompt tokens dominate
+            client = ConfigClient(srv.url)
+            r = _R({"depth": 8, "prefill_tokens": 4000, "decode_tokens": 10})
+            s = TieredAutoscaler(client, r, max_size=6, up_after=1)
+            s._tick()
+            got, _ = client.poll_cluster()
+            assert got.tier_counts() == {"prefill": 2, "decode": 2}
+            # decode-bound backlog grows the decode pool
+            r2 = _R({"depth": 8, "prefill_tokens": 10,
+                     "decode_tokens": 4000})
+            s2 = TieredAutoscaler(client, r2, max_size=6, up_after=1)
+            s2._tick()
+            got, _ = client.poll_cluster()
+            assert got.tier_counts() == {"prefill": 2, "decode": 3}
+            # sustained idle shrinks (never below 1 per pool)
+            r3 = _R({"depth": 0, "prefill_tokens": 0, "decode_tokens": 0})
+            r3.completed = 5
+            s3 = TieredAutoscaler(client, r3, max_size=6, down_after=1)
+            for _ in range(4):
+                s3._tick()
+            got, _ = client.poll_cluster()
+            counts = got.tier_counts()
+            assert counts["prefill"] >= 1 and counts["decode"] >= 1
+            assert sum(counts.values()) < 5
+            kinds = [e["kind"] for e in s.events + s2.events + s3.events]
+            assert "scale_up" in kinds and "scale_down" in kinds
+            assert all("tier" in e for e in s.events + s2.events + s3.events)
+        finally:
+            srv.stop()
+
+    def test_crash_serve_tier_grammar(self):
+        from kungfu_tpu.chaos.inject import ChaosInjector
+        from kungfu_tpu.chaos.plan import parse_fault_plan
+
+        plan = parse_fault_plan("crash_serve@tokens=8:tier=prefill:rank=-1")
+        (f,) = plan.serve_faults()
+        assert (f.tokens, f.tier, f.rank) == (8, "prefill", -1)
+        with pytest.raises(ValueError):  # tier must be a real pool
+            parse_fault_plan("crash_serve@tokens=8:tier=bogus:rank=0")
+        with pytest.raises(ValueError):  # rank=-1 needs a tier filter
+            parse_fault_plan("crash_serve@tokens=8:rank=-1")
+        exits = []
+        inj = ChaosInjector(plan, exit_fn=exits.append)
+        inj.on_serve_tokens(9, rank=0, tier="decode")  # wrong tier
+        assert exits == []
+        inj.on_serve_tokens(9, rank=3, tier="prefill")  # any rank, right tier
+        assert exits == [45]
+        inj.on_serve_tokens(20, rank=3, tier="prefill")  # one-shot
+        assert exits == [45]
 
 
 # -- chaos grammar ---------------------------------------------------------------------
@@ -426,3 +1002,17 @@ class TestServeDrill:
         assert counts.get("request_requeued", 0) >= 1
         assert counts.get("scale_down", 0) >= 1
         assert counts.get("scale_up", 0) >= 1
+
+    @pytest.mark.parametrize("tier", ["prefill", "decode"])
+    def test_tier_rank_kill_zero_drops(self, tier):
+        """The disaggregated failover contract per pool: a prefill-rank or
+        decode-rank crash mid-burst heals with zero dropped requests,
+        bounded p99, and a tier-stamped rank_rejoined."""
+        from kungfu_tpu.serving.drill import run_serve_drill
+
+        summary = run_serve_drill(np=3, timeout_s=300.0, tier=tier)
+        assert summary["ok"], summary["failures"]
+        assert summary["completed"] == summary["requests"]
+        counts = summary["journal_event_counts"]
+        assert counts.get("request_requeued", 0) >= 1
+        assert counts.get("rank_rejoined", 0) >= 1
