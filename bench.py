@@ -766,6 +766,16 @@ def _measure_serving():
         section["drill_p99_s"] = on.get("latency_p99_s")
         section["requeued_requests"] = on.get("requeued_requests")
         section["warm_resumes"] = on.get("warm_resumes")
+        # distributed-request tracing (docs/observability.md): per-phase
+        # p50/p99 latency fractions + the dominant p99 phase, assembled by
+        # the fleet /requests endpoint during the drill; stamped honest —
+        # measured only when the assembler actually saw this run's traces
+        att = on.get("request_attribution")
+        if att:
+            section["request_attribution"] = dict(att,
+                                                  measured_this_run=True)
+        else:
+            section["request_attribution"] = {"measured_this_run": False}
     off = one_drill("off")
     if off:
         section["failover_requeue_nobuddy_s"] = off.get("failover_requeue_s")

@@ -509,6 +509,15 @@ def main(argv=None) -> int:
     ap.add_argument("--no-autoscale-drill", action="store_true",
                     help="serve drill: skip the autoscale phase (failover "
                          "only — the bench A/B uses this)")
+    ap.add_argument("--trace-drill", action="store_true",
+                    help="run the distributed-tracing drill: the decode-tier "
+                         "serve drill plus the assertion that EVERY "
+                         "completed request stitches into a multi-process "
+                         "trace on /requests (zero orphans; failover victims "
+                         "carry requeue + warm_graft spans), and that an "
+                         "induced slow_serve@phase=kv_ship window journals a "
+                         "request-latency slo_breach with "
+                         "dominant_phase=kv_ship (docs/observability.md)")
     ap.add_argument("--json", default="",
                     help="serve drill: also write the metrics dict here")
     args = ap.parse_args(argv)
@@ -563,6 +572,44 @@ def main(argv=None) -> int:
               f"p50 fractions compute/data/wait = "
               f"{att.get('compute_frac_p50')}/{att.get('data_frac_p50')}/"
               f"{att.get('collective_wait_frac_p50')}")
+        return 0
+
+    if args.trace_drill:
+        from ..serving.drill import run_induced_tail_drill, run_serve_drill
+
+        summary = run_serve_drill(
+            np=3, buddy=args.buddy, timeout_s=args.timeout,
+            requests=args.serve_requests, p99_bound_s=args.serve_p99_bound,
+            tier=args.tier or "decode", trace=True,
+        )
+        tail = run_induced_tail_drill(timeout_s=args.timeout)
+        combined = {
+            "ok": summary["ok"] and tail["ok"],
+            "failures": summary["failures"] + tail["failures"],
+            "stitching": summary,
+            "induced_tail": tail,
+        }
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(combined, f, indent=2)
+        if not combined["ok"]:
+            print("TRACE DRILL FAILED: " + "; ".join(combined["failures"]),
+                  file=sys.stderr)
+            for half in (summary, tail):
+                if half.get("output_tail"):
+                    print("--- output tail ---\n" + half["output_tail"],
+                          file=sys.stderr)
+            return 1
+        att = summary.get("request_attribution") or {}
+        print("TRACE DRILL OK: "
+              f"{summary.get('traces_completed')} requests stitched across "
+              ">=2 processes (0 orphans, "
+              f"{summary.get('traces_partial', 0)} partial; p99 "
+              f"{att.get('latency_p99_s')}s dominated by "
+              f"{att.get('dominant_p99_phase')}); induced kv_ship tail: "
+              f"slo_breach dominant_phase="
+              f"{tail.get('slo_breach_dominant_phase')} at "
+              f"{tail.get('slo_breach_value_ms')}ms p99")
         return 0
 
     if args.serve_drill:

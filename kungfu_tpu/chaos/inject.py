@@ -46,6 +46,9 @@ class ChaosInjector:
         self._sleep = sleep_fn
         self._fired: Set[Fault] = set()  # one-shot kinds already triggered
         self._slow_announced: Set[Fault] = set()  # slow windows journaled
+        self._phase_started: dict = {}  # slow_serve fault -> first-fire t
+        self._phase_calls: dict = {}    # slow_serve fault -> matching calls
+        self._phase_first: dict = {}    # slow_serve fault -> first-call t
 
     def on_step(self, step: int, rank: int, ckpt_dir: str = "") -> None:
         """Fire any fault scheduled for this (step, rank).  Crash and hang
@@ -115,6 +118,43 @@ class ChaosInjector:
                           code=f.code, tier=tier)
             self._exit(f.code)
 
+    def on_serve_phase(self, phase: str, rank: int, tier: str = "") -> None:
+        """Fire `slow_serve` delays: sleep ms just before the named serving
+        phase runs (worker calls this at each phase entry — `prefill` before
+        the prefill-tier forward, `kv_ship` before the KV blob POST,
+        `decode` at the top of each engine iteration).  The first `after`
+        matching calls pass undelayed (warmup/compile traffic stays
+        clean); the first DELAYED call opens the fault's window; with
+        secs= the window closes that many seconds later.  Journaled once
+        per window (`chaos_slow_serve`) so a drill can anchor its
+        induced-tail assertions."""
+        for f in self.plan.serve_phase_faults():
+            if f.phase != phase:
+                continue
+            if f.tier and f.tier != tier:
+                continue
+            if f.rank >= 0 and rank != f.rank:
+                continue
+            calls = self._phase_calls.get(f, 0) + 1
+            self._phase_calls[f] = calls
+            now = time.monotonic()
+            first = self._phase_first.setdefault(f, now)
+            if calls <= f.after:
+                continue  # warmup headroom: let the first N through
+            if f.start_after_s and now - first < f.start_after_s:
+                continue  # time-based warmup grace (boot/compile traffic)
+            started = self._phase_started.get(f)
+            if started is None:
+                self._phase_started[f] = started = now
+                log.warning("CHAOS: slow_serve window entered (phase=%s "
+                            "rank=%d tier=%s, %.0f ms/call)", phase, rank,
+                            tier or "-", f.ms)
+                self._journal("chaos_slow_serve", -1, rank, phase=phase,
+                              ms=f.ms, secs=f.secs, tier=tier)
+            if f.secs and now - started > f.secs:
+                continue  # window closed
+            self._sleep(f.ms / 1e3)
+
     @staticmethod
     def _journal(event: str, step: int, rank: int, **fields) -> None:
         """Scripted faults stamp the journal (flushed per emit) so a drill's
@@ -129,7 +169,8 @@ def injector_from_env() -> Optional[ChaosInjector]:
     Covers both the training step faults (on_step) and the serving-loop
     faults (on_serve_tokens) — each loop calls only its own hook."""
     plan = plan_from_env()
-    armed = plan.worker_faults() + plan.serve_faults()
+    armed = (plan.worker_faults() + plan.serve_faults()
+             + plan.serve_phase_faults())
     if not armed:
         return None
     log.info("fault plan armed: %s", ", ".join(f.kind for f in armed))

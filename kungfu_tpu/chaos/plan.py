@@ -35,6 +35,22 @@ Serving faults (docs/serving.md, serve drills):
                                     threshold), and prefill-tier workers
                                     count PREFILLED tokens instead of
                                     generated ones
+  slow_serve@phase=P:ms=M[:rank=R][:tier=T][:secs=S][:after=N][:start_after=S2]
+                                    delay one SERVING phase: sleep M ms just
+                                    before each `P` in {prefill, decode,
+                                    kv_ship} executes on matching workers
+                                    (rank=-1/absent = all; tier filters a
+                                    disaggregated pool).  after=N lets the
+                                    first N matching calls through undelayed
+                                    and start_after=S2 holds the delay for
+                                    S2 seconds from the first matching call
+                                    (warmup/compile traffic stays clean);
+                                    with secs= the window closes S seconds
+                                    after the first delayed call.  The
+                                    trace-drill's induced tail: the phase
+                                    the delay lands in must come back as the
+                                    SLO breach's dominant_phase
+                                    (docs/observability.md)
 
 Checkpoint-integrity faults (docs/fault_tolerance.md, recovery ladder):
 
@@ -93,7 +109,9 @@ from typing import List, Optional, Tuple
 FAULT_PLAN_ENV = "KFT_FAULT_PLAN"
 
 _KINDS = ("crash", "hang", "slow", "flap", "corrupt_ckpt", "crash_in_save",
-          "crash_serve", "partition", "degrade_link", "kill_host")
+          "crash_serve", "slow_serve", "partition", "degrade_link",
+          "kill_host")
+SERVE_PHASES = ("prefill", "decode", "kv_ship")
 NETWORK_KINDS = ("partition", "degrade_link", "kill_host")
 DEFAULT_CRASH_CODE = 41
 DEFAULT_CRASH_IN_SAVE_CODE = 43
@@ -126,7 +144,9 @@ class Fault:
     after: int = DEFAULT_FLAP_AFTER  # flap: requests served before outage
     ckpt_step: int = -1             # corrupt_ckpt: target step; -1 = latest
     tokens: int = -1                # crash_serve: generated-token trigger
-    tier: str = ""                  # crash_serve: pool filter (disagg fleets)
+    tier: str = ""                  # crash/slow_serve: pool filter (disagg)
+    phase: str = ""                 # slow_serve: serving phase to delay
+    start_after_s: float = 0.0      # slow_serve: warmup grace (seconds)
     # network faults (pod harness; hosts/host name netns "hosts", not ranks)
     host: str = ""                  # degrade_link/kill_host target host
     groups: Tuple[Tuple[str, ...], ...] = ()  # partition: the two host sides
@@ -189,6 +209,26 @@ def _parse_one(spec: str) -> Fault:
         return Fault(
             kind="crash_serve", tokens=int(kv.pop("tokens")),
             rank=rank, code=code, tier=tier,
+            **_reject_leftovers(kv, spec),
+        )
+
+    if kind == "slow_serve":
+        if "phase" not in kv or "ms" not in kv:
+            raise ValueError(f"slow_serve fault needs phase= and ms=: {spec!r}")
+        phase = kv.pop("phase")
+        if phase not in SERVE_PHASES:
+            raise ValueError(
+                f"slow_serve phase must be one of {SERVE_PHASES}: {spec!r}")
+        tier = kv.pop("tier", "")
+        if tier and tier not in ("prefill", "decode"):
+            raise ValueError(f"slow_serve tier must be prefill|decode: {spec!r}")
+        return Fault(
+            kind="slow_serve", phase=phase,
+            ms=_duration_s(kv.pop("ms") + "ms", spec) * 1e3,
+            rank=int(kv.pop("rank", -1)), tier=tier,
+            secs=_duration_s(kv.pop("secs", "0"), spec),
+            after=int(kv.pop("after", 0)),
+            start_after_s=_duration_s(kv.pop("start_after", "0"), spec),
             **_reject_leftovers(kv, spec),
         )
 
@@ -289,6 +329,10 @@ class FaultPlan:
     def serve_faults(self) -> Tuple[Fault, ...]:
         """Faults fired from the serving decode loop (on_serve_tokens)."""
         return tuple(f for f in self.faults if f.kind == "crash_serve")
+
+    def serve_phase_faults(self) -> Tuple[Fault, ...]:
+        """Per-phase serving delays (on_serve_phase)."""
+        return tuple(f for f in self.faults if f.kind == "slow_serve")
 
     def flap_faults(self) -> Tuple[Fault, ...]:
         return tuple(f for f in self.faults if f.kind == "flap")

@@ -49,3 +49,7 @@ from .slo import (  # noqa: F401
     SLORule,
     load_rules,
 )
+from .requests import (  # noqa: F401
+    RequestMonitor,
+    assemble_requests,
+)

@@ -7,8 +7,12 @@ Two modes:
                   rotated `.1`/`.2` segments, KFT_JOURNAL_MAX_MB) is merged
                   into `merged-journal.jsonl` (wall-clock ordered), every
                   `trace-*.json` (the workers' exit dumps, KFT_TRACE_DUMP_DIR)
-                  into `merged-trace.json` with one Perfetto lane per file,
-                  and every `timeseries-*.json` (the samplers' exit dumps,
+                  into `merged-trace.json` with one Perfetto lane per file
+                  AND re-assembled into `requests.json` (per-request
+                  stitched timelines + phase attribution, monitor.requests —
+                  the dead-fleet answer to "which phase blew the p99"; join
+                  it against the journal on trace_id), and every
+                  `timeseries-*.json` (the samplers' exit dumps,
                   monitor.timeseries) into `merged-timeseries.json` keyed
                   by process identity.
 
@@ -46,7 +50,7 @@ from typing import Dict, List, Optional
 
 
 def run_merge(dirpath: str, trace_out: str = "", journal_out: str = "") -> int:
-    from .fleet import merge_chrome_traces
+    from .fleet import dedupe_chrome_events, merge_chrome_traces
     from .journal import merge_journals
     from .timeseries import merge_dumps
 
@@ -84,11 +88,27 @@ def run_merge(dirpath: str, trace_out: str = "", journal_out: str = "") -> int:
             lane = os.path.splitext(os.path.basename(p))[0].replace("trace-", "")
             loaded.append((i, lane, t))
         merged = merge_chrome_traces(loaded)
+        merged["traceEvents"] = dedupe_chrome_events(merged["traceEvents"])
         trace_out = trace_out or os.path.join(dirpath, "merged-trace.json")
         with open(trace_out, "w") as f:
             json.dump(merged, f)
         print(f"trace: {len(merged['traceEvents'])} events from {len(loaded)} "
               f"lanes -> {trace_out} (open in https://ui.perfetto.dev)")
+
+        # per-request stitched timelines for the dead fleet: the same
+        # assembly the live /requests endpoint runs, from the dumps
+        from .requests import assemble_requests
+
+        report = assemble_requests([(lane, t) for _, lane, t in loaded])
+        if report.get("completed_total"):
+            req_out = os.path.join(dirpath, "requests.json")
+            with open(req_out, "w") as f:
+                json.dump(report, f, indent=2)
+            att = report.get("attribution") or {}
+            print(f"requests: {report['completed_total']} stitched "
+                  f"({report.get('partial_total', 0)} partial) -> {req_out}"
+                  + (f"; p99 {att.get('latency_p99_s')}s dominated by "
+                     f"{att.get('dominant_p99_phase')}" if att else ""))
 
     if series:
         folded = merge_dumps(series)

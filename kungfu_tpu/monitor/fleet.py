@@ -13,7 +13,16 @@ single endpoint over the whole job:
              per-worker names/labels, so a fleet counter always equals the
              sum of the worker endpoints it scraped.
   /timeline  every worker's /trace buffer merged into ONE Chrome trace,
-             each rank in its own process lane (pid = rank).
+             each rank in its own process lane (pid = rank), plus the
+             launcher's own lane ("router" — the serving front door's spans
+             live in this process) and Perfetto flow arrows for
+             cross-process request hops (monitor.requests).  Events dedupe
+             by (lane, span_id), so overlapping scrapes can't double-draw.
+  /requests  the distributed-request assembler (monitor.requests): per-rank
+             /trace feeds stitched into per-request timelines by trace_id,
+             with per-phase latency attribution, a bounded reservoir of
+             completed requests and the tail sampler (slowest-N + failover/
+             SLO-breach touched).
   /ranks     JSON scrape status per rank (reachable, error, url).
   /stragglers  the straggler observatory's merged report (monitor.straggler):
              per-rank compute/data-wait/collective-wait attribution, arrival
@@ -177,6 +186,29 @@ def merge_prometheus(texts: Dict[int, str],
     return "\n".join(lines) + "\n"
 
 
+def dedupe_chrome_events(events: Sequence[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Drop duplicate events from a merged Chrome trace.
+
+    Spans carrying a distributed span id dedupe by (lane, span_id) — the
+    satellite fix for re-scraped /trace feeds folding the same span into
+    one export twice; everything else falls back to the full event shape."""
+    seen = set()
+    out: List[Dict[str, Any]] = []
+    for ev in events:
+        args = ev.get("args") or {}
+        sid = args.get("span_id")
+        if sid:
+            key = ("sid", ev.get("pid"), sid)
+        else:
+            key = (ev.get("pid"), ev.get("tid"), ev.get("name"),
+                   ev.get("ph"), ev.get("ts"), ev.get("dur"), ev.get("id"))
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(ev)
+    return out
+
+
 def merge_chrome_traces(traces: Sequence[Tuple[Any, str, Dict[str, Any]]]) -> Dict[str, Any]:
     """One merged Chrome trace from per-process exports.
 
@@ -230,6 +262,7 @@ class FleetAggregator:
         self._pool = ThreadPoolExecutor(max_workers=16,
                                         thread_name_prefix="kft-scrape")
         self._straggler = None  # monitor.straggler.StragglerMonitor, lazy
+        self._requests = None   # monitor.requests.RequestMonitor, lazy
         # fleet time-series store + SLO engine + sampler (the long-horizon
         # layer: /history and /slo read these; the sampler thread fills
         # them every KFT_TS_INTERVAL_S so breaches are detected even when
@@ -243,6 +276,7 @@ class FleetAggregator:
             self.ts_store,
             rules=slo_rules if slo_rules is not None else load_rules(),
             counters=global_counters(),
+            attribution_fn=self._slo_attribution,
         )
         self._sampler = FleetSampler(
             self, self.ts_store, engine=self.slo_engine,
@@ -267,6 +301,9 @@ class FleetAggregator:
                         ctype = "application/json"
                     elif path == "/stragglers":
                         body = json.dumps(outer.straggler_report()).encode()
+                        ctype = "application/json"
+                    elif path == "/requests":
+                        body = json.dumps(outer.requests_report()).encode()
                         ctype = "application/json"
                     elif path == "/history":
                         body = json.dumps(outer.history(query)).encode()
@@ -342,14 +379,90 @@ class FleetAggregator:
         return text
 
     def merged_timeline(self) -> Dict[str, Any]:
-        bodies, _ = self.scrape("/trace")
-        traces = []
+        traces, _ = self._scrape_traces()
+        mon = self._requests_monitor()
+        for rank, _, trace in traces:
+            mon.consume_chrome(rank, trace)
+        merged = merge_chrome_traces(traces)
+        merged["traceEvents"] = dedupe_chrome_events(merged["traceEvents"])
+        # cross-lane arrows: shipped-KV and requeued requests hop between
+        # rank lanes; the assembler's flow pairs draw them in Perfetto
+        merged["traceEvents"].extend(mon.flow_events())
+        return merged
+
+    def _scrape_traces(self) -> Tuple[List[Tuple[Any, str, Dict[str, Any]]], Dict]:
+        """Every rank's /trace plus this process's own buffer (the serving
+        router's lane — its spans never cross a socket) as parsed
+        (lane, name, trace) triples."""
+        bodies, errors = self.scrape("/trace")
+        traces: List[Tuple[Any, str, Dict[str, Any]]] = []
         for rank in sorted(bodies):
             try:
                 traces.append((rank, f"rank {rank}", json.loads(bodies[rank])))
             except ValueError:
-                continue
-        return merge_chrome_traces(traces)
+                errors[rank] = "invalid trace JSON"
+        from ..utils import trace as T
+
+        buf = T.global_trace_buffer()
+        if T.enabled() and len(buf):
+            traces.append(("router", "router",
+                           T.export_chrome_trace(buf, pid="router")))
+        return traces, errors
+
+    def _requests_monitor(self):
+        if self._requests is None:
+            from .requests import RequestMonitor
+
+            self._requests = RequestMonitor(
+                breach_active_fn=lambda: bool(self.slo_engine.active()))
+        return self._requests
+
+    def requests_report(self) -> Dict[str, Any]:
+        """One assembler update + report — `/requests`.  Each call scrapes
+        every rank's /trace (duplicate spans dedupe, so polling is safe)
+        and stitches newly completed requests into timelines."""
+        traces, errors = self._scrape_traces()
+        mon = self._requests_monitor()
+        for rank, _, trace in traces:
+            mon.consume_chrome(rank, trace)
+        return mon.report(scrape_errors=errors)
+
+    def _slo_attribution(self, rule,
+                         viol_since: Optional[float] = None
+                         ) -> Optional[Dict[str, Any]]:
+        """Phase attribution attached to `slo_breach` journal events for
+        request-latency rules: the tail sampler names the dominant phase
+        (e.g. dominant_phase=kv_ship) so a breach is actionable without
+        replaying the fleet.  The window opens a little before the
+        violation's first bad sample (that sample's request completed
+        earlier), so the attribution describes the requests that caused
+        THIS breach, not ancient history."""
+        if "request_latency" not in getattr(rule, "metric", ""):
+            return None
+        try:
+            self.requests_report()  # refresh from the live fleet
+        except Exception:  # noqa: BLE001 - attribution is best-effort
+            pass
+        since = (viol_since - 5.0) if viol_since is not None else None
+        # the rule's threshold defines the violating set: requests slower
+        # than it VOTE on the dominant phase (request_latency rules are in
+        # milliseconds; timelines are in seconds)
+        min_lat = None
+        try:
+            if getattr(rule, "metric", "").startswith("hist:request_latency_ms"):
+                min_lat = float(rule.threshold) / 1e3
+        except (TypeError, ValueError):
+            min_lat = None
+        att = self._requests_monitor().attribution(since_t=since,
+                                                   min_latency_s=min_lat)
+        if not att:
+            return None
+        return {
+            "dominant_phase": att.get("dominant_p99_phase"),
+            "dominant_phase_frac": att.get("dominant_p99_frac"),
+            "phase_p99_fracs": {p: v.get("p99")
+                                for p, v in (att.get("phases") or {}).items()},
+        }
 
     def straggler_report(self) -> Dict[str, Any]:
         """One straggler-observatory update + report (docs/observability.md).

@@ -115,7 +115,7 @@ class Journal:
         self._f = open(self.path, "a", encoding="utf-8")
 
     def emit(self, event: str, **fields: Any) -> None:
-        from ..utils.trace import job_now
+        from ..utils.trace import current_context, job_now
 
         rec: Dict[str, Any] = {
             "event": event,
@@ -124,7 +124,16 @@ class Journal:
             "rank": _context["rank"],
             "cluster_version": _context["cluster_version"],
         }
+        # request correlation: an event emitted under an active distributed
+        # trace context carries its trace_id, so `--merge` can join journal
+        # and trace offline (request-scoped emitters may also pass trace_id
+        # explicitly — explicit fields win below)
+        ctx = current_context()
+        if ctx is not None:
+            rec["trace_id"] = ctx.trace_id
         rec.update(fields)  # explicit fields win over context stamps
+        if "trace_id" in rec and not rec["trace_id"]:
+            del rec["trace_id"]  # an untraced request stamps nothing
         line = json.dumps(rec, default=str)
         with self._lock:
             self._f.write(line + "\n")

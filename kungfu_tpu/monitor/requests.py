@@ -1,0 +1,493 @@
+"""Per-request timeline assembler — distributed traces for the serving fleet.
+
+Every serving process records spans stamped with (trace_id, span_id,
+parent_id) (utils.trace): the router's root `request` span plus
+`queue:wait` / `route` / `requeue` / `warm_graft`, a prefill rank's
+`serve:prefill` + `kv_ship`, a decode rank's `serve:kv_graft` + the
+per-request `decode` aggregate, and batch-level `serve:decode` /
+`serve:draft` / `serve:verify` rounds that carry the traces they advanced
+as links (`args.trace_ids`).  This module stitches those per-rank feeds
+into per-request timelines and attributes each request's latency to
+phases, so "which phase of which request blew the p99" has an answer
+instead of a histogram shrug.
+
+`RequestMonitor` consumes each rank's /trace incrementally (spans dedupe
+by (rank, span_id), so duplicate scrapes and overlapping dumps are safe),
+finalizes a timeline when its root span arrives (late spans merge in and
+re-attribute — scrapes are unordered), and keeps:
+
+  * a bounded reservoir of recently completed requests (KFT_REQUESTS_KEEP)
+  * a tail sampler that ALWAYS retains the slowest-N requests
+    (KFT_REQUESTS_TAIL) plus any request touched by a failover
+    (requeues > 0) or completing inside an SLO-breach window — the
+    requests a p99 investigation actually needs, never evicted by
+    fast traffic
+
+Phase attribution is exclusive-time over the span tree: each span's
+duration minus its children's (clipped at zero), bucketed by span name
+(`PHASE_OF_SPAN`); the root's own exclusive remainder lands in `other`.
+For a sequential request this is critical-path attribution: the innermost
+span covering each moment gets the credit.  A timeline whose spans
+reference parents that never arrived (a crashed rank's lane, a ring
+overflow — see `spans_dropped`) is marked `partial` instead of presenting
+a misleading tree.
+
+`flow_events()` exports Perfetto flow arrows for every cross-process
+parent->child edge (route -> worker subtree, kv_ship -> kv_graft), which
+the fleet aggregator splices into `/timeline`.  The fleet `/requests`
+endpoint serves `report()`; `python -m kungfu_tpu.monitor --merge` runs
+the same assembly over a dead fleet's trace dumps into `requests.json`.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..utils import get_logger
+
+log = get_logger("kungfu.requests")
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        v = os.environ.get(name, "")
+        return int(v) if v else default
+    except ValueError:
+        return default
+
+KEEP_ENV = "KFT_REQUESTS_KEEP"    # completed-request reservoir size
+TAIL_ENV = "KFT_REQUESTS_TAIL"    # slowest-N retained by the tail sampler
+DEFAULT_KEEP = 256
+DEFAULT_TAIL = 32
+FLAGGED_CAP = 64                  # failover/breach retention bound
+SEEN_CAP = 65536                  # per-rank span-id dedup window
+
+#: span name -> latency phase (docs/serving.md names its phases after these)
+PHASE_OF_SPAN: Dict[str, str] = {
+    "queue:wait": "queue",
+    "route": "route",
+    "serve:prefill": "prefill",
+    "kv_ship": "kv_ship",
+    "serve:kv_graft": "kv_graft",
+    "decode": "decode",
+    "warm_graft": "requeue",
+    "requeue": "requeue",
+}
+#: batch-level spans linking many traces (args.trace_ids), counted as rounds
+BATCH_SPANS: Dict[str, str] = {
+    "serve:decode": "decode",
+    "serve:draft": "spec",
+    "serve:verify": "spec",
+}
+PHASES: Tuple[str, ...] = ("queue", "route", "prefill", "kv_ship",
+                           "kv_graft", "decode", "spec", "requeue", "other")
+
+
+def _percentile(xs: Sequence[float], p: float) -> Optional[float]:
+    if not xs:
+        return None
+    xs = sorted(xs)
+    k = min(len(xs) - 1, max(0, int(round(p * (len(xs) - 1)))))
+    return xs[k]
+
+
+class RequestMonitor:
+    """Incremental cross-process trace assembler (thread-safe: the fleet
+    aggregator feeds it from /timeline, /requests and SLO-breach paths)."""
+
+    def __init__(self, keep: Optional[int] = None,
+                 tail_slowest: Optional[int] = None,
+                 breach_active_fn: Optional[Callable[[], bool]] = None):
+        self.keep = keep if keep is not None else _env_int(KEEP_ENV, DEFAULT_KEEP)
+        self.tail_slowest = (tail_slowest if tail_slowest is not None
+                             else _env_int(TAIL_ENV, DEFAULT_TAIL))
+        self.breach_active_fn = breach_active_fn
+        self._lock = threading.Lock()
+        self._seen: Dict[Any, set] = {}            # rank -> span_id set
+        self._seen_order: Dict[Any, deque] = {}    # rank -> insertion order
+        self._open: Dict[str, dict] = {}           # trace_id -> working set
+        self._completed: deque = deque()           # timelines, oldest first
+        self._by_trace: Dict[str, dict] = {}       # retained timeline index
+        self._tail_slow: List[dict] = []           # slowest-N timelines
+        self._tail_flagged: deque = deque()        # failover/breach retained
+        self._anchors: Dict[Any, float] = {}       # rank -> job_start_wall
+        self.spans_dropped: Dict[str, int] = {}    # rank -> ring drops seen
+        self.completed_total = 0
+        self.partial_total = 0
+        self._flow_id = 0
+
+    # -- ingestion --------------------------------------------------------------------
+
+    def consume_chrome(self, rank: Any, trace: Dict[str, Any]) -> int:
+        """Feed one process's Chrome-trace export (a /trace scrape or an
+        offline dump).  Returns the number of NEW spans consumed; re-fed
+        spans dedupe by (rank, span_id)."""
+        other = trace.get("otherData") or {}
+        dropped = other.get("spans_dropped")
+        with self._lock:
+            if isinstance(dropped, (int, float)) and dropped > 0:
+                self.spans_dropped[str(rank)] = int(dropped)
+            if rank not in self._anchors:
+                anchor = other.get("job_start_wall")
+                self._anchors[rank] = (float(anchor)
+                                       if isinstance(anchor, (int, float))
+                                       else 0.0)
+            # cross-host alignment: ranks sharing KFT_JOB_START get offset 0;
+            # a foreign job clock is re-anchored onto the first-seen one
+            base = min(self._anchors.values())
+            offset = self._anchors[rank] - base if base else 0.0
+            new = 0
+            touched: set = set()
+            for ev in trace.get("traceEvents", []):
+                if ev.get("ph") not in ("X", "i"):
+                    continue
+                args = ev.get("args") or {}
+                sid = str(args.get("span_id") or "")
+                tid_ = str(args.get("trace_id") or "")
+                if not sid:
+                    continue
+                if not self._mark_seen(rank, sid):
+                    continue
+                new += 1
+                span = {
+                    "name": str(ev.get("name", "")),
+                    "rank": rank,
+                    "tid": ev.get("tid", 0),
+                    "t0": float(ev.get("ts", 0.0)) / 1e6 + offset,
+                    "dur": float(ev.get("dur", 0.0) or 0.0) / 1e6,
+                    "span_id": sid,
+                    "parent_id": str(args.get("parent_id") or ""),
+                    "args": {k: v for k, v in args.items()
+                             if k not in ("trace_id", "span_id", "parent_id")},
+                }
+                if tid_:
+                    self._attach(tid_, span)
+                    touched.add(tid_)
+                elif span["name"] in BATCH_SPANS:
+                    for linked in args.get("trace_ids") or ():
+                        self._attach_batch(str(linked), span)
+                        touched.add(str(linked))
+            for tid_ in touched:
+                self._maybe_finalize(tid_)
+            return new
+
+    def _mark_seen(self, rank: Any, sid: str) -> bool:
+        seen = self._seen.setdefault(rank, set())
+        if sid in seen:
+            return False
+        seen.add(sid)
+        order = self._seen_order.setdefault(rank, deque())
+        order.append(sid)
+        if len(order) > SEEN_CAP:
+            seen.discard(order.popleft())
+        return True
+
+    def _working(self, trace_id: str) -> Optional[dict]:
+        tl = self._by_trace.get(trace_id)
+        if tl is not None:
+            return tl  # late arrival for a retained completed timeline
+        return self._open.setdefault(
+            trace_id, {"trace_id": trace_id, "spans": {}, "batch": []})
+
+    def _attach(self, trace_id: str, span: dict) -> None:
+        tr = self._working(trace_id)
+        if tr is None:
+            return
+        spans = tr["spans"] if "spans" in tr else None
+        if spans is None:  # finalized timeline keeps spans under "spans" too
+            return
+        spans[span["span_id"]] = span
+        if tr.get("status") is not None:  # completed: re-derive in place
+            self._refresh(tr)
+
+    def _attach_batch(self, trace_id: str, span: dict) -> None:
+        tr = self._working(trace_id)
+        if tr is None:
+            return
+        tr.setdefault("batch", []).append(span)
+        if tr.get("status") is not None:
+            self._refresh(tr)
+
+    # -- assembly ---------------------------------------------------------------------
+
+    def _maybe_finalize(self, trace_id: str) -> None:
+        tr = self._open.get(trace_id)
+        if tr is None:
+            return
+        root = next((s for s in tr["spans"].values()
+                     if s["name"] == "request"), None)
+        if root is None:
+            return  # still in flight: the router records the root at delivery
+        del self._open[trace_id]
+        tr["root_id"] = root["span_id"]
+        self._refresh(tr)
+        self.completed_total += 1
+        if tr["partial"]:
+            self.partial_total += 1
+        self._retain(tr)
+
+    def _refresh(self, tr: dict) -> None:
+        """(Re-)derive the timeline's summary fields from its spans —
+        idempotent, so out-of-order late arrivals just re-run it."""
+        spans = tr["spans"]
+        root = spans.get(tr.get("root_id", ""))
+        if root is None:
+            return
+        ids = set(spans)
+        orphans = [s["span_id"] for s in spans.values()
+                   if s["parent_id"] and s["parent_id"] not in ids
+                   and s["span_id"] != root["span_id"]]
+        args = root.get("args") or {}
+        tr["req_id"] = args.get("req_id", "")
+        tr["status"] = args.get("status", "ok")
+        tr["requeues"] = int(args.get("requeues", 0) or 0)
+        tr["t0"] = root["t0"]
+        tr["latency_s"] = round(root["dur"], 6)
+        tr["processes"] = sorted({str(s["rank"]) for s in spans.values()})
+        tr["n_spans"] = len(spans)
+        tr["orphans"] = len(orphans)
+        tr["partial"] = bool(orphans)
+        tr["phases"] = self._attribute(spans, root)
+        batch = tr.get("batch") or []
+        tr["decode_rounds"] = sum(1 for b in batch
+                                  if b["name"] == "serve:decode")
+        tr["spec_rounds"] = sum(1 for b in batch
+                                if b["name"] == "serve:verify")
+        if tr["spec_rounds"]:
+            acc = []
+            for b in batch:
+                if b["name"] != "serve:verify":
+                    continue
+                accepted = (b.get("args") or {}).get("accepted")
+                linked = (b.get("args") or {}).get("trace_ids") or ()
+                if accepted and tr["trace_id"] in linked:
+                    i = list(linked).index(tr["trace_id"])
+                    if i < len(accepted):
+                        acc.append(int(accepted[i]))
+            if acc:
+                tr["spec_accepted"] = sum(acc)
+        dom = max(tr["phases"], key=lambda p: tr["phases"][p]) \
+            if tr["phases"] else "other"
+        tr["dominant_phase"] = dom
+
+    @staticmethod
+    def _attribute(spans: Dict[str, dict], root: dict) -> Dict[str, float]:
+        """Exclusive-time per phase: each span's duration minus its
+        children's (clipped at zero) credits its phase; the root's own
+        remainder is `other` (router bookkeeping, network gaps)."""
+        child_sum: Dict[str, float] = {}
+        for s in spans.values():
+            if s["parent_id"] in spans:
+                child_sum[s["parent_id"]] = (child_sum.get(s["parent_id"], 0.0)
+                                             + s["dur"])
+        phases = {p: 0.0 for p in PHASES}
+        for s in spans.values():
+            excl = max(0.0, s["dur"] - child_sum.get(s["span_id"], 0.0))
+            if s["span_id"] == root["span_id"]:
+                phases["other"] += excl
+                continue
+            phases[PHASE_OF_SPAN.get(s["name"], "other")] += excl
+        return {p: round(v, 6) for p, v in phases.items() if v > 0.0}
+
+    # -- retention --------------------------------------------------------------------
+
+    def _retain(self, tr: dict) -> None:
+        self._completed.append(tr)
+        self._by_trace[tr["trace_id"]] = tr
+        while len(self._completed) > self.keep:
+            old = self._completed.popleft()
+            self._drop_index(old)
+        flagged = tr["requeues"] > 0
+        if not flagged and self.breach_active_fn is not None:
+            try:
+                flagged = bool(self.breach_active_fn())
+                if flagged:
+                    tr["in_breach_window"] = True
+            except Exception:  # noqa: BLE001 - retention must never raise
+                flagged = False
+        if flagged:
+            self._tail_flagged.append(tr)
+            self._by_trace[tr["trace_id"]] = tr
+            while len(self._tail_flagged) > FLAGGED_CAP:
+                self._drop_index(self._tail_flagged.popleft())
+        # slowest-N: a faster request NEVER evicts a slower one
+        if len(self._tail_slow) < self.tail_slowest:
+            self._tail_slow.append(tr)
+        else:
+            fastest = min(self._tail_slow, key=lambda t: t["latency_s"])
+            if tr["latency_s"] > fastest["latency_s"]:
+                for i, t in enumerate(self._tail_slow):
+                    if t is fastest:
+                        del self._tail_slow[i]
+                        break
+                self._drop_index(fastest)
+                self._tail_slow.append(tr)
+        self._by_trace[tr["trace_id"]] = tr
+
+    def _drop_index(self, tr: dict) -> None:
+        """Remove the timeline's late-arrival index entry unless another
+        retention tier still holds it (identity, not value, comparisons —
+        timelines are mutable dicts)."""
+        held = (any(t is tr for t in self._tail_slow)
+                or any(t is tr for t in self._tail_flagged)
+                or any(t is tr for t in self._completed))
+        if not held:
+            self._by_trace.pop(tr["trace_id"], None)
+
+    # -- reporting --------------------------------------------------------------------
+
+    @staticmethod
+    def _summary(tr: dict, spans: bool = False) -> dict:
+        out = {k: tr.get(k) for k in (
+            "trace_id", "req_id", "status", "requeues", "t0", "latency_s",
+            "processes", "n_spans", "orphans", "partial", "phases",
+            "dominant_phase", "decode_rounds", "spec_rounds",
+            "spec_accepted", "in_breach_window") if k in tr}
+        if spans:
+            out["spans"] = sorted(
+                ({"name": s["name"], "rank": str(s["rank"]),
+                  "t0": round(s["t0"], 6), "dur": round(s["dur"], 6),
+                  "span_id": s["span_id"], "parent_id": s["parent_id"]}
+                 for s in tr["spans"].values()),
+                key=lambda s: s["t0"])
+        return out
+
+    def attribution(self, since_t: Optional[float] = None,
+                    min_latency_s: Optional[float] = None) -> Dict[str, Any]:
+        """Aggregate per-phase p50/p99 latency fractions over the retained
+        completed requests, plus the dominant p99 phase — what the SLO
+        breach journal names as `dominant_phase`.  Dominance is a VOTE:
+        every slow request names the phase that dominated ITS latency, and
+        the most-named phase wins (ties break by summed fraction) — robust
+        against a single mis-assembled straggler, which a mean over the
+        top-percentile set is not.
+
+        `since_t` (job-relative seconds) restricts the pool to requests
+        starting at/after that stamp — the SLO path passes the breach's
+        violation start, so the attribution describes the requests that
+        CAUSED this breach, not ancient history (falls back to everything
+        when the window is empty).  `min_latency_s` defines the slow set
+        directly (the SLO path passes the rule threshold: the VIOLATING
+        requests vote); without it, requests at/above the pool's p99
+        latency vote."""
+        with self._lock:
+            rows = [t for t in self._completed if t.get("latency_s")]
+            tail_rows = [t for t in self._tail_slow if t.get("latency_s")]
+        pool = {t["trace_id"]: t for t in rows + tail_rows}.values()
+        rows = [t for t in pool if t["latency_s"] > 0]
+        # prefer structurally complete timelines: a row whose spans are
+        # router-only (the worker's scrape lagged) or partial attributes
+        # everything to the dispatch hop — poison for the aggregate
+        complete = [t for t in rows if not t.get("partial")
+                    and len(t.get("processes") or ()) >= 2]
+        if complete:
+            rows = complete
+        if since_t is not None:
+            windowed = [t for t in rows if t.get("t0", 0.0) >= since_t]
+            if windowed:
+                rows = windowed
+        if not rows:
+            return {}
+        fracs: Dict[str, List[float]] = {p: [] for p in PHASES}
+        for t in rows:
+            for p in PHASES:
+                fracs[p].append(t["phases"].get(p, 0.0) / t["latency_s"])
+        lat = [t["latency_s"] for t in rows]
+        p99_lat = _percentile(lat, 0.99) or 0.0
+        cutoff = p99_lat if min_latency_s is None else min_latency_s
+        slow = [t for t in rows if t["latency_s"] >= cutoff] or rows
+        votes: Dict[str, int] = {}
+        sums: Dict[str, float] = {}
+        for t in slow:
+            dom = t.get("dominant_phase", "other")
+            votes[dom] = votes.get(dom, 0) + 1
+            for p in PHASES:
+                sums[p] = sums.get(p, 0.0) + (t["phases"].get(p, 0.0)
+                                              / t["latency_s"])
+        dominant = max(votes, key=lambda p: (votes[p], sums.get(p, 0.0)))
+        return {
+            "requests": len(rows),
+            "slow_requests": len(slow),
+            "latency_p50_s": round(_percentile(lat, 0.50) or 0.0, 6),
+            "latency_p99_s": round(p99_lat, 6),
+            "phases": {
+                p: {"p50": round(_percentile(fracs[p], 0.50) or 0.0, 4),
+                    "p99": round(_percentile(fracs[p], 0.99) or 0.0, 4)}
+                for p in PHASES
+                if any(v > 0 for v in fracs[p])
+            },
+            "dominant_p99_phase": dominant,
+            "dominant_p99_frac": round(sums.get(dominant, 0.0) / len(slow), 4),
+        }
+
+    def report(self, scrape_errors: Optional[Dict] = None) -> Dict[str, Any]:
+        with self._lock:
+            recent = [self._summary(t) for t in reversed(self._completed)]
+            tail_slow = [self._summary(t, spans=True)
+                         for t in sorted(self._tail_slow,
+                                         key=lambda t: -t["latency_s"])]
+            flagged = [self._summary(t, spans=True)
+                       for t in reversed(self._tail_flagged)]
+            out = {
+                "completed_total": self.completed_total,
+                "partial_total": self.partial_total,
+                "open": len(self._open),
+                "spans_dropped": dict(self.spans_dropped),
+                "requests": recent,
+                "tail": {"slowest": tail_slow, "flagged": flagged},
+            }
+        out["attribution"] = self.attribution()
+        if scrape_errors:
+            out["scrape_errors"] = {str(k): v for k, v in scrape_errors.items()}
+        return out
+
+    # -- Perfetto flows ---------------------------------------------------------------
+
+    def flow_events(self) -> List[Dict[str, Any]]:
+        """Chrome-trace flow event pairs ("s"/"f") for every cross-process
+        parent->child span edge of the retained + in-flight traces — the
+        arrows that make a shipped-KV or requeued request's hop visible
+        across /timeline's rank lanes."""
+        out: List[Dict[str, Any]] = []
+        with self._lock:
+            pools = [t["spans"] for t in self._completed]
+            pools += [t["spans"] for t in self._tail_slow]
+            pools += [t["spans"] for t in self._tail_flagged]
+            pools += [t["spans"] for t in self._open.values()]
+            emitted: set = set()
+            for spans in pools:
+                for s in spans.values():
+                    parent = spans.get(s["parent_id"])
+                    if parent is None or parent["rank"] == s["rank"]:
+                        continue
+                    key = (parent["span_id"], s["span_id"])
+                    if key in emitted:
+                        continue
+                    emitted.add(key)
+                    self._flow_id += 1
+                    name = f"flow:{s['name']}"
+                    out.append({
+                        "ph": "s", "id": self._flow_id, "name": name,
+                        "cat": "flow", "pid": parent["rank"],
+                        "tid": parent["tid"],
+                        "ts": round((parent["t0"] + parent["dur"]) * 1e6, 1),
+                    })
+                    out.append({
+                        "ph": "f", "bp": "e", "id": self._flow_id,
+                        "name": name, "cat": "flow", "pid": s["rank"],
+                        "tid": s["tid"], "ts": round(s["t0"] * 1e6, 1),
+                    })
+        return out
+
+
+def assemble_requests(traces: Sequence[Tuple[Any, Dict[str, Any]]]) -> Dict[str, Any]:
+    """Offline assembly over (rank/lane, chrome_trace) pairs — the
+    `python -m kungfu_tpu.monitor --merge` path for dead fleets.  Retention
+    bounds are lifted to the input size: a post-mortem wants everything."""
+    mon = RequestMonitor(keep=max(DEFAULT_KEEP, 4096),
+                         tail_slowest=DEFAULT_TAIL)
+    for rank, trace in traces:
+        mon.consume_chrome(rank, trace)
+    return mon.report()

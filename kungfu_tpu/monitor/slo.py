@@ -117,6 +117,11 @@ DEFAULT_RULES: List[SLORule] = [
             sustain_s=30.0, severity="page",
             description="serving admission-queue depth stays bounded "
                         "(sustained depth = the autoscaler lost the race)"),
+    SLORule("request_latency_p99", "hist:request_latency_ms:p99", "<=",
+            30000.0, sustain_s=15.0, severity="page",
+            description="windowed serving request-latency p99 stays under "
+                        "30 s; a breach journals the tail sampler's "
+                        "per-phase attribution (dominant_phase)"),
     SLORule("heal_mttr", "gauge:heal_mttr_s", "<=", 30.0,
             sustain_s=0.0, severity="warn",
             description="worker-death-to-first-post-heal-step stays under "
@@ -178,12 +183,18 @@ class SLOEngine:
 
     def __init__(self, store, rules: Optional[List[SLORule]] = None,
                  counters=None, journal: Callable[..., None] = journal_event,
-                 clock: Callable[[], float] = job_now):
+                 clock: Callable[[], float] = job_now,
+                 attribution_fn: Optional[
+                     Callable[[SLORule, Optional[float]],
+                              Optional[Dict[str, Any]]]] = None):
         self.store = store
         self.rules = list(rules) if rules is not None else load_rules()
         self.counters = counters
         self.journal = journal
         self.clock = clock
+        # extra journal fields for breach transitions (e.g. the request
+        # assembler's per-phase tail attribution: dominant_phase=kv_ship)
+        self.attribution_fn = attribution_fn
         self._states: Dict[str, _RuleState] = {r.name: _RuleState()
                                                for r in self.rules}
         self.evaluations = 0
@@ -235,10 +246,19 @@ class SLOEngine:
     def _transition(self, event: str, rule: SLORule, st: _RuleState) -> None:
         log.warning("%s: %s (%s = %s, want %s %s)", event, rule.name,
                     rule.metric, st.last_value, rule.op, rule.threshold)
+        extra: Dict[str, Any] = {}
+        if event == "slo_breach" and self.attribution_fn is not None:
+            try:
+                # viol_since anchors the attribution window: the requests
+                # since THIS violation began are the ones that caused it
+                extra = self.attribution_fn(rule, st.viol_since) or {}
+            except Exception as e:  # noqa: BLE001 - never block the breach
+                log.debug("SLO attribution skipped: %s", e)
+                extra = {}
         self.journal(event, rule=rule.name, metric=rule.metric,
                      value=st.last_value, op=rule.op,
                      threshold=rule.threshold, severity=rule.severity,
-                     sustain_s=rule.sustain_s)
+                     sustain_s=rule.sustain_s, **extra)
         if self.counters is not None:
             self.counters.inc_event("slo_breaches" if event == "slo_breach"
                                     else "slo_clears")

@@ -478,6 +478,7 @@ class FleetSampler:
         self._prev_counters: Dict[str, float] = {}
         self._prev_t: Optional[float] = None
         self._prev_hists: Dict[str, Dict[float, float]] = {}
+        self._prev_local_hists: Dict[Tuple[str, str], Dict[float, float]] = {}
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self.ticks = 0
@@ -503,6 +504,7 @@ class FleetSampler:
                 # identically-named one must not interleave two semantics
                 if f"gauge:{name}" not in seen_gauges:
                     self.store.record(f"gauge:{name}", t, v)
+            self._sample_local_hists(t)
         self.ticks += 1
         if self.engine is not None:
             self.engine.evaluate(now=t)
@@ -579,6 +581,38 @@ class FleetSampler:
                     # percentile tag behind it
                     self.store.record(f"hist:{hkey}:{tag}", t, v)
         self._prev_t = t
+
+    def _sample_local_hists(self, t: float) -> None:
+        """Launcher-local histograms as windowed percentiles — the serving
+        router's `request_latency_ms`/`ttft_ms` observe in THIS process,
+        not in any worker, so the fleet scrape never sees them; without
+        this the request-latency SLO rule would read no_data forever.
+        Fleet-scraped series of the same name win (skip on collision)."""
+        try:
+            snap = self.local_counters.snapshot_json()
+        except Exception:  # noqa: BLE001 - sampling must not die mid-tick
+            return
+        for h in snap.get("hists") or []:
+            metric, label = h["metric"], h.get("label", "")
+            if any(k == metric or k.startswith(f"{metric}[")
+                   for k in self._prev_hists):
+                continue  # a worker-side histogram of the same name wins
+            bounds = list(h["bounds"]) + [float("inf")]
+            cum: Dict[float, float] = {}
+            running = 0.0
+            for b, c in zip(bounds, h["counts"]):
+                running += c
+                cum[b] = running
+            key = (metric, label)
+            pairs = _delta_pairs(cum, self._prev_local_hists.get(key))
+            self._prev_local_hists[key] = cum
+            if sum(c for _, c in pairs) <= 0:
+                continue
+            for p, tag in HIST_PCTS:
+                v = percentile_from_buckets(pairs, p)
+                if v is not None:
+                    self.store.record(hist_series_name(metric, label, tag),
+                                      t, v)
 
     def _sample_straggler(self, t: float) -> None:
         """Feed the straggler observatory's attribution medians into the

@@ -40,6 +40,7 @@ from ..monitor.journal import journal_event
 from ..ops.kv_ship import pack_kv
 from ..plan import Cluster, PeerList
 from ..utils import get_logger
+from ..utils import trace as T
 
 log = get_logger("kungfu.serving")
 
@@ -99,20 +100,31 @@ def ship_to_decode(urls: List[str], req, first_token: int, rows,
                    cursor: int, origin_rank: int,
                    ship_timeout_s: float = 10.0,
                    result_timeout_s: float = 120.0,
-                   counters=None) -> Tuple[Optional[dict], str]:
+                   counters=None, phase_hook=None) -> Tuple[Optional[dict], str]:
     """Ship finished prefill KV to the first decode rank that accepts it,
     then block for the request's final result (the prefill worker proxies
     it back to the router).  Returns (result_json | None, error).  The
     ship POST and the result GET are separate calls so `kv_ship_ms`
-    measures transfer + graft-admission, not the decode itself."""
-    blob = pack_kv(
-        {"cursor": int(cursor), "first_token": int(first_token),
-         "origin_rank": int(origin_rank), "request": req.to_json()},
-        rows,
-    )
+    measures transfer + graft-admission, not the decode itself.
+    `phase_hook` (the worker's chaos `slow_serve@phase=kv_ship` entry)
+    runs inside each attempt's timed window, so an injected delay lands in
+    the kv_ship span/histogram — where a real slow ship would."""
+    meta = {"cursor": int(cursor), "first_token": int(first_token),
+            "origin_rank": int(origin_rank), "request": req.to_json()}
+    tid = getattr(req, "trace_id", "")
+    ship_sid = T.new_span_id() if (tid and T.enabled()) else ""
+    if ship_sid:
+        # the decode rank's graft/decode spans parent under this hop's
+        # kv_ship span — the context rides in the blob meta (the ship is a
+        # binary POST, so the header convention moves into the payload)
+        meta["traceparent"] = T.format_traceparent(
+            T.TraceContext(tid, ship_sid))
+    blob = pack_kv(meta, rows)
     last_err = "no decode workers"
     for url in urls:
         t0 = time.monotonic()
+        if phase_hook is not None:
+            phase_hook()
         post = urllib.request.Request(
             url + "/kv_ship", data=blob, method="POST",
             headers={"Content-Type": "application/octet-stream"},
@@ -131,6 +143,13 @@ def ship_to_decode(urls: List[str], req, first_token: int, rows,
         ship_ms = (time.monotonic() - t0) * 1e3
         if counters is not None:
             counters.observe_hist("kv_ship_ms", ship_ms)
+        if ship_sid:
+            T.child_span("kv_ship", t0, trace_id=tid,
+                         parent_id=getattr(req, "parent_span", ""),
+                         span_id=ship_sid, cat="serving",
+                         args={"req_id": req.req_id, "url": url,
+                               "tokens": int(cursor),
+                               "ship_ms": round(ship_ms, 3)})
         if not ack.get("ok"):
             last_err = f"ship rejected by {url}: {ack}"
             continue

@@ -57,6 +57,217 @@ def _journal_events(journal_dir: str) -> List[dict]:
     return events
 
 
+def _poll_requests(telemetry_url: str, want_completed: int,
+                   deadline_s: float = 45.0) -> Optional[dict]:
+    """Poll the fleet /requests assembler until it holds `want_completed`
+    completed timelines (late-arriving spans merge in, so keep polling
+    until the view is consistent); returns the final report or None."""
+    t0 = time.monotonic()
+    report = None
+    while time.monotonic() - t0 < deadline_s:
+        try:
+            with urllib.request.urlopen(telemetry_url + "/requests",
+                                        timeout=10) as r:
+                report = json.loads(r.read().decode())
+        except (OSError, ValueError):
+            time.sleep(0.5)
+            continue
+        if report.get("completed_total", 0) >= want_completed and not any(
+                t.get("partial") for t in report.get("requests", ())):
+            return report
+        time.sleep(0.5)
+    return report
+
+
+def _assert_stitched(report: dict, requests: int) -> List[str]:
+    """The trace drill's acceptance: 100% of completed requests stitched
+    across >= 2 processes with zero orphan spans; failover victims carry
+    the requeue + warm-graft spans."""
+    failures: List[str] = []
+    rows = report.get("requests") or []
+    if report.get("completed_total", 0) < requests:
+        failures.append(
+            f"only {report.get('completed_total')}/{requests} requests "
+            "assembled into completed traces")
+    not_stitched = [t["req_id"] for t in rows if len(t.get("processes", ())) < 2]
+    if not_stitched:
+        failures.append(f"single-process traces (not stitched): {not_stitched}")
+    orphaned = [t["req_id"] for t in rows
+                if t.get("orphans", 0) or t.get("partial")]
+    if orphaned:
+        failures.append(f"partial/orphaned traces: {orphaned}")
+    flagged = (report.get("tail") or {}).get("flagged") or []
+    victims = [t for t in flagged if t.get("requeues", 0) > 0]
+    if not victims:
+        failures.append("tail sampler retained no failover-touched request")
+    for t in victims:
+        names = {s["name"] for s in t.get("spans", ())}
+        if not {"requeue", "warm_graft"} <= names:
+            failures.append(
+                f"failover victim {t['req_id']} trace lacks the requeue/"
+                f"warm_graft spans (saw {sorted(names)})")
+    return failures
+
+
+def run_induced_tail_drill(timeout_s: float = 240.0, slow_ms: int = 600,
+                           start_after_s: float = 35.0,
+                           threshold_ms: float = 250.0,
+                           max_new: int = 16) -> Dict:
+    """The induced-tail half of `--trace-drill`: a CLEAN disaggregated
+    fleet (no kills) with `slow_serve@phase=kv_ship:start_after=S` armed —
+    ships pass undelayed for the first S seconds (boot churn + jit
+    compiles), then every ship pays `slow_ms`.  A tight request-latency
+    SLO must breach with the journaled `slo_breach` naming kv_ship as the
+    dominant phase (the attribution windows on the violation start — the
+    requests that CAUSED it).  The compile era can honestly breach the
+    rule too (first requests take seconds); that breach clears during the
+    post-warmup fast window (clear_s << start_after), and the drill
+    asserts on the breach the INDUCED window drives."""
+    failures: List[str] = []
+    metrics: Dict = {"slow_ms": slow_ms, "start_after_s": start_after_s,
+                     "threshold_ms": threshold_ms}
+    tmp = tempfile.mkdtemp(prefix="kft-trace-slo-drill-")
+    jdir = os.path.join(tmp, "journal")
+    slo_file = os.path.join(tmp, "slo.json")
+    with open(slo_file, "w") as f:
+        json.dump({"rules": [{
+            "name": "drill_request_latency_p99",
+            "metric": "hist:request_latency_ms:p99",
+            "op": "<=", "threshold": threshold_ms,
+            "sustain_s": 3.0, "clear_s": 4.0, "severity": "page",
+            "description": "trace drill: request p99 stays under the "
+                           "threshold (the induced kv_ship delay breaches)",
+        }]}, f)
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        KFT_FAULT_PLAN=(f"slow_serve@phase=kv_ship:ms={slow_ms}"
+                        f":tier=prefill:start_after={start_after_s:g}"),
+        KFT_JOURNAL_DIR=jdir,
+        KFT_SLO_FILE=slo_file,
+        KFT_TS_INTERVAL_S="0.5",
+        KFT_TRACE_BUFFER="65536",
+    )
+    env.pop("XLA_FLAGS", None)
+    cmd = [
+        sys.executable, "-m", "kungfu_tpu.serving", "-np", "3",
+        "--min-size", "3", "--max-size", "3", "--platform", "cpu",
+        "--preset", "tiny", "--slots", "2", "--prefill-ranks", "1",
+        "--no-autoscale", "--telemetry",
+        "--timeout", str(int(timeout_s)), "-q",
+    ]
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    lines: List[str] = []
+    pump = threading.Thread(
+        target=lambda: [lines.append(ln) for ln in proc.stdout], daemon=True
+    )
+    pump.start()
+
+    def find(pattern: str, deadline_s: float = 60.0) -> Optional[str]:
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < deadline_s:
+            for line in list(lines):
+                m = re.search(pattern, line)
+                if m:
+                    return m.group(1)
+            if proc.poll() is not None:
+                return None
+            time.sleep(0.1)
+        return None
+
+    breach = None
+    sent = [0]
+    try:
+        serve_url = find(r"SERVE_URL: (\S+)")
+        if not serve_url:
+            failures.append("fleet never printed SERVE_URL")
+            return {"ok": False, "failures": failures,
+                    "output_tail": "".join(lines)[-3000:], **metrics}
+        client = _Client(serve_url)
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 90:
+            try:
+                with urllib.request.urlopen(serve_url + "/stats",
+                                            timeout=3) as r:
+                    st = json.loads(r.read().decode())
+                if sum(1 for w in st["workers"].values()
+                       if w["healthy"]) >= 3:
+                    break
+            except (OSError, ValueError):
+                pass
+            time.sleep(0.25)
+
+        # two closed-loop clients keep fresh latency samples flowing:
+        # ships stay undelayed through the start_after grace (compile +
+        # warmup), then pay the kv_ship delay and sustain the violation
+        stop = threading.Event()
+
+        def loop(i: int) -> None:
+            k = 0
+            while not stop.is_set():
+                try:
+                    client.generate([1 + (k + i) % 5, 2, 3], max_new,
+                                    timeout_s=60)
+                    sent[0] += 1
+                except OSError:
+                    time.sleep(0.2)
+                k += 1
+
+        clients = [threading.Thread(target=loop, args=(i,), daemon=True)
+                   for i in range(2)]
+        for t in clients:
+            t.start()
+        # wait for the breach the INDUCED window drives (a compile-era
+        # breach may come first — it clears during the fast window and
+        # carries a different attribution; keep the last breach as the
+        # fallback evidence either way)
+        deadline = time.monotonic() + min(150.0, timeout_s - 10)
+        while time.monotonic() < deadline:
+            for e in _journal_events(jdir):
+                if (e.get("event") == "slo_breach"
+                        and "request_latency" in str(e.get("rule", ""))):
+                    breach = e
+                    if e.get("dominant_phase") == "kv_ship":
+                        break
+            if breach is not None and breach.get("dominant_phase") == "kv_ship":
+                break
+            time.sleep(0.5)
+        stop.set()
+        for t in clients:
+            t.join(timeout=70)
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+        pump.join(timeout=5)
+
+    metrics["requests_sent"] = sent[0]
+    events = _journal_events(jdir)
+    if not any(e.get("event") == "chaos_slow_serve" for e in events):
+        failures.append("the slow_serve@phase=kv_ship window never armed "
+                        "(no chaos_slow_serve journal event)")
+    if breach is None:
+        failures.append("no slo_breach journal event for the "
+                        "request-latency rule despite the induced "
+                        "kv_ship delay")
+    else:
+        metrics["slo_breach_value_ms"] = breach.get("value")
+        metrics["slo_breach_dominant_phase"] = breach.get("dominant_phase")
+        metrics["slo_breach_phase_fracs"] = breach.get("phase_p99_fracs")
+        if breach.get("dominant_phase") != "kv_ship":
+            failures.append(
+                "SLO breach attributed the wrong dominant phase: "
+                f"{breach.get('dominant_phase')!r} (induced delay was "
+                "in kv_ship)")
+    return {"ok": not failures, "failures": failures,
+            "output_tail": "".join(lines)[-3000:] if failures else "",
+            **metrics}
+
+
 class _Client:
     def __init__(self, url: str):
         self.url = url
@@ -83,7 +294,8 @@ class _Client:
 def run_serve_drill(np: int = 2, buddy: str = "on", timeout_s: float = 300.0,
                     requests: int = 12, max_new: int = 16,
                     crash_tokens: int = 24, p99_bound_s: float = 60.0,
-                    skip_autoscale: bool = False, tier: str = "") -> Dict:
+                    skip_autoscale: bool = False, tier: str = "",
+                    trace: bool = False) -> Dict:
     """Run the drill; returns {"ok": bool, "failures": [...], metrics...}.
 
     `tier="prefill"|"decode"` runs the DISAGGREGATED variant: a 3-rank
@@ -93,10 +305,19 @@ def run_serve_drill(np: int = 2, buddy: str = "on", timeout_s: float = 300.0,
     re-queues); a decode kill fires mid-stream with shipped-KV requests
     decoding (the prefill worker's proxy read dies, surfaces as a failed
     dispatch, re-queues).  Either way: zero drops, bounded p99,
-    `rank_rejoined` journaled by the respawned victim."""
+    `rank_rejoined` journaled by the respawned victim.
+
+    `trace=True` runs the distributed-tracing variant on top (half of the
+    `--trace-drill` stage, docs/observability.md "Request tracing"): every
+    completed request must assemble into a stitched multi-process trace on
+    the fleet `/requests` endpoint (>= 2 process lanes, zero orphan spans,
+    not partial; failover victims carry the requeue + warm_graft spans).
+    The induced-tail half (slow_serve -> SLO breach attribution) is
+    `run_induced_tail_drill` — a separate clean fleet, so failover churn
+    cannot pollute the breach's phase attribution."""
     failures: List[str] = []
     metrics: Dict = {"np": np, "buddy": buddy, "requests": requests,
-                     "tier": tier}
+                     "tier": tier, "trace": trace}
 
     prefill_ranks = 0
     if tier:
@@ -118,12 +339,17 @@ def run_serve_drill(np: int = 2, buddy: str = "on", timeout_s: float = 300.0,
         JAX_PLATFORMS="cpu",
         KFT_FAULT_PLAN=plan,
         KFT_JOURNAL_DIR=jdir,
+        # failover churn must not wrap the router's span ring mid-drill —
+        # the stitching assertions need every route span still resident
+        KFT_TRACE_BUFFER="65536",
         # aggressive autoscale windows so the drill finishes in seconds
         KFT_SERVE_SCALE_UP_DEPTH="3",
         KFT_SERVE_SCALE_UP_TICKS="2",
         KFT_SERVE_SCALE_DOWN_TICKS="6",
         KFT_SERVE_TICK_S="0.25",
     )
+    if trace:
+        assert tier, "the trace drill needs a tiered fleet (tier=decode)"
     env.pop("XLA_FLAGS", None)
     if buddy == "off":
         env["KFT_BUDDY"] = "0"
@@ -250,6 +476,27 @@ def run_serve_drill(np: int = 2, buddy: str = "on", timeout_s: float = 300.0,
                 break
             time.sleep(0.5)
         metrics["rejoin_visible_s"] = round(time.monotonic() - t0, 3)
+
+        # ---- tracing: stitched cross-process timelines + tail SLO ------------
+        telemetry_url = find(r"TELEMETRY_URL: (\S+)", 5.0)
+        if telemetry_url:
+            report = _poll_requests(telemetry_url, requests,
+                                    deadline_s=45.0 if trace else 15.0)
+            if report is None:
+                if trace:
+                    failures.append("fleet /requests never assembled "
+                                    f"{requests} completed request traces")
+            else:
+                metrics["traces_completed"] = report.get("completed_total")
+                metrics["traces_partial"] = report.get("partial_total")
+                att = report.get("attribution") or {}
+                if att:
+                    metrics["request_attribution"] = att
+                if trace:
+                    failures.extend(_assert_stitched(report, requests))
+        elif trace:
+            failures.append("fleet never printed TELEMETRY_URL "
+                            "(trace drill needs --telemetry)")
 
         # ---- phase C: autoscale down then up ---------------------------------
         if not skip_autoscale:

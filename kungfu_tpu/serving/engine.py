@@ -62,7 +62,7 @@ import numpy as np
 
 from ..models.transformer import TransformerConfig, TransformerLM
 from ..utils import get_logger
-from ..utils.trace import trace_scope
+from ..utils.trace import TraceContext, child_span, trace_context, trace_scope
 from .queue import AdmissionQueue
 from .request import Request, Result
 from .slots import (
@@ -316,20 +316,34 @@ class ServingEngine:
                 return b
         raise ValueError(f"no prefill bucket fits {n} tokens")
 
+    def _req_ctx(self, req: Request) -> Optional[TraceContext]:
+        """This request's hop context (the dispatcher's route span — or the
+        shipping rank's kv_ship span — is the parent), or None untraced."""
+        if not req.trace_id:
+            return None
+        return TraceContext(req.trace_id, req.parent_span)
+
     def _admit(self, req: Request) -> None:
         slot = self.slot_mgr.allocate(req)
         assert slot is not None
+        ctx = self._req_ctx(req)
+        if ctx is not None:
+            child_span("queue:wait", req.queued_t, trace_id=ctx.trace_id,
+                       parent_id=ctx.span_id, cat="serving",
+                       args={"req_id": req.req_id, "slot": slot})
         graft = self._grafts.pop(req.req_id, None)
         if graft is not None:
             self._admit_prefilled(slot, req, *graft)
             return
         toks = req.prefill_tokens
-        first, small, total, hit = self._run_prefill(toks, req.temperature)
+        with trace_context(ctx):
+            first, small, total, hit = self._run_prefill(toks, req.temperature)
         self.cache = write_slot(self.cache, small, slot)
         self._cursor[slot] = total
         if self.spec is not None:
             self.spec.prefill_slot(slot, toks)
         req.ttft_s = time.monotonic() - req.submitted_t
+        req.decode_t0 = time.monotonic()
         self._observe("ttft_ms", req.ttft_s * 1e3)
         self._push_token(slot, req, int(first))
 
@@ -384,7 +398,8 @@ class ServingEngine:
         if len(req.prefill_tokens) > self.buckets[-1]:
             raise ValueError("prompt longer than the largest prefill bucket")
         toks = req.prefill_tokens
-        first, small, total, hit = self._run_prefill(toks, req.temperature)
+        with trace_context(self._req_ctx(req)):
+            first, small, total, hit = self._run_prefill(toks, req.temperature)
         return int(first), extract_rows(small, total), total, hit
 
     def _admit_prefilled(self, slot: int, req: Request, meta: dict,
@@ -395,16 +410,19 @@ class ServingEngine:
         total = int(meta["cursor"])
         first = int(meta["first_token"])
         t0 = time.monotonic()
-        with trace_scope("serve:kv_graft", cat="serving",
-                         args={"tokens": total}):
-            small = warm_small_cache(self._small_cache0, rows, total)
-            self.cache = write_slot(self.cache, small, slot)
+        with trace_context(self._req_ctx(req)):
+            with trace_scope("serve:kv_graft", cat="serving",
+                             args={"tokens": total,
+                                   "req_id": req.req_id}):
+                small = warm_small_cache(self._small_cache0, rows, total)
+                self.cache = write_slot(self.cache, small, slot)
         self._cursor[slot] = total
         if self.spec is not None:
             self.spec.prefill_slot(slot, req.prefill_tokens)
         # TTFT: the first token was produced on the prefill rank; local
         # queue wait still counts (submitted_t is decode-side receipt)
         req.ttft_s = time.monotonic() - req.submitted_t
+        req.decode_t0 = time.monotonic()
         self._observe("ttft_ms", req.ttft_s * 1e3)
         self._observe("kv_graft_ms", (time.monotonic() - t0) * 1e3)
         self._push_token(slot, req, first)
@@ -413,15 +431,25 @@ class ServingEngine:
         if self._spec_step_ok():
             return self._spec_decode_step()
         toks = jnp.asarray(self._next_tok[:, None])
-        with trace_scope("serve:decode", cat="serving",
-                         args={"active": self.slot_mgr.active_count}):
+        active = sorted(self.slot_mgr.active().items())
+        targs: Dict[str, Any] = {"active": len(active)}
+        ids = [r.trace_id for _, r in active if r.trace_id]
+        if ids:
+            # batch-level span: one decode round serves many requests, so
+            # it carries the traces it advanced as links instead of
+            # belonging to one tree; the assembler counts it as a decode
+            # round for each listed trace
+            targs["trace_ids"] = ids
+        with trace_scope("serve:decode", cat="serving", args=targs,
+                         track=bool(ids)):
             t0 = time.monotonic()
             logits, self.cache = self._decode(self.params, self.cache, toks)
             logits = np.asarray(logits)
             dt = time.monotonic() - t0
         self._observe("tok_latency_ms", dt * 1e3)
         self._cursor += 1  # every row consumed one token (free rows too)
-        active = sorted(self.slot_mgr.active().items())
+        for _, r in active:
+            r.decode_rounds += 1
         if self.spec is not None:
             # the target advanced without the draft: those slots' draft
             # caches are behind until their next admission
@@ -466,12 +494,19 @@ class ServingEngine:
         correctness."""
         k = self.spec.k
         t0_toks = self._next_tok.copy()
-        with trace_scope("serve:draft", cat="serving", args={"k": k}):
+        active = sorted(self.slot_mgr.active().items())
+        ids = [r.trace_id for _, r in active if r.trace_id]
+        dargs: Dict[str, Any] = {"k": k}
+        vargs: Dict[str, Any] = {"active": len(active), "k": k}
+        if ids:
+            dargs["trace_ids"] = ids
+            vargs["trace_ids"] = ids
+        with trace_scope("serve:draft", cat="serving", args=dargs,
+                         track=bool(ids)):
             proposals = self.spec.propose(t0_toks, self._cursor)
         ver = np.concatenate([t0_toks[:, None], proposals], axis=1)
-        with trace_scope("serve:verify", cat="serving",
-                         args={"active": self.slot_mgr.active_count,
-                               "k": k}):
+        with trace_scope("serve:verify", cat="serving", args=vargs,
+                         track=bool(ids)):
             t0 = time.monotonic()
             g_dev, n_acc_dev, self.cache = self._verify(
                 self.params, self.cache, jnp.asarray(ver.astype(np.int32)),
@@ -480,12 +515,19 @@ class ServingEngine:
             g = np.asarray(g_dev)
             n_acc = np.asarray(n_acc_dev)
             dt = time.monotonic() - t0
+            if ids:
+                # per-round acceptance, aligned with trace_ids (args is
+                # serialized at scrape time, so filling it here is visible)
+                vargs["accepted"] = [int(n_acc[s]) for s, r in active
+                                     if r.trace_id]
         self._observe("tok_latency_ms", dt * 1e3)
         # every slot's cursor (free rows included) moved to committed
         # length: + accepted drafts + the correction token
         self._cursor = self._cursor + n_acc + 1
+        for _, r in active:
+            r.decode_rounds += 1
         done: List[Result] = []
-        for slot, req in sorted(self.slot_mgr.active().items()):
+        for slot, req in active:
             budget = req.remaining_new_tokens - len(req.generated)
             run: List[int] = []
             for j in range(int(n_acc[slot]) + 1):
@@ -495,7 +537,8 @@ class ServingEngine:
                                           and tok == req.eos_id):
                     break
             if self.spec.slot_ready(slot):
-                self.spec.observe(slot, int(n_acc[slot]), len(run))
+                self.spec.observe(slot, int(n_acc[slot]), len(run),
+                                  trace_id=req.trace_id)
             for tok in run:
                 finished = self._push_token(slot, req, tok, from_decode=True)
                 if finished is not None:
@@ -533,6 +576,16 @@ class ServingEngine:
     def _finish(self, req: Request, status: str) -> Result:
         self._grafts.pop(req.req_id, None)  # expired-before-admission ship
         req.finished_t = time.monotonic()
+        if req.trace_id and req.decode_t0 is not None:
+            # the per-request decode phase: first new token -> completion,
+            # aggregated over every batch round that advanced this slot
+            child_span("decode", req.decode_t0, req.finished_t,
+                       trace_id=req.trace_id, parent_id=req.parent_span,
+                       cat="serving",
+                       args={"req_id": req.req_id,
+                             "tokens": len(req.generated),
+                             "rounds": req.decode_rounds,
+                             "status": status})
         lat = (req.finished_t - req.submitted_t) * 1e3
         result = Result(
             req_id=req.req_id,

@@ -299,10 +299,16 @@ class PrefixCache:
             self.evictions += 1
         if evicted_tokens:
             from ..monitor.journal import journal_event
+            from ..utils.trace import current_context
 
+            # evictions run inside the admitting request's insert, so the
+            # trace_id names the request whose admission forced them — the
+            # offline journal+trace join (`--merge`) hangs on this stamp
+            ctx = current_context()
             journal_event("prefix_evicted", tokens=evicted_tokens,
                           bytes=evicted_bytes,
-                          cache_bytes=self.total_bytes, budget=self.budget)
+                          cache_bytes=self.total_bytes, budget=self.budget,
+                          trace_id=ctx.trace_id if ctx else "")
             self._count("prefix_evicted")
 
     # -- invalidation ---------------------------------------------------------------

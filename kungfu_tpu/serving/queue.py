@@ -37,6 +37,7 @@ class AdmissionQueue:
         with self._lock:
             if len(self._q) >= self.capacity:
                 return False
+            req.queued_t = time.monotonic()  # queue:wait span anchor
             self._q.append(req)
             self._lock.notify()
             return True
@@ -50,6 +51,7 @@ class AdmissionQueue:
         with self._lock:
             if count:
                 req.requeues += 1
+            req.queued_t = time.monotonic()  # new wait interval starts here
             self._q.appendleft(req)
             self._lock.notify()
 

@@ -39,11 +39,22 @@ class Request:
     deadline_s: float = 0.0               # 0: no deadline
     prior_tokens: Tuple[int, ...] = ()    # warm-resume: already generated
     submitted_t: float = dataclasses.field(default_factory=time.monotonic)
+    # distributed trace context (utils.trace): trace_id names the request's
+    # trace end to end; parent_span is the CALLER's span for the current hop
+    # (re-stamped per dispatch/ship) — the in-band fallback when a hop's
+    # traceparent header is absent
+    trace_id: str = ""
+    parent_span: str = ""
     # filled in by the engine
     generated: List[int] = dataclasses.field(default_factory=list)
     ttft_s: Optional[float] = None        # first NEW token (prefill done)
     finished_t: Optional[float] = None
     requeues: int = 0                     # times re-queued after a rank loss
+    # local bookkeeping (never serialized): last queue-entry stamp (queue
+    # wait spans), decode-phase start, decode/verify rounds consumed
+    queued_t: float = dataclasses.field(default_factory=time.monotonic)
+    decode_t0: Optional[float] = None
+    decode_rounds: int = 0
 
     def __post_init__(self):
         if not self.req_id:
@@ -85,6 +96,8 @@ class Request:
             "deadline_s": self.deadline_s,
             "prior_tokens": list(self.prior_tokens),
             "requeues": self.requeues,
+            "trace_id": self.trace_id,
+            "parent_span": self.parent_span,
         }
 
     @classmethod
@@ -98,6 +111,8 @@ class Request:
             deadline_s=float(d.get("deadline_s", 0.0)),
             prior_tokens=tuple(d.get("prior_tokens", ())),
             requeues=int(d.get("requeues", 0)),
+            trace_id=str(d.get("trace_id", "")),
+            parent_span=str(d.get("parent_span", "")),
         )
 
 

@@ -31,6 +31,7 @@ from ..elastic.config_client import ConfigClient
 from ..monitor.journal import journal_event
 from ..plan import Cluster, PeerID
 from ..utils import get_logger
+from ..utils import trace as T
 from .queue import AdmissionQueue
 from .request import Request, Result
 
@@ -114,7 +115,16 @@ class Router:
 
     def submit(self, req: Request) -> bool:
         """False = backpressure (queue full)."""
-        holder = {"event": threading.Event(), "result": None}
+        holder: Dict[str, object] = {"event": threading.Event(),
+                                     "result": None,
+                                     "t0": time.monotonic()}
+        if T.enabled():
+            # the request's distributed trace starts (or continues — a
+            # client-supplied trace_id is honored) at the front door; the
+            # root span id is allocated now and recorded at delivery
+            req.trace_id = req.trace_id or T.new_trace_id()
+            holder["root"] = T.new_span_id()
+            holder["inbound_parent"] = req.parent_span
         with self._lock:
             self._results[req.req_id] = holder
         if not self.queue.put(req):
@@ -123,6 +133,15 @@ class Router:
             return False
         self._gauge()
         return True
+
+    def _trace_ids(self, req: Request) -> tuple:
+        """(trace_id, root_span_id) for a live request, or ("", "")."""
+        with self._lock:
+            holder = self._results.get(req.req_id)
+        root = str(holder.get("root", "")) if holder else ""
+        if req.trace_id and root:
+            return req.trace_id, root
+        return "", ""
 
     def wait(self, req_id: str, timeout_s: float) -> Optional[Result]:
         with self._lock:
@@ -140,6 +159,17 @@ class Router:
         if holder is not None:
             holder["result"] = result
             holder["event"].set()
+        if holder is not None and req.trace_id and holder.get("root"):
+            # the root "request" span closes the trace: submit -> delivery,
+            # every other span of this request parents under it (directly
+            # or via a dispatched hop)
+            T.child_span(
+                "request", float(holder["t0"]), trace_id=req.trace_id,
+                parent_id=str(holder.get("inbound_parent", "")),
+                span_id=str(holder["root"]), cat="serving",
+                args={"req_id": req.req_id, "status": result.status,
+                      "requeues": result.requeues},
+            )
         if result.status == "ok":
             self.completed += 1
             self._count("requests_completed")
@@ -148,12 +178,22 @@ class Router:
                 # request_requeued) is the request-visible recovery window
                 journal_event("requeued_request_completed",
                               req_id=req.req_id, requeues=result.requeues,
-                              latency_ms=result.latency_ms)
+                              latency_ms=result.latency_ms,
+                              trace_id=req.trace_id)
             if self.counters is not None and result.ttft_ms is not None:
                 self.counters.observe_hist("ttft_ms", result.ttft_ms)
-            if self.counters is not None and result.latency_ms is not None:
-                self.counters.observe_hist("request_latency_ms",
-                                           result.latency_ms)
+            if self.counters is not None:
+                # CLIENT-visible latency: submit -> delivery, covering the
+                # router queue, every dispatch attempt and (on tiered
+                # fleets) the prefill + ship hops.  The worker-side number
+                # in result.latency_ms starts at WORKER receipt — an SLO on
+                # "request latency" that missed the router queue and the
+                # kv_ship hop would watch the wrong thing
+                t0 = holder.get("t0") if holder is not None else None
+                lat_ms = ((time.monotonic() - float(t0)) * 1e3
+                          if t0 is not None else result.latency_ms)
+                if lat_ms is not None:
+                    self.counters.observe_hist("request_latency_ms", lat_ms)
         else:
             self.expired += 1
             self._count("requests_expired")
@@ -212,6 +252,11 @@ class Router:
                         req_id=req.req_id, tokens=tuple(req.prompt),
                         status="expired", requeues=req.requeues))
                     continue
+                tid, root = self._trace_ids(req)
+                if tid:
+                    T.child_span("queue:wait", req.queued_t, trace_id=tid,
+                                 parent_id=root, cat="serving",
+                                 args={"req_id": req.req_id})
                 with self._lock:
                     self._active += 1
                 try:
@@ -225,17 +270,41 @@ class Router:
             self._gauge()
 
     def _dispatch_one(self, w: WorkerRef, req: Request) -> None:
+        tid, root = self._trace_ids(req)
+        route_sid = T.new_span_id() if tid else ""
+        if tid:
+            # the route span is the worker subtree's parent: it crosses the
+            # process boundary as a traceparent header (and, belt and
+            # braces, in the request body)
+            req.parent_span = route_sid
+        headers = {"Content-Type": "application/json"}
+        if tid:
+            headers[T.TRACEPARENT_HEADER] = T.format_traceparent(
+                T.TraceContext(tid, route_sid))
         body = json.dumps(req.to_json()).encode()
         http_req = urllib.request.Request(
-            w.url + "/generate", data=body, method="POST",
-            headers={"Content-Type": "application/json"},
+            w.url + "/generate", data=body, method="POST", headers=headers,
         )
+        t_route = time.monotonic()
+        outcome = {"peer": str(w.peer)}
+        if w.tier:
+            outcome["tier"] = w.tier
+        try:
+            self._route_one(w, req, http_req, outcome)
+        finally:
+            if tid:
+                T.child_span("route", t_route, trace_id=tid, parent_id=root,
+                             span_id=route_sid, cat="serving", args=outcome)
+
+    def _route_one(self, w: WorkerRef, req: Request, http_req,
+                   outcome: Dict[str, str]) -> None:
         try:
             with urllib.request.urlopen(
                 http_req, timeout=self.request_timeout_s
             ) as r:
                 doc = json.loads(r.read().decode())
         except urllib.error.HTTPError as e:
+            outcome["outcome"] = f"http_{e.code}"
             if e.code in (400,):  # semantically rejected: not a worker loss
                 self._deliver(req, Result(
                     req_id=req.req_id, tokens=tuple(req.prompt),
@@ -264,8 +333,10 @@ class Router:
             self._requeue_after_failure(w, req, f"HTTP {e.code}")
             return
         except OSError as e:
+            outcome["outcome"] = "dispatch_failed"
             self._requeue_after_failure(w, req, str(e)[:120])
             return
+        outcome["outcome"] = "ok"
         self._deliver(req, Result(
             req_id=doc["id"], tokens=tuple(doc["tokens"]),
             status=doc.get("status", "ok"), ttft_ms=doc.get("ttft_ms"),
@@ -298,7 +369,16 @@ class Router:
         journal_event("request_requeued", req_id=req.req_id,
                       peer=str(dead) if dead is not None else "?",
                       error=err, decode_loss=True,
-                      warm_tokens=len(req.prior_tokens) if resumed else 0)
+                      warm_tokens=len(req.prior_tokens) if resumed else 0,
+                      trace_id=req.trace_id)
+        self._trace_requeue(req, str(dead) if dead is not None else "?",
+                            resumed, decode_loss=True)
+        # beat before re-queueing: the prefill proxy stays healthy, so a
+        # requeue-front would redispatch within milliseconds and re-run the
+        # WHOLE prefill + ship against a still-dead decode pool — a hot
+        # loop that burned thousands of wasted prefills (and buddy warm
+        # fetches, and router spans) per outage before this pause
+        time.sleep(0.25)
         self.queue.requeue(req)
 
     def _requeue_after_failure(self, w: WorkerRef, req: Request,
@@ -318,13 +398,43 @@ class Router:
         self._count("requests_requeued")
         journal_event("request_requeued", req_id=req.req_id,
                       peer=str(w.peer), error=err,
-                      warm_tokens=len(req.prior_tokens) if resumed else 0)
+                      warm_tokens=len(req.prior_tokens) if resumed else 0,
+                      trace_id=req.trace_id)
+        self._trace_requeue(req, str(w.peer), resumed)
         self.queue.requeue(req)
+
+    def _trace_requeue(self, req: Request, peer: str, resumed: bool,
+                       decode_loss: bool = False) -> None:
+        """Stamp the failover into the request's trace: an instant
+        `requeue` marker under the root span (the warm_graft span records
+        the buddy fetch itself, _recover_warm)."""
+        tid, root = self._trace_ids(req)
+        if not tid:
+            return
+        args = {"req_id": req.req_id, "peer": peer, "warm": resumed}
+        if decode_loss:
+            args["decode_loss"] = True
+        now = time.monotonic()
+        T.child_span("requeue", now, now, trace_id=tid, parent_id=root,
+                     cat="serving", args=args)
 
     def _recover_warm(self, dead: PeerID, req: Request) -> bool:
         """Pull the dead rank's warm set from its ring buddy; on a hit the
         request resumes from prompt+generated (greedy decode is
         deterministic, so the re-prefill rebuilds the exact KV rows)."""
+        tid, root = self._trace_ids(req)
+        t0 = time.monotonic()
+        hit = self._recover_warm_inner(dead, req)
+        if tid:
+            T.child_span("warm_graft", t0, trace_id=tid, parent_id=root,
+                         cat="serving",
+                         args={"req_id": req.req_id, "origin": str(dead),
+                               "hit": hit,
+                               "warm_tokens": len(req.prior_tokens)
+                               if hit else 0})
+        return hit
+
+    def _recover_warm_inner(self, dead: PeerID, req: Request) -> bool:
         with self._lock:
             buddy = self._buddy_of.get(dead)
             bw = self._workers.get(buddy) if buddy is not None else None
@@ -339,11 +449,26 @@ class Router:
                 items = json.loads(r.read().decode()).get("items", [])
         except (OSError, ValueError):
             return False
+        return self._merge_warm(req, items)
+
+    @staticmethod
+    def _merge_warm(req: Request, items) -> bool:
         for it in items:
             if it.get("id") == req.req_id and it.get("generated"):
-                prior = tuple(req.prior_tokens) + tuple(it["generated"])
+                # the snapshot's own stream position: prior_tokens AT SHIP
+                # TIME + what the dead rank generated since.  A repeated
+                # failover can serve a STALE snapshot (shipped before an
+                # earlier resume already folded these tokens into
+                # req.prior_tokens); both are prefixes of the same
+                # deterministic greedy stream, so only a strictly longer
+                # snapshot is new progress — appending blindly would
+                # duplicate the overlap into the output
+                candidate = (tuple(int(t) for t in it.get("prior_tokens", ()))
+                             + tuple(int(t) for t in it["generated"]))
+                if len(candidate) <= len(req.prior_tokens):
+                    return False
                 # cap: never resume past the request's budget
-                req.prior_tokens = prior[: req.max_new_tokens]
+                req.prior_tokens = candidate[: req.max_new_tokens]
                 return True
         return False
 

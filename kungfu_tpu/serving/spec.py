@@ -207,9 +207,12 @@ class SpecDecoder:
         )
         return np.asarray(drafts)[:, : self.k - 1]
 
-    def observe(self, slot: int, accepted: int, committed: int) -> None:
+    def observe(self, slot: int, accepted: int, committed: int,
+                trace_id: str = "") -> None:
         """Per-slot acceptance bookkeeping after a verify round; disables
-        the slot (journaled once) when its acceptance EMA collapses."""
+        the slot (journaled once) when its acceptance EMA collapses.
+        `trace_id` names the request decoding in the slot so a collapse is
+        attributable to the request whose stream caused it."""
         frac = accepted / max(1, self.k - 1)
         self.rounds += 1
         self.accepted_tokens += accepted
@@ -232,7 +235,8 @@ class SpecDecoder:
 
             journal_event("spec_disabled", slot=int(slot),
                           accept_ema=round(float(self._ema[slot]), 4),
-                          rounds=int(self._rounds[slot]))
+                          rounds=int(self._rounds[slot]),
+                          trace_id=trace_id)
             if self.counters is not None:
                 self.counters.inc_event("spec_disabled")
             log.info("spec disabled on slot %d (accept ema %.3f)",

@@ -60,6 +60,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 
 from ..utils import get_logger
+from ..utils import trace as T
 
 log = get_logger("kungfu.serving")
 
@@ -295,9 +296,16 @@ class ServingWorker:
                  else self.engine.total_tokens)
         self.injector.on_serve_tokens(total, self.rank, tier=self.tier)
 
+    def _chaos_phase(self, phase: str) -> None:
+        """slow_serve@phase=... hook: an armed per-phase delay sleeps here,
+        just before the named serving phase runs (chaos/plan.py)."""
+        if self.injector is not None:
+            self.injector.on_serve_phase(phase, self.rank, tier=self.tier)
+
     def _engine_loop(self) -> None:
         last_ship = 0.0
         while not self._stop.is_set():
+            self._chaos_phase("decode")
             done = self.engine.step()
             self._chaos_tick()
             now = time.monotonic()
@@ -402,6 +410,13 @@ class ServingWorker:
                 t0 = time.monotonic()
                 try:
                     req = Request.from_json(meta["request"])
+                    # re-parent to the shipping rank's kv_ship span (the
+                    # cross-process hop context rides in the blob meta), so
+                    # this rank's graft/decode spans chain under the ship
+                    ctx = T.parse_traceparent(meta.get("traceparent", ""))
+                    if ctx is not None:
+                        req.trace_id = req.trace_id or ctx.trace_id
+                        req.parent_span = ctx.span_id
                     pending = outer.engine.submit_prefilled(req, meta, rows)
                 except BackpressureError as e:
                     self._send(503, json.dumps({"error": str(e)}).encode())
@@ -414,11 +429,20 @@ class ServingWorker:
                 journal_event("kv_shipped", req_id=req.req_id,
                               tokens=int(meta.get("cursor", 0)),
                               origin_rank=int(meta.get("origin_rank", -1)),
-                              rank=outer.rank,
+                              rank=outer.rank, trace_id=req.trace_id,
                               admit_ms=round((time.monotonic() - t0) * 1e3, 3))
                 if outer.counters is not None:
                     outer.counters.inc_event("kv_ships_received")
                 self._send(200, b'{"ok": true}')
+
+            def _trace_ctx(self, req) -> None:
+                """Adopt the dispatching hop's context: the traceparent
+                header wins, the request-body fields are the fallback."""
+                ctx = T.parse_traceparent(
+                    self.headers.get(T.TRACEPARENT_HEADER, ""))
+                if ctx is not None:
+                    req.trace_id = req.trace_id or ctx.trace_id
+                    req.parent_span = ctx.span_id
 
             def _handle_prefill_generate(self, doc: dict) -> None:
                 """Prefill tier: run the prefill half, ship KV to a decode
@@ -428,6 +452,8 @@ class ServingWorker:
 
                 try:
                     req = Request.from_json(doc)
+                    self._trace_ctx(req)
+                    outer._chaos_phase("prefill")
                     first, rows, total, hit = outer.engine.prefill_only(req)
                 except ValueError as e:
                     self._send(400, json.dumps({"error": str(e)}).encode())
@@ -442,6 +468,7 @@ class ServingWorker:
                     urls, req, first, rows, total, outer.rank,
                     result_timeout_s=outer.args.request_timeout_s,
                     counters=outer.counters,
+                    phase_hook=lambda: outer._chaos_phase("kv_ship"),
                 )
                 if result is None:
                     # a dead decode rank reads as a failed dispatch at the
@@ -477,7 +504,9 @@ class ServingWorker:
                 from .request import Request
 
                 try:
-                    pending = outer.engine.submit(Request.from_json(doc))
+                    req = Request.from_json(doc)
+                    self._trace_ctx(req)
+                    pending = outer.engine.submit(req)
                 except BackpressureError as e:
                     self._send(503, json.dumps({"error": str(e)}).encode())
                     return

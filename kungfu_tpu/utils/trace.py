@@ -25,6 +25,18 @@ which case it records a span (and logs enter/exit) and, with device=True,
 also opens a `jax.profiler.TraceAnnotation` so the scope shows up in TPU
 profiler timelines.  `profile_to(dir)` wraps a block in a full
 `jax.profiler.trace` capture.
+
+Distributed trace context (docs/observability.md "Request tracing"): a
+`TraceContext` is a (trace_id, span_id) pair in the W3C traceparent shape
+(`00-<32 hex>-<16 hex>-01`, `format_traceparent`/`parse_traceparent`) that
+rides every serving HTTP hop as a `traceparent` header.  A thread pushes a
+context with `trace_context(ctx)`; every `trace_scope` under it allocates a
+child span id and re-parents nested scopes, so one request's spans — across
+the router, a prefill rank and a decode rank — stitch into a single tree by
+(trace_id, span_id, parent_id).  `child_span` records a span under an
+explicit (possibly remote) parent for phases timed by hand.  The fleet-side
+assembler (monitor.requests) consumes each rank's /trace and stitches the
+trees into per-request timelines.
 """
 from __future__ import annotations
 
@@ -81,6 +93,78 @@ def enabled() -> bool:
     return env_flag(ENABLE_ENV)
 
 
+# -- distributed trace context ---------------------------------------------------------
+
+#: the header carrying the context across serving HTTP hops (W3C name)
+TRACEPARENT_HEADER = "traceparent"
+_HEX = frozenset("0123456789abcdef")
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceContext:
+    """One hop's position in a distributed trace: the trace and the span
+    that any child spans recorded under this context parent to."""
+
+    trace_id: str  # 32 lowercase hex chars
+    span_id: str   # 16 lowercase hex chars ("" = trace-only context)
+
+
+def new_trace_id() -> str:
+    return os.urandom(16).hex()
+
+
+def new_span_id() -> str:
+    return os.urandom(8).hex()
+
+
+def format_traceparent(ctx: TraceContext) -> str:
+    """W3C-traceparent-style wire form: `00-<trace_id>-<span_id>-01`."""
+    return f"00-{ctx.trace_id}-{ctx.span_id}-01"
+
+
+def parse_traceparent(header: Optional[str]) -> Optional[TraceContext]:
+    """TraceContext from a traceparent header, or None on any malformation
+    (a bad header degrades to an untraced request, never an error)."""
+    parts = (header or "").strip().lower().split("-")
+    if len(parts) != 4:
+        return None
+    ver, trace_id, span_id, flags = parts
+    if len(ver) != 2 or len(trace_id) != 32 or len(span_id) != 16:
+        return None
+    if not (set(ver) <= _HEX and set(trace_id) <= _HEX
+            and set(span_id) <= _HEX and set(flags) <= _HEX):
+        return None
+    if trace_id == "0" * 32 or span_id == "0" * 16:
+        return None
+    return TraceContext(trace_id=trace_id, span_id=span_id)
+
+
+_ctx_tls = threading.local()
+
+
+def current_context() -> Optional[TraceContext]:
+    """The thread's innermost active TraceContext, or None."""
+    stack = getattr(_ctx_tls, "stack", None)
+    return stack[-1] if stack else None
+
+
+@contextlib.contextmanager
+def trace_context(ctx: Optional[TraceContext]) -> Iterator[Optional[TraceContext]]:
+    """Make `ctx` the thread's current context for the block (None = no-op,
+    so callers can pass through an unparsed/absent header unconditionally)."""
+    if ctx is None:
+        yield None
+        return
+    stack = getattr(_ctx_tls, "stack", None)
+    if stack is None:
+        stack = _ctx_tls.stack = []
+    stack.append(ctx)
+    try:
+        yield ctx
+    finally:
+        stack.pop()
+
+
 @dataclasses.dataclass
 class Span:
     """One recorded scope: job-relative start + duration, both monotonic."""
@@ -92,6 +176,10 @@ class Span:
     tid: int = 0
     phase: str = "X"  # Chrome trace phase: "X" complete, "i" instant
     args: Optional[Dict[str, Any]] = None
+    # distributed trace identity; empty on purely-local spans
+    trace_id: str = ""
+    span_id: str = ""
+    parent_id: str = ""
 
     def to_chrome(self, pid: Union[int, str]) -> Dict[str, Any]:
         ev: Dict[str, Any] = {
@@ -106,8 +194,17 @@ class Span:
             ev["dur"] = round(self.dur * 1e6, 1)
         else:
             ev["s"] = "t"  # thread-scoped instant
-        if self.args:
-            ev["args"] = self.args
+        args = dict(self.args) if self.args else {}
+        if self.span_id:
+            # trace identity rides in args so the Chrome export round-trips
+            # through /trace scrapes and offline dumps unchanged
+            args["span_id"] = self.span_id
+            if self.trace_id:
+                args["trace_id"] = self.trace_id
+            if self.parent_id:
+                args["parent_id"] = self.parent_id
+        if args:
+            ev["args"] = args
         return ev
 
 
@@ -127,9 +224,16 @@ class TraceBuffer:
 
     def add(self, span: Span) -> None:
         with self._lock:
-            if len(self._spans) == self.capacity:
+            dropped = len(self._spans) == self.capacity
+            if dropped:
                 self._dropped += 1
+                n = self._dropped
             self._spans.append(span)
+        if dropped:
+            # a truncated trace must be tellable from a short one: the
+            # counter/gauge pair lets assemblers (and operators) see that
+            # spans fell off the ring before they were scraped
+            _count_dropped(n)
 
     def spans(self) -> List[Span]:
         with self._lock:
@@ -150,6 +254,19 @@ class TraceBuffer:
             return self._dropped
 
 
+def _count_dropped(total: int) -> None:
+    """Bump the `trace_spans_dropped` counter + gauge (best-effort: span
+    recording must never fail because monitoring is mid-teardown)."""
+    try:
+        from ..monitor.counters import global_counters
+
+        c = global_counters()
+        c.inc_event("trace_spans_dropped")
+        c.set_gauge("trace_spans_dropped", float(total))
+    except Exception:  # noqa: BLE001 - pure telemetry
+        pass
+
+
 def export_chrome_trace(
     spans: Union[TraceBuffer, Sequence[Span]],
     pid: Optional[Union[int, str]] = None,
@@ -161,7 +278,9 @@ def export_chrome_trace(
     The wall/monotonic anchor pair rides along under "otherData" so offline
     merges can align timelines across hosts.
     """
+    dropped = None
     if isinstance(spans, TraceBuffer):
+        dropped = spans.dropped
         spans = spans.spans()
     if pid is None:
         pid = os.getpid()
@@ -172,13 +291,18 @@ def export_chrome_trace(
             "args": {"name": process_name},
         })
     events.extend(s.to_chrome(pid) for s in spans)
+    other: Dict[str, Any] = {
+        "proc_start_wall": _PROC_START_WALL,
+        "job_start_wall": _job_start_wall(),
+    }
+    if dropped is not None:
+        # assemblers use this to mark timelines whose spans fell off the
+        # ring as truncated rather than presenting a misleading tree
+        other["spans_dropped"] = dropped
     return {
         "traceEvents": events,
         "displayTimeUnit": "ms",
-        "otherData": {
-            "proc_start_wall": _PROC_START_WALL,
-            "job_start_wall": _job_start_wall(),
-        },
+        "otherData": other,
     }
 
 
@@ -275,36 +399,76 @@ def global_trace_buffer() -> TraceBuffer:
 def record_span(name: str, t0_mono: float, t1_mono: Optional[float] = None,
                 cat: str = "", args: Optional[Dict[str, Any]] = None) -> None:
     """Record a span from explicit monotonic stamps (for phases timed by
-    hand, e.g. the heal decomposition).  No-op when tracing is off."""
+    hand, e.g. the heal decomposition).  No-op when tracing is off.  Under
+    an active TraceContext the span joins that trace as a child."""
     if not enabled():
         return
+    t1 = time.monotonic() if t1_mono is None else t1_mono
+    ctx = current_context()
+    global_trace_buffer().add(Span(
+        name=name, t_start=job_now(t0_mono), dur=max(0.0, t1 - t0_mono),
+        cat=cat, tid=threading.get_ident() & 0x7FFFFFFF, args=args,
+        trace_id=ctx.trace_id if ctx else "",
+        span_id=new_span_id() if ctx else "",
+        parent_id=ctx.span_id if ctx else "",
+    ))
+
+
+def child_span(name: str, t0_mono: float, t1_mono: Optional[float] = None,
+               *, trace_id: str, parent_id: str = "", span_id: str = "",
+               cat: str = "", args: Optional[Dict[str, Any]] = None) -> str:
+    """Record one span under an explicit (possibly remote) parent — the
+    cross-process hop primitive: the parent span id arrived over the wire
+    (traceparent header / request body), not from this thread's context.
+    Returns the recorded span's id ("" when tracing is off or no trace_id),
+    so callers can hand it to the NEXT hop as its parent."""
+    if not enabled() or not trace_id:
+        return ""
+    sid = span_id or new_span_id()
     t1 = time.monotonic() if t1_mono is None else t1_mono
     global_trace_buffer().add(Span(
         name=name, t_start=job_now(t0_mono), dur=max(0.0, t1 - t0_mono),
         cat=cat, tid=threading.get_ident() & 0x7FFFFFFF, args=args,
+        trace_id=trace_id, span_id=sid, parent_id=parent_id,
     ))
+    return sid
 
 
 def log_event(name: str, **args: Any) -> None:
     """One-line event + an instant span in the buffer (t on the monotonic
-    job clock; wall time appears only in the export's anchor metadata)."""
+    job clock; wall time appears only in the export's anchor metadata).
+    Under an active TraceContext the instant joins that trace."""
     if not enabled():
         return
     t = job_now()
     log.info("[event] %s +%.3fs job +%.3fs proc", name, t,
              time.monotonic() - _PROC_START_MONO)
+    ctx = current_context()
     global_trace_buffer().add(Span(
         name=name, t_start=t, dur=0.0, cat="event", phase="i",
         tid=threading.get_ident() & 0x7FFFFFFF, args=args or None,
+        trace_id=ctx.trace_id if ctx else "",
+        span_id=new_span_id() if ctx else "",
+        parent_id=ctx.span_id if ctx else "",
     ))
 
 
 @contextlib.contextmanager
 def trace_scope(name: str, device: bool = False, cat: str = "",
-                args: Optional[Dict[str, Any]] = None) -> Iterator[None]:
+                args: Optional[Dict[str, Any]] = None,
+                track: bool = False) -> Iterator[None]:
     """Scoped span: recorded in the ring buffer + timing log; with
     device=True also annotates the XLA timeline.  Nesting is free — Chrome
-    trace viewers nest "X" events by ts/dur containment per thread."""
+    trace viewers nest "X" events by ts/dur containment per thread.
+
+    Under an active TraceContext the scope allocates a child span id and
+    becomes the current context for its body, so nested scopes chain into
+    the distributed span tree.  `track=True` allocates a span id even with
+    no context — for batch-level spans (one decode step serving many
+    requests) that need a stable dedup identity without belonging to a
+    single trace.  `args` is held by reference and serialized at scrape
+    time, so a scope body may fill in outcome fields (e.g. per-round
+    acceptance) before it closes."""
     if not enabled():
         yield
         return
@@ -317,9 +481,13 @@ def trace_scope(name: str, device: bool = False, cat: str = "",
             ann.__enter__()
         except Exception:  # pragma: no cover - profiler backend optional
             ann = None
+    parent = current_context()
+    sid = new_span_id() if (parent is not None or track) else ""
+    child = TraceContext(parent.trace_id, sid) if parent is not None else None
     t0 = time.monotonic()
     try:
-        yield
+        with trace_context(child):
+            yield
     finally:
         t1 = time.monotonic()
         if ann is not None:
@@ -327,6 +495,9 @@ def trace_scope(name: str, device: bool = False, cat: str = "",
         global_trace_buffer().add(Span(
             name=name, t_start=job_now(t0), dur=t1 - t0, cat=cat,
             tid=threading.get_ident() & 0x7FFFFFFF, args=args,
+            trace_id=parent.trace_id if parent else "",
+            span_id=sid,
+            parent_id=parent.span_id if parent else "",
         ))
         log.info("[trace] %s took %.3f ms", name, (t1 - t0) * 1e3)
 

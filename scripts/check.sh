@@ -124,6 +124,15 @@ echo "== serving v2: decode-tier rank kill drill (CPU, disaggregated) =="
 # decode rank's ring buddy, every request completes
 JAX_PLATFORMS=cpu python -m kungfu_tpu.chaos --serve-drill --tier decode --timeout 300
 
+echo "== trace drill: stitched cross-process request traces + tail attribution (CPU) =="
+# the decode-tier serve drill plus distributed tracing: every completed
+# request must stitch into a multi-process trace on the fleet /requests
+# endpoint (>= 2 process lanes, zero orphan spans; failover victims carry
+# the requeue + warm_graft spans), and an induced slow_serve@phase=kv_ship
+# window must journal a request-latency slo_breach naming kv_ship as the
+# dominant phase (docs/observability.md "Request tracing")
+JAX_PLATFORMS=cpu python -m kungfu_tpu.chaos --trace-drill --timeout 300
+
 echo "== straggler drill: slow rank fingered, not killed (CPU) =="
 # a slow@-injected rank (per-step sleep > heartbeat timeout) must be
 # flagged by the fleet /stragglers detector (journal straggler_suspected

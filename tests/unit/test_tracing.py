@@ -1,0 +1,658 @@
+"""Distributed request tracing: traceparent propagation contract, span-tree
+chaining under foreign parents, the fleet request assembler (stitching,
+dedup, tail sampling, flow events), and the SLO breach phase attribution.
+"""
+import json
+import time
+import urllib.request
+
+import pytest
+
+from kungfu_tpu.utils import trace as T
+
+pytestmark = pytest.mark.tracing
+
+
+@pytest.fixture(autouse=True)
+def _clean_buffer():
+    T.global_trace_buffer().clear()
+    yield
+    T.global_trace_buffer().clear()
+
+
+@pytest.fixture
+def traced(monkeypatch):
+    monkeypatch.setenv(T.ENABLE_ENV, "1")
+
+
+# -- traceparent wire format -----------------------------------------------------------
+
+
+def test_traceparent_round_trip():
+    ctx = T.TraceContext(T.new_trace_id(), T.new_span_id())
+    header = T.format_traceparent(ctx)
+    assert header == f"00-{ctx.trace_id}-{ctx.span_id}-01"
+    parsed = T.parse_traceparent(header)
+    assert parsed == ctx
+
+
+@pytest.mark.parametrize("bad", [
+    None, "", "garbage", "00-short-span-01",
+    "00-" + "g" * 32 + "-" + "1" * 16 + "-01",       # non-hex trace
+    "00-" + "0" * 32 + "-" + "1" * 16 + "-01",       # all-zero trace
+    "00-" + "a" * 32 + "-" + "0" * 16 + "-01",       # all-zero span
+    "00-" + "a" * 32 + "-" + "b" * 16,               # missing flags
+    "0-" + "a" * 32 + "-" + "b" * 16 + "-01",        # short version
+])
+def test_traceparent_rejects_malformed(bad):
+    assert T.parse_traceparent(bad) is None
+
+
+def test_trace_ids_are_hex_and_sized():
+    assert len(T.new_trace_id()) == 32
+    assert len(T.new_span_id()) == 16
+    assert set(T.new_trace_id()) <= set("0123456789abcdef")
+
+
+# -- context chaining ------------------------------------------------------------------
+
+
+def test_trace_scope_chains_under_context(traced):
+    ctx = T.TraceContext("a" * 32, "b" * 16)
+    with T.trace_context(ctx):
+        with T.trace_scope("outer"):
+            with T.trace_scope("inner"):
+                pass
+    spans = {s.name: s for s in T.global_trace_buffer().spans()}
+    outer, inner = spans["outer"], spans["inner"]
+    assert outer.trace_id == inner.trace_id == "a" * 32
+    assert outer.parent_id == "b" * 16          # chained under the context
+    assert inner.parent_id == outer.span_id     # nested scopes re-parent
+    assert outer.span_id and inner.span_id and outer.span_id != inner.span_id
+
+
+def test_child_spans_under_foreign_parent(traced):
+    """The cross-process contract: a worker's spans parent under a span id
+    that arrived over the wire and was never recorded locally."""
+    header = f"00-{'c' * 32}-{'d' * 16}-01"
+    ctx = T.parse_traceparent(header)
+    with T.trace_context(ctx):
+        with T.trace_scope("serve:prefill"):
+            pass
+    (s,) = T.global_trace_buffer().spans()
+    assert s.trace_id == "c" * 32
+    assert s.parent_id == "d" * 16
+
+
+def test_scope_without_context_has_no_ids(traced):
+    with T.trace_scope("plain"):
+        pass
+    (s,) = T.global_trace_buffer().spans()
+    assert s.span_id == "" and s.trace_id == "" and s.parent_id == ""
+
+
+def test_track_allocates_id_without_context(traced):
+    with T.trace_scope("serve:decode", track=True,
+                       args={"trace_ids": ["x"]}):
+        pass
+    (s,) = T.global_trace_buffer().spans()
+    assert s.span_id and s.trace_id == ""
+    chrome = s.to_chrome(pid=1)
+    assert chrome["args"]["span_id"] == s.span_id
+    assert chrome["args"]["trace_ids"] == ["x"]
+
+
+def test_child_span_explicit_and_disabled(traced, monkeypatch):
+    sid = T.child_span("kv_ship", time.monotonic(), trace_id="e" * 32,
+                       parent_id="f" * 16, span_id="1" * 16)
+    assert sid == "1" * 16
+    (s,) = T.global_trace_buffer().spans()
+    assert (s.trace_id, s.span_id, s.parent_id) == ("e" * 32, "1" * 16,
+                                                    "f" * 16)
+    monkeypatch.delenv(T.ENABLE_ENV, raising=False)
+    assert T.child_span("x", time.monotonic(), trace_id="e" * 32) == ""
+
+
+def test_record_span_and_log_event_join_context(traced):
+    ctx = T.TraceContext("9" * 32, "8" * 16)
+    with T.trace_context(ctx):
+        T.record_span("manual", time.monotonic())
+        T.log_event("milestone")
+    spans = T.global_trace_buffer().spans()
+    assert all(s.trace_id == "9" * 32 and s.parent_id == "8" * 16
+               and s.span_id for s in spans)
+
+
+def test_args_filled_before_scope_close_are_recorded(traced):
+    """The verify span's per-round acceptance is filled in after the
+    dispatch but before the scope closes — args is held by reference."""
+    args = {"k": 4}
+    with T.trace_scope("serve:verify", args=args, track=True):
+        args["accepted"] = [3, 1]
+    (s,) = T.global_trace_buffer().spans()
+    assert s.args["accepted"] == [3, 1]
+
+
+# -- ring overflow accounting ----------------------------------------------------------
+
+
+def test_buffer_drop_counter_and_export_stamp():
+    from kungfu_tpu.monitor.counters import global_counters
+
+    before = global_counters().snapshot_json().get("events", {}).get(
+        "trace_spans_dropped", 0)
+    buf = T.TraceBuffer(capacity=2)
+    for i in range(5):
+        buf.add(T.Span(f"s{i}", float(i), 0.1))
+    assert buf.dropped == 3
+    events = global_counters().snapshot_json().get("events", {})
+    assert events.get("trace_spans_dropped", 0) - before == 3
+    assert global_counters().gauges().get("trace_spans_dropped") == 3.0
+    out = T.export_chrome_trace(buf, pid=1)
+    assert out["otherData"]["spans_dropped"] == 3
+
+
+# -- journal correlation ---------------------------------------------------------------
+
+
+def test_journal_auto_stamps_trace_id(tmp_path, monkeypatch):
+    from kungfu_tpu.monitor import journal as J
+
+    monkeypatch.setenv(J.JOURNAL_FILE_ENV, str(tmp_path / "j.jsonl"))
+    J._reset_for_tests()
+    try:
+        with T.trace_context(T.TraceContext("7" * 32, "6" * 16)):
+            J.journal_event("prefix_evicted", tokens=3)
+        J.journal_event("resize", old=2, new=3)           # no context
+        J.journal_event("spec_disabled", trace_id="")      # explicit empty
+        events = J.read_journal(str(tmp_path / "j.jsonl"))
+    finally:
+        J._reset_for_tests()
+    assert events[0]["trace_id"] == "7" * 32
+    assert "trace_id" not in events[1]
+    assert "trace_id" not in events[2]  # falsy explicit stamp stripped
+
+
+def test_request_json_round_trips_trace_fields():
+    from kungfu_tpu.serving.request import Request
+
+    r = Request(prompt=(1, 2), max_new_tokens=4, trace_id="a" * 32,
+                parent_span="b" * 16)
+    r2 = Request.from_json(r.to_json())
+    assert r2.trace_id == "a" * 32 and r2.parent_span == "b" * 16
+
+
+# -- assembler -------------------------------------------------------------------------
+
+
+def _span(name, t0, dur, tid, sid, parent, args=None, **kw):
+    return T.Span(name=name, t_start=t0, dur=dur, trace_id=tid,
+                  span_id=sid, parent_id=parent, args=args, **kw)
+
+
+def _request_traces(tid="t1", req_id="r1", requeues=0, latency=1.0):
+    """(router_trace, worker_trace) for one synthetic two-process request."""
+    router = [
+        _span("request", 0.0, latency, tid, f"{tid}-root", "",
+              {"req_id": req_id, "status": "ok", "requeues": requeues}),
+        _span("queue:wait", 0.0, 0.1, tid, f"{tid}-q", f"{tid}-root"),
+        _span("route", 0.1, 0.85, tid, f"{tid}-rt", f"{tid}-root"),
+    ]
+    worker = [
+        _span("serve:prefill", 0.15, 0.3, tid, f"{tid}-p", f"{tid}-rt",
+              {"tokens": 5, "hit": 2}),
+        _span("decode", 0.45, 0.5, tid, f"{tid}-d", f"{tid}-rt",
+              {"rounds": 8}),
+    ]
+    if requeues:
+        router.append(_span("requeue", 0.5, 0.0, tid, f"{tid}-rq",
+                            f"{tid}-root", phase="i"))
+        router.append(_span("warm_graft", 0.5, 0.05, tid, f"{tid}-wg",
+                            f"{tid}-root", {"hit": True}))
+    return (T.export_chrome_trace(router, pid=999),
+            T.export_chrome_trace(worker, pid=998))
+
+
+def _monitor(**kw):
+    from kungfu_tpu.monitor.requests import RequestMonitor
+
+    return RequestMonitor(**kw)
+
+
+def test_assembler_stitches_two_processes():
+    mon = _monitor()
+    router, worker = _request_traces()
+    mon.consume_chrome(1, worker)
+    mon.consume_chrome("router", router)
+    rep = mon.report()
+    assert rep["completed_total"] == 1 and rep["partial_total"] == 0
+    (tl,) = rep["requests"]
+    assert tl["req_id"] == "r1" and tl["status"] == "ok"
+    assert sorted(tl["processes"]) == ["1", "router"]
+    assert tl["orphans"] == 0 and not tl["partial"]
+    ph = tl["phases"]
+    assert ph["queue"] == pytest.approx(0.1, abs=1e-6)
+    assert ph["prefill"] == pytest.approx(0.3, abs=1e-6)
+    assert ph["decode"] == pytest.approx(0.5, abs=1e-6)
+    # route keeps only its exclusive remainder (network + serialization)
+    assert ph["route"] == pytest.approx(0.05, abs=1e-6)
+    assert tl["dominant_phase"] == "decode"
+
+
+def test_assembler_dedupes_duplicate_scrapes():
+    mon = _monitor()
+    router, worker = _request_traces()
+    assert mon.consume_chrome(1, worker) == 2
+    assert mon.consume_chrome(1, worker) == 0  # re-scrape: all seen
+    mon.consume_chrome("router", router)
+    mon.consume_chrome("router", router)
+    rep = mon.report()
+    assert rep["completed_total"] == 1
+    assert rep["requests"][0]["n_spans"] == 5
+
+
+def test_assembler_merges_out_of_order_arrivals():
+    """Root first (finalizes), worker spans later (merge + re-attribute)."""
+    mon = _monitor()
+    router, worker = _request_traces()
+    mon.consume_chrome("router", router)
+    rep = mon.report()
+    assert rep["completed_total"] == 1
+    assert rep["requests"][0]["n_spans"] == 3
+    mon.consume_chrome(1, worker)
+    rep = mon.report()
+    assert rep["completed_total"] == 1  # same request, not a new one
+    tl = rep["requests"][0]
+    assert tl["n_spans"] == 5
+    assert tl["phases"]["prefill"] == pytest.approx(0.3, abs=1e-6)
+
+
+def test_assembler_marks_missing_parents_partial():
+    mon = _monitor()
+    router, _ = _request_traces()
+    orphan = T.export_chrome_trace(
+        [_span("serve:kv_graft", 0.2, 0.1, "t1", "t1-g", "never-arrived")],
+        pid=997)
+    mon.consume_chrome(2, orphan)
+    mon.consume_chrome("router", router)
+    rep = mon.report()
+    (tl,) = rep["requests"]
+    assert tl["partial"] and tl["orphans"] == 1
+    assert rep["partial_total"] == 1
+
+
+def test_assembler_counts_batch_rounds_and_acceptance():
+    mon = _monitor()
+    router, worker = _request_traces()
+    batch = T.export_chrome_trace([
+        T.Span("serve:decode", 0.5, 0.01, span_id="b1",
+               args={"trace_ids": ["t1"]}),
+        T.Span("serve:verify", 0.52, 0.01, span_id="b2",
+               args={"trace_ids": ["t1", "zz"], "accepted": [3, 0], "k": 4}),
+    ], pid=998)
+    mon.consume_chrome(1, worker)
+    mon.consume_chrome(1, batch)
+    mon.consume_chrome("router", router)
+    (tl,) = mon.report()["requests"]
+    assert tl["decode_rounds"] == 1
+    assert tl["spec_rounds"] == 1
+    assert tl["spec_accepted"] == 3
+
+
+def test_tail_sampler_retention_invariants():
+    mon = _monitor(keep=8, tail_slowest=2)
+    # 12 fast requests, 3 slow, 1 failover-touched (fast)
+    for i in range(12):
+        r, w = _request_traces(tid=f"f{i}", req_id=f"f{i}", latency=0.2)
+        mon.consume_chrome(1, w)
+        mon.consume_chrome("router", r)
+    for i in range(3):
+        r, w = _request_traces(tid=f"s{i}", req_id=f"s{i}",
+                               latency=5.0 + i)
+        mon.consume_chrome(1, w)
+        mon.consume_chrome("router", r)
+    r, w = _request_traces(tid="v1", req_id="v1", requeues=1, latency=0.2)
+    mon.consume_chrome(1, w)
+    mon.consume_chrome("router", r)
+    # more fast traffic must NOT evict the slow or flagged retentions
+    for i in range(12, 24):
+        r, w = _request_traces(tid=f"f{i}", req_id=f"f{i}", latency=0.2)
+        mon.consume_chrome(1, w)
+        mon.consume_chrome("router", r)
+    rep = mon.report()
+    slow_ids = [t["req_id"] for t in rep["tail"]["slowest"]]
+    assert slow_ids == ["s2", "s1"]  # slowest-N, slowest first
+    flagged_ids = [t["req_id"] for t in rep["tail"]["flagged"]]
+    assert "v1" in flagged_ids
+    victim = next(t for t in rep["tail"]["flagged"] if t["req_id"] == "v1")
+    names = {s["name"] for s in victim["spans"]}
+    assert {"requeue", "warm_graft"} <= names
+    assert len(rep["requests"]) <= 8  # reservoir bounded
+
+
+def test_breach_window_retention():
+    active = {"on": False}
+    mon = _monitor(keep=4, tail_slowest=1,
+                   breach_active_fn=lambda: active["on"])
+    r, w = _request_traces(tid="n1", req_id="n1", latency=0.3)
+    mon.consume_chrome(1, w)
+    mon.consume_chrome("router", r)
+    active["on"] = True
+    r, w = _request_traces(tid="b1", req_id="b1", latency=0.2)
+    mon.consume_chrome(1, w)
+    mon.consume_chrome("router", r)
+    rep = mon.report()
+    flagged = {t["req_id"] for t in rep["tail"]["flagged"]}
+    assert flagged == {"b1"}
+    assert next(t for t in rep["tail"]["flagged"]
+                if t["req_id"] == "b1")["in_breach_window"]
+
+
+def test_attribution_dominant_p99_phase():
+    mon = _monitor()
+    for i in range(10):
+        r, w = _request_traces(tid=f"q{i}", req_id=f"q{i}", latency=1.0)
+        mon.consume_chrome(1, w)
+        mon.consume_chrome("router", r)
+    # one tail request dominated by a huge kv_ship hop
+    tid = "tail"
+    router = [
+        _span("request", 0.0, 10.0, tid, f"{tid}-root", "",
+              {"req_id": tid, "status": "ok", "requeues": 0}),
+        _span("route", 0.0, 9.9, tid, f"{tid}-rt", f"{tid}-root"),
+    ]
+    worker = [_span("kv_ship", 0.1, 9.0, tid, f"{tid}-k", f"{tid}-rt")]
+    mon.consume_chrome(1, T.export_chrome_trace(worker, pid=998))
+    mon.consume_chrome("router", T.export_chrome_trace(router, pid=999))
+    att = mon.attribution()
+    assert att["requests"] == 11
+    assert att["dominant_p99_phase"] == "kv_ship"
+    assert att["phases"]["kv_ship"]["p99"] > 0.8
+    assert 0 < att["phases"]["decode"]["p50"] < 1
+
+
+def test_flow_events_cross_process_only_and_schema_valid():
+    mon = _monitor()
+    router, worker = _request_traces()
+    mon.consume_chrome(1, worker)
+    mon.consume_chrome("router", router)
+    flows = mon.flow_events()
+    # two cross-process edges (route->prefill, route->decode), two events each
+    assert len(flows) == 4
+    starts = [f for f in flows if f["ph"] == "s"]
+    finishes = [f for f in flows if f["ph"] == "f"]
+    assert len(starts) == len(finishes) == 2
+    assert {f["id"] for f in starts} == {f["id"] for f in finishes}
+    for f in flows:
+        assert set(f) >= {"ph", "id", "name", "cat", "pid", "tid", "ts"}
+    for f in finishes:
+        assert f["bp"] == "e" and f["pid"] == 1  # arrowhead on the worker
+    for f in starts:
+        assert f["pid"] == "router"
+
+
+def test_dedupe_chrome_events_by_span_id():
+    from kungfu_tpu.monitor.fleet import dedupe_chrome_events
+
+    ev = _span("route", 0.1, 0.2, "t1", "s1", "root").to_chrome(3)
+    other = _span("route", 0.3, 0.2, "t1", "s2", "root").to_chrome(3)
+    meta = {"name": "process_name", "ph": "M", "pid": 3, "tid": 0,
+            "args": {"name": "rank 3"}}
+    out = dedupe_chrome_events([meta, ev, dict(ev), other, meta])
+    assert out == [meta, ev, other]
+
+
+def test_assemble_requests_offline():
+    from kungfu_tpu.monitor.requests import assemble_requests
+
+    router, worker = _request_traces()
+    rep = assemble_requests([("rank 1", worker), ("router", router)])
+    assert rep["completed_total"] == 1
+    assert rep["attribution"]["dominant_p99_phase"] == "decode"
+
+
+# -- fleet endpoint e2e ----------------------------------------------------------------
+
+
+def test_fleet_requests_endpoint_and_timeline_flows(traced):
+    from kungfu_tpu.monitor.fleet import FleetAggregator
+    from kungfu_tpu.monitor.server import MonitorServer
+
+    wbuf = T.TraceBuffer(capacity=64)
+    for s in [_span("serve:prefill", 0.15, 0.3, "t1", "t1-p", "t1-rt"),
+              _span("decode", 0.45, 0.5, "t1", "t1-d", "t1-rt")]:
+        wbuf.add(s)
+    srv = MonitorServer(host="127.0.0.1", port=0, trace_buffer=wbuf).start()
+    # the router's spans live in THIS process's global buffer
+    gbuf = T.global_trace_buffer()
+    for s in [_span("request", 0.0, 1.0, "t1", "t1-root", "",
+                    {"req_id": "r1", "status": "ok", "requeues": 0}),
+              _span("queue:wait", 0.0, 0.1, "t1", "t1-q", "t1-root"),
+              _span("route", 0.1, 0.85, "t1", "t1-rt", "t1-root")]:
+        gbuf.add(s)
+    agg = FleetAggregator(lambda: [(1, f"http://127.0.0.1:{srv.port}")],
+                          host="127.0.0.1").start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{agg.port}/requests", timeout=10) as r:
+            rep = json.loads(r.read().decode())
+        assert rep["completed_total"] == 1
+        (tl,) = rep["requests"]
+        assert sorted(tl["processes"]) == ["1", "router"]
+        assert not tl["partial"]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{agg.port}/timeline", timeout=10) as r:
+            tl2 = json.loads(r.read().decode())
+        pids = {e["pid"] for e in tl2["traceEvents"]}
+        assert 1 in pids and "router" in pids
+        flows = [e for e in tl2["traceEvents"] if e.get("cat") == "flow"]
+        assert flows and {e["ph"] for e in flows} == {"s", "f"}
+        # a second scrape must not duplicate spans in the export
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{agg.port}/timeline", timeout=10) as r:
+            tl3 = json.loads(r.read().decode())
+        sids = [e["args"]["span_id"] for e in tl3["traceEvents"]
+                if e.get("args", {}).get("span_id")]
+        assert len(sids) == len(set(sids))
+    finally:
+        agg.close()
+        srv.close()
+
+
+# -- SLO breach attribution ------------------------------------------------------------
+
+
+def test_slo_breach_journals_dominant_phase():
+    from kungfu_tpu.monitor.slo import SLOEngine, SLORule
+    from kungfu_tpu.monitor.timeseries import TimeSeriesStore
+
+    store = TimeSeriesStore()
+    events = []
+    rule = SLORule("req_p99", "hist:request_latency_ms:p99", "<=", 100.0,
+                   sustain_s=0.0)
+    seen_since = []
+
+    def attribution(r, viol_since):
+        seen_since.append(viol_since)
+        return {"dominant_phase": "kv_ship", "dominant_phase_frac": 0.7}
+
+    eng = SLOEngine(
+        store, rules=[rule],
+        journal=lambda ev, **kw: events.append((ev, kw)),
+        attribution_fn=attribution,
+    )
+    store.record("hist:request_latency_ms:p99", 1.0, 900.0)
+    eng.evaluate(now=1.0)
+    breaches = [kw for ev, kw in events if ev == "slo_breach"]
+    assert breaches and breaches[0]["dominant_phase"] == "kv_ship"
+    assert breaches[0]["dominant_phase_frac"] == 0.7
+    assert seen_since == [1.0]  # the violation-start anchor rides along
+
+
+def test_request_latency_rule_shipped():
+    from kungfu_tpu.monitor.slo import DEFAULT_RULES
+
+    names = {r.name for r in DEFAULT_RULES}
+    assert "request_latency_p99" in names
+
+
+# -- slow_serve chaos grammar ----------------------------------------------------------
+
+
+def test_slow_serve_grammar():
+    from kungfu_tpu.chaos.plan import parse_fault_plan
+
+    plan = parse_fault_plan(
+        "slow_serve@phase=kv_ship:ms=300:tier=prefill;"
+        "slow_serve@phase=decode:ms=50:rank=1:secs=2")
+    f1, f2 = plan.serve_phase_faults()
+    assert f1.phase == "kv_ship" and f1.ms == 300.0 and f1.tier == "prefill"
+    assert f2.phase == "decode" and f2.rank == 1 and f2.secs == 2.0
+    with pytest.raises(ValueError):
+        parse_fault_plan("slow_serve@phase=bogus:ms=10")
+    with pytest.raises(ValueError):
+        parse_fault_plan("slow_serve@phase=decode")  # needs ms=
+
+
+def test_slow_serve_injector_filters_and_sleeps():
+    from kungfu_tpu.chaos.inject import ChaosInjector
+    from kungfu_tpu.chaos.plan import parse_fault_plan
+
+    sleeps = []
+    inj = ChaosInjector(
+        parse_fault_plan("slow_serve@phase=kv_ship:ms=250:tier=prefill"),
+        exit_fn=lambda c: None, sleep_fn=sleeps.append)
+    inj.on_serve_phase("kv_ship", 0, tier="decode")   # tier mismatch
+    inj.on_serve_phase("decode", 0, tier="prefill")   # phase mismatch
+    assert sleeps == []
+    inj.on_serve_phase("kv_ship", 0, tier="prefill")
+    inj.on_serve_phase("kv_ship", 1, tier="prefill")  # rank=-1: everyone
+    assert sleeps == [0.25, 0.25]
+
+
+def test_attribution_since_t_windows_out_history():
+    """The SLO path windows attribution on the violation start, so an
+    old failover storm cannot masquerade as the current breach's cause."""
+    mon = _monitor()
+    # ancient queue-dominated request (a failover-era victim)
+    tid = "old"
+    router = [
+        _span("request", 0.0, 8.0, tid, f"{tid}-root", "",
+              {"req_id": tid, "status": "ok", "requeues": 2}),
+        _span("queue:wait", 0.0, 7.5, tid, f"{tid}-q", f"{tid}-root"),
+        _span("route", 7.5, 0.4, tid, f"{tid}-rt", f"{tid}-root"),
+    ]
+    old_worker = [_span("serve:prefill", 7.6, 0.1, tid, f"{tid}-p",
+                        f"{tid}-rt")]
+    mon.consume_chrome(1, T.export_chrome_trace(old_worker, pid=998))
+    mon.consume_chrome("router", T.export_chrome_trace(router, pid=999))
+    # fresh kv_ship-dominated requests
+    for i in range(4):
+        tid = f"new{i}"
+        router = [
+            _span("request", 100.0 + i, 1.0, tid, f"{tid}-root", "",
+                  {"req_id": tid, "status": "ok", "requeues": 0}),
+            _span("route", 100.0 + i, 0.95, tid, f"{tid}-rt", f"{tid}-root"),
+        ]
+        worker = [_span("kv_ship", 100.05 + i, 0.8, tid, f"{tid}-k",
+                        f"{tid}-rt")]
+        mon.consume_chrome(1, T.export_chrome_trace(worker, pid=998))
+        mon.consume_chrome("router", T.export_chrome_trace(router, pid=999))
+    assert mon.attribution()["dominant_p99_phase"] == "queue"  # all-time
+    windowed = mon.attribution(since_t=50.0)
+    assert windowed["dominant_p99_phase"] == "kv_ship"
+    assert windowed["requests"] == 4
+    # an empty window falls back to everything rather than reporting nothing
+    assert mon.attribution(since_t=1e9)["requests"] == 5
+
+
+def test_attribution_prefers_complete_timelines():
+    """A router-only timeline (worker scrape lagged) attributes everything
+    to the dispatch hop — it must not poison the aggregate when complete
+    rows exist."""
+    mon = _monitor()
+    # incomplete: root + route only, route looks like 99% of the latency
+    tid = "lag"
+    router = [
+        _span("request", 0.0, 3.0, tid, f"{tid}-root", "",
+              {"req_id": tid, "status": "ok", "requeues": 0}),
+        _span("route", 0.0, 2.97, tid, f"{tid}-rt", f"{tid}-root"),
+    ]
+    mon.consume_chrome("router", T.export_chrome_trace(router, pid=999))
+    for i in range(3):
+        r, w = _request_traces(tid=f"c{i}", req_id=f"c{i}", latency=1.0)
+        mon.consume_chrome(1, w)
+        mon.consume_chrome("router", r)
+    att = mon.attribution()
+    assert att["requests"] == 3  # the lagging row is excluded
+    assert att["dominant_p99_phase"] == "decode"
+
+
+def test_slow_serve_after_skips_warmup_calls():
+    from kungfu_tpu.chaos.inject import ChaosInjector
+    from kungfu_tpu.chaos.plan import parse_fault_plan
+
+    sleeps = []
+    inj = ChaosInjector(
+        parse_fault_plan("slow_serve@phase=kv_ship:ms=100:after=3"),
+        exit_fn=lambda c: None, sleep_fn=sleeps.append)
+    for _ in range(5):
+        inj.on_serve_phase("kv_ship", 0)
+    assert sleeps == [0.1, 0.1]  # first 3 calls pass undelayed
+
+
+def test_warm_merge_rejects_stale_snapshot():
+    """Repeated failovers must not duplicate output: a warm snapshot no
+    longer ahead of the request's resumed stream is ignored."""
+    from kungfu_tpu.serving.request import Request
+    from kungfu_tpu.serving.router import Router
+
+    req = Request(prompt=(1, 2), max_new_tokens=8, req_id="r1")
+    # first failover: fresh snapshot with new progress
+    assert Router._merge_warm(req, [
+        {"id": "r1", "prior_tokens": [], "generated": [11, 34]}])
+    assert req.prior_tokens == (11, 34)
+    # second failover serves the SAME stale snapshot again
+    assert not Router._merge_warm(req, [
+        {"id": "r1", "prior_tokens": [], "generated": [11, 34]}])
+    assert req.prior_tokens == (11, 34)  # no duplication
+    # a genuinely fresher snapshot (shipped after the resume) extends
+    assert Router._merge_warm(req, [
+        {"id": "r1", "prior_tokens": [11, 34], "generated": [13, 57]}])
+    assert req.prior_tokens == (11, 34, 13, 57)
+    # budget cap still applies
+    req2 = Request(prompt=(1,), max_new_tokens=3, req_id="r2")
+    assert Router._merge_warm(req2, [
+        {"id": "r2", "prior_tokens": [5, 6], "generated": [7, 8]}])
+    assert req2.prior_tokens == (5, 6, 7)
+
+
+def test_slow_serve_start_after_time_grace():
+    from kungfu_tpu.chaos.inject import ChaosInjector
+    from kungfu_tpu.chaos.plan import parse_fault_plan
+
+    plan = parse_fault_plan("slow_serve@phase=kv_ship:ms=100:start_after=5")
+    (fault,) = plan.serve_phase_faults()
+    assert fault.start_after_s == 5.0
+    sleeps = []
+    inj = ChaosInjector(plan, exit_fn=lambda c: None, sleep_fn=sleeps.append)
+    inj.on_serve_phase("kv_ship", 0)   # within the grace window: no delay
+    inj.on_serve_phase("kv_ship", 0)
+    assert sleeps == []
+    inj._phase_first[fault] -= 10.0    # age past the grace
+    inj.on_serve_phase("kv_ship", 0)
+    assert sleeps == [0.1]
+
+
+def test_slow_serve_window_closes():
+    from kungfu_tpu.chaos.inject import ChaosInjector
+    from kungfu_tpu.chaos.plan import parse_fault_plan
+
+    sleeps = []
+    inj = ChaosInjector(parse_fault_plan("slow_serve@phase=decode:ms=10:secs=5"),
+                        exit_fn=lambda c: None, sleep_fn=sleeps.append)
+    inj.on_serve_phase("decode", 0)
+    (fault,) = inj.plan.serve_phase_faults()
+    inj._phase_started[fault] -= 10.0  # age the window past secs=5
+    inj.on_serve_phase("decode", 0)
+    assert sleeps == [0.01]  # second call fell outside the window
