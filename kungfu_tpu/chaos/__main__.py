@@ -518,6 +518,19 @@ def main(argv=None) -> int:
                          "induced slow_serve@phase=kv_ship window journals a "
                          "request-latency slo_breach with "
                          "dominant_phase=kv_ship (docs/observability.md)")
+    ap.add_argument("--fairness-drill", action="store_true",
+                    help="run the multi-tenant QoS drill: an adversarial "
+                         "tenant mix (bursty vs batch vs sensitive) against "
+                         "a tenanted CPU fleet — asserts the token bucket "
+                         "journals tenant_rate_limited, the sensitive class "
+                         "preempts a batch slot (slot_preempted + warm "
+                         "preempted_readmitted, byte-identical replay), the "
+                         "sensitive p99 stays inside its per-tenant SLO, "
+                         "and zero requests drop (docs/serving.md)")
+    ap.add_argument("--burst-plan",
+                    default="burst@tenant=bursty:rps=20:secs=3",
+                    help="fairness drill: the burst@ traffic shape the "
+                         "client executes against the bursty tenant")
     ap.add_argument("--json", default="",
                     help="serve drill: also write the metrics dict here")
     args = ap.parse_args(argv)
@@ -610,6 +623,31 @@ def main(argv=None) -> int:
               f"slo_breach dominant_phase="
               f"{tail.get('slo_breach_dominant_phase')} at "
               f"{tail.get('slo_breach_value_ms')}ms p99")
+        return 0
+
+    if args.fairness_drill:
+        from ..serving.drill import run_fairness_drill
+
+        summary = run_fairness_drill(timeout_s=args.timeout,
+                                     burst_plan=args.burst_plan)
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(summary, f, indent=2)
+        if not summary["ok"]:
+            print("FAIRNESS DRILL FAILED: " + "; ".join(summary["failures"]),
+                  file=sys.stderr)
+            if summary.get("output_tail"):
+                print("--- output tail ---\n" + summary["output_tail"],
+                      file=sys.stderr)
+            return 1
+        print("FAIRNESS DRILL OK: "
+              f"{summary['rate_limited']} rate-limit rejections journaled "
+              f"(client saw {summary['burst_codes']}), "
+              f"{summary['preemptions']} slot preemptions with "
+              f"{summary['readmits']} warm readmits (byte-identical "
+              "replays), sensitive p99="
+              f"{summary['sensitive_p99_s']}s inside its "
+              f"{summary['threshold_ms'] / 1000.0:g}s SLO, 0 dropped")
         return 0
 
     if args.serve_drill:

@@ -51,6 +51,17 @@ Serving faults (docs/serving.md, serve drills):
                                     the delay lands in must come back as the
                                     SLO breach's dominant_phase
                                     (docs/observability.md)
+  burst@tenant=T:rps=R[:secs=S][:start_after=S2]
+                                    synthetic TRAFFIC shape, not a fault:
+                                    the drill's closed-loop client fires
+                                    tenant T's requests open-loop at R
+                                    requests/sec for S seconds (default 3),
+                                    optionally starting S2 seconds in.
+                                    Executed by the drill harness itself
+                                    (serving/drill.py reads the plan) — it
+                                    never arms a worker-side injector, so a
+                                    burst plan composes with real faults in
+                                    the same string
 
 Checkpoint-integrity faults (docs/fault_tolerance.md, recovery ladder):
 
@@ -109,7 +120,7 @@ from typing import List, Optional, Tuple
 FAULT_PLAN_ENV = "KFT_FAULT_PLAN"
 
 _KINDS = ("crash", "hang", "slow", "flap", "corrupt_ckpt", "crash_in_save",
-          "crash_serve", "slow_serve", "partition", "degrade_link",
+          "crash_serve", "slow_serve", "burst", "partition", "degrade_link",
           "kill_host")
 SERVE_PHASES = ("prefill", "decode", "kv_ship")
 NETWORK_KINDS = ("partition", "degrade_link", "kill_host")
@@ -146,7 +157,9 @@ class Fault:
     tokens: int = -1                # crash_serve: generated-token trigger
     tier: str = ""                  # crash/slow_serve: pool filter (disagg)
     phase: str = ""                 # slow_serve: serving phase to delay
-    start_after_s: float = 0.0      # slow_serve: warmup grace (seconds)
+    start_after_s: float = 0.0      # slow_serve/burst: warmup grace (seconds)
+    tenant: str = ""                # burst: tenant to fire traffic as
+    rps: float = 0.0                # burst: open-loop request rate
     # network faults (pod harness; hosts/host name netns "hosts", not ranks)
     host: str = ""                  # degrade_link/kill_host target host
     groups: Tuple[Tuple[str, ...], ...] = ()  # partition: the two host sides
@@ -228,6 +241,19 @@ def _parse_one(spec: str) -> Fault:
             rank=int(kv.pop("rank", -1)), tier=tier,
             secs=_duration_s(kv.pop("secs", "0"), spec),
             after=int(kv.pop("after", 0)),
+            start_after_s=_duration_s(kv.pop("start_after", "0"), spec),
+            **_reject_leftovers(kv, spec),
+        )
+
+    if kind == "burst":
+        if "tenant" not in kv or "rps" not in kv:
+            raise ValueError(f"burst fault needs tenant= and rps=: {spec!r}")
+        rps = float(kv.pop("rps"))
+        if rps <= 0:
+            raise ValueError(f"burst rps must be > 0: {spec!r}")
+        return Fault(
+            kind="burst", tenant=kv.pop("tenant"), rps=rps,
+            secs=_duration_s(kv.pop("secs", "3"), spec),
             start_after_s=_duration_s(kv.pop("start_after", "0"), spec),
             **_reject_leftovers(kv, spec),
         )
@@ -333,6 +359,11 @@ class FaultPlan:
     def serve_phase_faults(self) -> Tuple[Fault, ...]:
         """Per-phase serving delays (on_serve_phase)."""
         return tuple(f for f in self.faults if f.kind == "slow_serve")
+
+    def burst_faults(self) -> Tuple[Fault, ...]:
+        """Synthetic tenant-traffic shapes, executed by the DRILL harness
+        (serving/drill.py), never by a worker-side injector."""
+        return tuple(f for f in self.faults if f.kind == "burst")
 
     def flap_faults(self) -> Tuple[Fault, ...]:
         return tuple(f for f in self.faults if f.kind == "flap")

@@ -505,11 +505,13 @@ class FleetAggregator:
 
         Query params: `series=<prefix>` filters names, `split=rank`
         includes the per-rank `...@N` splits, `rank=N` selects one rank's
-        splits only.  Default: the fleet-summed view."""
+        splits only, `tenant=T` selects the tenant-labeled hist series
+        (`hist:<m>[T]:<pct>`).  Default: the fleet-summed view."""
         from .timeseries import sample_interval_s
 
         query = query or {}
         prefix = (query.get("series") or [""])[0]
+        tenant = (query.get("tenant") or [""])[0]
         rank = None
         if query.get("rank"):
             try:
@@ -518,7 +520,8 @@ class FleetAggregator:
                 rank = None
         include_ranks = (query.get("split") or [""])[0] == "rank"
         snap = self.ts_store.snapshot(prefix=prefix,
-                                      include_ranks=include_ranks, rank=rank)
+                                      include_ranks=include_ranks, rank=rank,
+                                      contains=f"[{tenant}]" if tenant else "")
         snap["interval_s"] = self._sampler.interval_s or sample_interval_s()
         snap["ticks"] = self._sampler.ticks
         return snap

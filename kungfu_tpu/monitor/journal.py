@@ -245,6 +245,24 @@ def read_journal(path: str) -> List[Dict[str, Any]]:
     return out
 
 
+def filter_events(events: Sequence[Dict[str, Any]],
+                  event: Optional[str] = None,
+                  **field_eq: Any) -> List[Dict[str, Any]]:
+    """Select journal events by name and exact field values — e.g.
+    `filter_events(evts, "slot_preempted", tenant="bursty")`.  The
+    drill-side workhorse for tenant-scoped assertions: tenancy events all
+    stamp a `tenant` field, so per-tenant behaviour reads straight out of
+    the merged journal."""
+    out = []
+    for e in events:
+        if event is not None and e.get("event") != event:
+            continue
+        if any(e.get(k) != v for k, v in field_eq.items()):
+            continue
+        out.append(e)
+    return out
+
+
 def merge_journals(paths: Sequence[str]) -> List[Dict[str, Any]]:
     """Merge several processes' journals into one wall-clock-ordered list
     (wall time is the only cross-host merge key; per-host ordering is

@@ -241,6 +241,7 @@ class RequestMonitor:
                    and s["span_id"] != root["span_id"]]
         args = root.get("args") or {}
         tr["req_id"] = args.get("req_id", "")
+        tr["tenant"] = str(args.get("tenant", "") or "")
         tr["status"] = args.get("status", "ok")
         tr["requeues"] = int(args.get("requeues", 0) or 0)
         tr["t0"] = root["t0"]
@@ -341,9 +342,9 @@ class RequestMonitor:
     @staticmethod
     def _summary(tr: dict, spans: bool = False) -> dict:
         out = {k: tr.get(k) for k in (
-            "trace_id", "req_id", "status", "requeues", "t0", "latency_s",
-            "processes", "n_spans", "orphans", "partial", "phases",
-            "dominant_phase", "decode_rounds", "spec_rounds",
+            "trace_id", "req_id", "tenant", "status", "requeues", "t0",
+            "latency_s", "processes", "n_spans", "orphans", "partial",
+            "phases", "dominant_phase", "decode_rounds", "spec_rounds",
             "spec_accepted", "in_breach_window") if k in tr}
         if spans:
             out["spans"] = sorted(

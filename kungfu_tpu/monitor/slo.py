@@ -69,6 +69,10 @@ class SLORule:
     clear_s: Optional[float] = None
     severity: str = "warn"
     description: str = ""
+    # tenant selector: scope a histogram rule to one tenant's labeled
+    # series (hist:<m>[<tenant>]:<pct>) — per-tenant SLOs on the shared
+    # rule schema, no new rule type
+    tenant: str = ""
 
     def __post_init__(self):
         if self.op not in _OPS:
@@ -81,12 +85,32 @@ class SLORule:
     def effective_clear_s(self) -> float:
         return self.sustain_s if self.clear_s is None else self.clear_s
 
+    @property
+    def series_expr(self) -> str:
+        """The store series this rule actually watches: `metric` with the
+        tenant label spliced into each hist side (a `tenant=` on a gauge
+        or rate expr is a no-op — only histograms carry labels)."""
+        if not self.tenant:
+            return self.metric
+
+        def splice(expr: str) -> str:
+            expr = expr.strip()
+            if expr.startswith("hist:"):
+                head, _, pct = expr.rpartition(":")
+                return f"{head}[{self.tenant}]:{pct}"
+            return expr
+
+        if "/" in self.metric:
+            a, _, b = self.metric.partition("/")
+            return f"{splice(a)}/{splice(b)}"
+        return splice(self.metric)
+
     def to_json(self) -> Dict[str, Any]:
         return {
             "name": self.name, "metric": self.metric, "op": self.op,
             "threshold": self.threshold, "sustain_s": self.sustain_s,
             "clear_s": self.effective_clear_s, "severity": self.severity,
-            "description": self.description,
+            "description": self.description, "tenant": self.tenant,
         }
 
     @classmethod
@@ -99,6 +123,7 @@ class SLORule:
                      else None),
             severity=str(obj.get("severity", "warn")),
             description=str(obj.get("description", "")),
+            tenant=str(obj.get("tenant", "")),
         )
 
 
@@ -218,7 +243,7 @@ class SLOEngine:
         self.evaluations += 1
         for rule in self.rules:
             st = self._states[rule.name]
-            got = self._resolve(rule.metric)
+            got = self._resolve(rule.series_expr)
             if got is None:
                 continue  # no_data: hold state, never transition on silence
             t, value = got
@@ -255,6 +280,8 @@ class SLOEngine:
             except Exception as e:  # noqa: BLE001 - never block the breach
                 log.debug("SLO attribution skipped: %s", e)
                 extra = {}
+        if rule.tenant:
+            extra.setdefault("tenant", rule.tenant)
         self.journal(event, rule=rule.name, metric=rule.metric,
                      value=st.last_value, op=rule.op,
                      threshold=rule.threshold, severity=rule.severity,

@@ -187,15 +187,20 @@ class TimeSeriesStore:
             return sorted(self._series)
 
     def snapshot(self, prefix: str = "", include_ranks: bool = False,
-                 rank: Optional[int] = None) -> Dict[str, Any]:
+                 rank: Optional[int] = None,
+                 contains: str = "") -> Dict[str, Any]:
         """JSON-serializable view.  `prefix` filters series names; the
         default hides per-rank splits (`...@N`) — the fleet-summed view;
-        include_ranks=True keeps them, `rank` selects ONE rank's."""
+        include_ranks=True keeps them, `rank` selects ONE rank's.
+        `contains` substring-filters the base name — the /history?tenant=
+        path selects labeled hist series (`...[tenant]...`) with it."""
         with self._lock:
             out: Dict[str, Any] = {}
             for name, s in sorted(self._series.items()):
                 base, _, r = name.partition("@")
                 if prefix and not base.startswith(prefix):
+                    continue
+                if contains and contains not in base:
                     continue
                 if rank is not None:
                     if r != str(rank):

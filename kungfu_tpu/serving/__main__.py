@@ -237,10 +237,23 @@ def main(argv=None) -> int:
     from .router import Autoscaler, Router
 
     counters = counters_if_enabled()
+    from .tenancy import TenantRegistry
+
+    # tenancy is opt-in: no KFT_TENANTS_FILE (and no KV document) means
+    # None, and the router keeps the v1 single-tenant FIFO path; workers
+    # pick the same file up from their inherited environment
+    tenants = TenantRegistry.from_env(client=client)
+    if tenants is not None:
+        print(f"TENANTS: {sorted(tenants.tenants())}", flush=True)
+    # tenanted fleets need dispatch concurrency past the fleet's slot
+    # budget: preemption evidence only exists when ENGINE queues back up,
+    # and the default dispatcher pool (sized for one worker) would cap
+    # in-flight work below total slots and starve them of it
+    dispatchers = 2 * args.slots * max(1, args.max_size) if tenants else 0
     router = Router(
         slots_per_worker=args.slots, queue_capacity=args.queue_capacity,
-        counters=counters,
-    ).start(port=args.port)
+        counters=counters, tenants=tenants,
+    ).start(port=args.port, dispatchers=dispatchers)
     print(f"SERVE_URL: http://127.0.0.1:{router.port}", flush=True)
 
     fleet = None

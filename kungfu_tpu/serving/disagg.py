@@ -149,6 +149,7 @@ def ship_to_decode(urls: List[str], req, first_token: int, rows,
                          span_id=ship_sid, cat="serving",
                          args={"req_id": req.req_id, "url": url,
                                "tokens": int(cursor),
+                               "tenant": req.tenant,
                                "ship_ms": round(ship_ms, 3)})
         if not ack.get("ok"):
             last_err = f"ship rejected by {url}: {ack}"
@@ -213,7 +214,10 @@ class TieredAutoscaler(threading.Thread):
             return
         size = int(health.get("size", 0))
         self._up_streak = self._up_streak + 1 if depth >= self.hi_depth else 0
-        idle = depth == 0 and busy == 0 and self.router.completed > 0
+        # mid-heal (a crashed rank's respawn not yet healthy) is not idle:
+        # shrinking would scale away the peer the supervisor is rebooting
+        idle = (depth == 0 and busy == 0 and self.router.completed > 0
+                and self.router.healthy_count() >= size)
         self._idle_streak = self._idle_streak + 1 if idle else 0
         if self._up_streak >= self.up_after and size < self.max_size:
             if self._commit(comp, grow=True):

@@ -33,8 +33,11 @@ import sys
 import tempfile
 import threading
 import time
+import urllib.error
 import urllib.request
 from typing import Dict, List, Optional
+
+from ..monitor.journal import filter_events
 
 
 def _percentile(xs: List[float], p: float) -> Optional[float]:
@@ -268,14 +271,358 @@ def run_induced_tail_drill(timeout_s: float = 240.0, slow_ms: int = 600,
             **metrics}
 
 
+def run_fairness_drill(timeout_s: float = 300.0,
+                       burst_plan: str = "burst@tenant=bursty:rps=20:secs=3",
+                       threshold_ms: float = 30000.0,
+                       batch_requests: int = 9, batch_new: int = 32,
+                       sensitive_requests: int = 3,
+                       decode_delay_ms: int = 40) -> Dict:
+    """Multi-tenant QoS drill (`python -m kungfu_tpu.chaos --fairness-drill`,
+    docs/serving.md "Multi-tenancy & QoS"): a 3-rank CPU fleet with three
+    tenant classes driven through an adversarial mix, asserting the whole
+    tenancy contract end to end:
+
+      1. rate limiting: a `burst@tenant=bursty:rps=R:secs=S` traffic shape
+         (parsed from the chaos fault grammar, executed CLIENT-side — burst
+         never arms a worker injector) fires well past the bursty tenant's
+         token bucket; the router must journal `tenant_rate_limited` and
+         the client must see 429s, while every ADMITTED request completes
+      2. priority preemption: low-priority batch traffic fills every engine
+         slot, then sensitive-tenant requests arrive; a worker must evict a
+         batch slot (`slot_preempted`), serve the sensitive request, and
+         warm-readmit the victim (`preempted_readmitted`)
+      3. determinism: every preempted-then-readmitted batch prompt replays
+         to byte-identical tokens (greedy decode; the generated prefix
+         re-enters as a prefix-cache graft, not recomputation)
+      4. isolation: the sensitive tenant's client-measured p99 stays inside
+         its per-tenant SLO rule (`tenant=sensitive` selector on the
+         labeled `hist:request_latency_ms[sensitive]:p99` series) and the
+         rule never journals `slo_breach`
+      5. zero drops: router `dropped` stays 0 — QoS pressure degrades and
+         defers, it never silently loses admitted work
+    """
+    failures: List[str] = []
+    metrics: Dict = {"burst_plan": burst_plan, "threshold_ms": threshold_ms}
+    from ..chaos.plan import parse_fault_plan
+    bursts = parse_fault_plan(burst_plan).burst_faults()
+    if not bursts:
+        return {"ok": False, "failures": [f"no burst fault in plan "
+                                          f"{burst_plan!r}"], **metrics}
+
+    tmp = tempfile.mkdtemp(prefix="kft-fairness-drill-")
+    jdir = os.path.join(tmp, "journal")
+    tenants_file = os.path.join(tmp, "tenants.json")
+    slo_file = os.path.join(tmp, "slo.json")
+    with open(tenants_file, "w") as f:
+        json.dump({
+            "default": {"weight": 1.0, "priority": 1},
+            "tenants": {
+                # the protected tenant: 4x scheduling share, highest
+                # priority (preempts batch at the slot layer), SLO-ruled
+                "sensitive": {"weight": 4.0, "priority": 2},
+                # best-effort backfill: lowest priority = preemption victim
+                "batch": {"weight": 1.0, "priority": 0},
+                # the adversary: same class as batch but rate-limited at
+                # the front door (4 req/s, burst of 6)
+                "bursty": {"weight": 1.0, "priority": 0,
+                           "rate": 4.0, "burst": 6.0},
+            },
+        }, f)
+    with open(slo_file, "w") as f:
+        json.dump({"rules": [{
+            "name": "sensitive_latency_p99",
+            "metric": "hist:request_latency_ms:p99",
+            "tenant": "sensitive",
+            "op": "<=", "threshold": threshold_ms,
+            "sustain_s": 2.0, "clear_s": 3.0, "severity": "page",
+            "description": "fairness drill: the sensitive tenant's p99 "
+                           "stays inside its SLO while batch + bursty "
+                           "traffic contends",
+        }]}, f)
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        KFT_TENANTS_FILE=tenants_file,
+        # the burst shape rides the normal fault-plan env to prove it
+        # composes with a REAL worker fault in the same string: the
+        # decode delay holds batch requests in their slots long enough
+        # for the sensitive wave to find every slot occupied (warm tiny
+        # decode on CPU is otherwise too fast to contend with), while
+        # the workers' injectors ignore the burst kind entirely
+        KFT_FAULT_PLAN=(f"{burst_plan};"
+                        f"slow_serve@phase=decode:ms={decode_delay_ms}"),
+        KFT_JOURNAL_DIR=jdir,
+        KFT_SLO_FILE=slo_file,
+        KFT_TS_INTERVAL_S="0.5",
+        KFT_TRACE_BUFFER="65536",
+    )
+    env.pop("XLA_FLAGS", None)
+    cmd = [
+        sys.executable, "-m", "kungfu_tpu.serving", "-np", "3",
+        "--min-size", "3", "--max-size", "3", "--platform", "cpu",
+        "--preset", "tiny", "--slots", "2", "--no-autoscale",
+        "--telemetry", "--timeout", str(int(timeout_s)), "-q",
+    ]
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    lines: List[str] = []
+    pump = threading.Thread(
+        target=lambda: [lines.append(ln) for ln in proc.stdout], daemon=True
+    )
+    pump.start()
+
+    def find(pattern: str, deadline_s: float = 60.0) -> Optional[str]:
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < deadline_s:
+            for line in list(lines):
+                m = re.search(pattern, line)
+                if m:
+                    return m.group(1)
+            if proc.poll() is not None:
+                return None
+            time.sleep(0.1)
+        return None
+
+    stats: Dict = {}
+    try:
+        serve_url = find(r"SERVE_URL: (\S+)")
+        if not serve_url:
+            failures.append("fleet never printed SERVE_URL")
+            return {"ok": False, "failures": failures,
+                    "output_tail": "".join(lines)[-3000:], **metrics}
+        if not find(r"TENANTS: (\[.*\])", 5.0):
+            failures.append("router never loaded the tenant registry "
+                            "(no TENANTS line)")
+        client = _Client(serve_url)
+
+        def get_stats() -> Optional[dict]:
+            try:
+                with urllib.request.urlopen(serve_url + "/stats",
+                                            timeout=3) as r:
+                    return json.loads(r.read().decode())
+            except (OSError, ValueError):
+                return None
+
+        t0 = time.monotonic()
+        healthy = 0
+        while time.monotonic() - t0 < 90:
+            st = get_stats()
+            if st:
+                healthy = sum(1 for w in st["workers"].values()
+                              if w["healthy"])
+                if healthy >= 3:
+                    break
+            time.sleep(0.25)
+        if healthy < 3:
+            failures.append(f"only {healthy}/3 workers came healthy")
+        metrics["boot_s"] = round(time.monotonic() - t0, 3)
+
+        prompts = [[1 + (i % 5), 2, 3 + (i % 7), 4, 5 + (i % 3)]
+                   for i in range(max(batch_requests, 12))]
+
+        # ---- warmup: pay the jit compiles under a throwaway tenant so the
+        # compile-era latencies land in the `warmup` series, never in the
+        # SLO-ruled sensitive one --------------------------------------------------
+        warm_errs: List[str] = []
+
+        def warm_one(i: int) -> None:
+            try:
+                client.generate(prompts[i], 8, timeout_s=120,
+                                tenant="warmup")
+            except OSError as e:
+                warm_errs.append(f"warmup {i}: {e}")
+
+        warm = [threading.Thread(target=warm_one, args=(i,))
+                for i in range(6)]
+        for t in warm:
+            t.start()
+        for t in warm:
+            t.join(timeout=150)
+        if warm_errs:
+            failures.append(f"warmup errors: {warm_errs[:3]}")
+
+        # ---- phase A: the burst shape vs the token bucket --------------------
+        codes: Dict[int, int] = {}
+        burst_errs: List[str] = []
+        burst_threads: List[threading.Thread] = []
+
+        def burst_one(i: int, tenant: str) -> None:
+            try:
+                client.generate(prompts[i % len(prompts)], 4,
+                                timeout_s=120, tenant=tenant)
+                codes[200] = codes.get(200, 0) + 1
+            except urllib.error.HTTPError as e:
+                codes[e.code] = codes.get(e.code, 0) + 1
+            except OSError as e:
+                burst_errs.append(f"burst {i}: {e}")
+
+        for fault in bursts:
+            if fault.start_after_s:
+                time.sleep(fault.start_after_s)
+            n = max(1, int(fault.rps * fault.secs))
+            gap = 1.0 / fault.rps
+            for i in range(n):
+                t = threading.Thread(target=burst_one,
+                                     args=(i, fault.tenant), daemon=True)
+                t.start()
+                burst_threads.append(t)
+                time.sleep(gap)
+        for t in burst_threads:
+            t.join(timeout=120)
+        metrics["burst_codes"] = dict(sorted(codes.items()))
+        if burst_errs:
+            failures.append(f"burst client errors: {burst_errs[:3]}")
+        if not codes.get(429):
+            failures.append("the burst never hit the token bucket "
+                            "(no 429 responses)")
+        if not codes.get(200):
+            failures.append("the bucket admitted nothing from the burst "
+                            "(no 200 responses)")
+
+        # drain the admitted burst backlog before staging the preemption mix
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 60:
+            st = get_stats()
+            if st and st["queue_depth"] == 0 and st["in_flight"] == 0:
+                break
+            time.sleep(0.25)
+
+        # ---- phase B: batch fills every slot, sensitive preempts -------------
+        batch_results: List[Optional[dict]] = [None] * batch_requests
+        sens_lat: List[float] = []
+        mix_errs: List[str] = []
+
+        def batch_one(i: int) -> None:
+            try:
+                batch_results[i] = client.generate(
+                    prompts[i], batch_new, timeout_s=180, tenant="batch")
+            except OSError as e:
+                mix_errs.append(f"batch {i}: {e}")
+
+        def sensitive_one(i: int) -> None:
+            t0 = time.monotonic()
+            try:
+                r = client.generate(prompts[i], 8, timeout_s=180,
+                                    tenant="sensitive")
+                if r["status"] == "ok":
+                    sens_lat.append(time.monotonic() - t0)
+                else:
+                    mix_errs.append(f"sensitive {i}: status {r['status']}")
+            except OSError as e:
+                mix_errs.append(f"sensitive {i}: {e}")
+
+        batch_threads = [threading.Thread(target=batch_one, args=(i,))
+                         for i in range(batch_requests)]
+        for t in batch_threads:
+            t.start()
+        # give the batch wave time to occupy every engine slot (decode is
+        # warm — fast — so don't wait long enough for it to finish)
+        time.sleep(0.5)
+        sens_threads = [threading.Thread(target=sensitive_one, args=(i,))
+                        for i in range(sensitive_requests)]
+        for t in sens_threads:
+            t.start()
+        for t in batch_threads + sens_threads:
+            t.join(timeout=240)
+        if mix_errs:
+            failures.append(f"mix client errors: {mix_errs[:3]}")
+        done = [r for r in batch_results
+                if r is not None and r["status"] == "ok"]
+        if len(done) != batch_requests:
+            failures.append(f"only {len(done)}/{batch_requests} batch "
+                            "requests completed (preemption dropped work?)")
+
+        # the preemption evidence is journaled by the WORKER process; its
+        # emit is flushed, but give the fs a moment under load
+        preempted: List[dict] = []
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 30:
+            events = _journal_events(jdir)
+            preempted = filter_events(events, "slot_preempted")
+            if preempted and filter_events(events, "preempted_readmitted"):
+                break
+            time.sleep(0.5)
+
+        # a few post-contention sensitive probes pad the client-side p99
+        # sample beyond the contended trio
+        for i in range(3):
+            sensitive_one(i + sensitive_requests)
+
+        # ---- phase C: byte-identical replay of the (possibly preempted)
+        # batch prompts on the now-idle fleet ----------------------------------
+        for i, r in enumerate(batch_results):
+            if r is None or r["status"] != "ok":
+                continue
+            try:
+                replay = client.generate(prompts[i], batch_new,
+                                         timeout_s=120, tenant="batch")
+            except OSError as e:
+                failures.append(f"replay {i} failed: {e}")
+                continue
+            if replay["tokens"] != r["tokens"]:
+                failures.append(
+                    f"batch prompt {i} diverged after preemption churn: "
+                    f"{r['tokens']} vs {replay['tokens']}")
+        stats = get_stats() or {}
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+        pump.join(timeout=5)
+
+    # ---- journal + stats assertions ------------------------------------------
+    events = _journal_events(jdir)
+    limited = filter_events(events, "tenant_rate_limited", tenant="bursty")
+    if not limited:
+        failures.append("no tenant_rate_limited journal event for the "
+                        "bursty tenant")
+    preempted = filter_events(events, "slot_preempted")
+    readmitted = filter_events(events, "preempted_readmitted")
+    if not preempted:
+        failures.append("no slot_preempted journal event — the sensitive "
+                        "tenant never displaced a batch slot")
+    if not readmitted:
+        failures.append("no preempted_readmitted journal event — evicted "
+                        "batch work never resumed")
+    breaches = filter_events(events, "slo_breach",
+                             rule="sensitive_latency_p99")
+    if breaches:
+        failures.append(
+            f"sensitive tenant breached its SLO {len(breaches)}x "
+            f"(value={breaches[0].get('value')})")
+    p99 = _percentile(sens_lat, 0.99)
+    metrics["sensitive_p99_s"] = round(p99, 3) if p99 is not None else None
+    if p99 is None:
+        failures.append("no successful sensitive-tenant requests")
+    elif p99 > threshold_ms / 1000.0:
+        failures.append(f"client-measured sensitive p99 {p99:.3f}s exceeds "
+                        f"the {threshold_ms / 1000.0:g}s SLO")
+    if stats.get("dropped", 0) != 0:
+        failures.append(f"router reports dropped={stats.get('dropped')}")
+    metrics.update(
+        rate_limited=len(limited),
+        preemptions=len(preempted),
+        readmits=len(readmitted),
+        tenancy_stats=stats.get("tenancy", {}),
+    )
+    return {"ok": not failures, "failures": failures,
+            "output_tail": "".join(lines)[-3000:] if failures else "",
+            **metrics}
+
+
 class _Client:
     def __init__(self, url: str):
         self.url = url
 
-    def generate(self, prompt, max_new: int, timeout_s: float = 120.0) -> dict:
-        body = json.dumps(
-            {"prompt": list(prompt), "max_new_tokens": max_new}
-        ).encode()
+    def generate(self, prompt, max_new: int, timeout_s: float = 120.0,
+                 tenant: str = "") -> dict:
+        payload = {"prompt": list(prompt), "max_new_tokens": max_new}
+        if tenant:
+            payload["tenant"] = tenant
+        body = json.dumps(payload).encode()
         req = urllib.request.Request(
             self.url + "/v1/generate", data=body, method="POST",
             headers={"Content-Type": "application/json"},

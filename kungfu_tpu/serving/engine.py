@@ -61,6 +61,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.transformer import TransformerConfig, TransformerLM
+from ..monitor.journal import journal_event
 from ..utils import get_logger
 from ..utils.trace import TraceContext, child_span, trace_context, trace_scope
 from .queue import AdmissionQueue
@@ -68,10 +69,12 @@ from .request import Request, Result
 from .slots import (
     SlotManager,
     extract_rows,
+    extract_slot_rows,
     reset_slot,
     warm_small_cache,
     write_slot,
 )
+from .tenancy import TenantRegistry, WeightedFairQueue
 
 log = get_logger("kungfu.serving")
 
@@ -117,6 +120,7 @@ class ServingEngine:
         counters=None,
         prefix_cache=None,
         spec=None,
+        tenants: Optional[TenantRegistry] = None,
     ):
         assert cfg.rope, "serving decode requires a rope config (cache cursors)"
         # decode overrides mirror generate(): full attention on the cache, a
@@ -126,8 +130,14 @@ class ServingEngine:
         )
         self.model = TransformerLM(self.dcfg)
         self.n_slots = slots
-        self.queue = AdmissionQueue(queue_capacity)
+        self.tenants = tenants
+        if tenants is not None:
+            # tenanted: weighted-fair slot admission + priority preemption
+            self.queue = WeightedFairQueue(queue_capacity, registry=tenants)
+        else:
+            self.queue = AdmissionQueue(queue_capacity)
         self.slot_mgr = SlotManager(slots)
+        self.preemptions = 0
         self.counters = counters
         self.buckets = tuple(sorted(prefill_buckets or default_buckets(cfg.max_len)))
         assert self.buckets[-1] <= cfg.max_len
@@ -283,6 +293,8 @@ class ServingEngine:
         done: List[Result] = []
         for req in self.queue.drain_expired():
             done.append(self._finish(req, status="expired"))
+        if self.tenants is not None:
+            self._maybe_preempt()
         while self.slot_mgr.free_count:
             req = self.queue.pop()
             if req is None:
@@ -310,6 +322,67 @@ class ServingEngine:
 
     # -- internals -----------------------------------------------------------------
 
+    def _maybe_preempt(self) -> None:
+        """Priority preemption: when every slot is busy and the queue's next
+        request outranks the lowest-priority in-flight request, evict that
+        slot.  Eviction is cheap by construction — the victim's generated
+        tokens fold into `prior_tokens` (greedy decode is deterministic, so
+        the resumed stream is byte-identical) and its KV rows enter the
+        radix prefix cache, making the eventual re-prefill a warm hit.  At
+        most ONE preemption per request (the `_preempted` flag), so a
+        starved class degrades to at-least-half progress, never livelock."""
+        if self.slot_mgr.free_count or not self.queue.depth():
+            return
+        head_prio = self.queue.head_priority()
+        if head_prio is None:
+            return
+        victim_slot, victim, victim_prio = None, None, None
+        for slot, req in self.slot_mgr.active().items():
+            folded = len(req.prefill_tokens) + len(req.generated)
+            if folded > self.buckets[-1]:
+                # the folded resume prefix must fit a prefill bucket (a
+                # prefix-cache hit usually shrinks it, but eviction can't
+                # be ruled out) — an unresumable victim is not a victim
+                continue
+            p = self.tenants.classify(req.tenant).priority
+            if victim_prio is None or p < victim_prio:
+                victim_slot, victim, victim_prio = slot, req, p
+        if (victim is None or head_prio <= victim_prio
+                or getattr(victim, "_preempted", False)):
+            return
+        self._preempt(victim_slot, victim, head_prio)
+
+    def _preempt(self, slot: int, req: Request, head_prio: int) -> None:
+        cursor = int(self._cursor[slot])
+        # fold progress into the warm-resume prefix.  The cache holds
+        # prefill + generated - 1 rows (the newest token is still pending in
+        # _next_tok), i.e. exactly `cursor` rows — the prefix-cache key must
+        # match that row count, not the full folded stream
+        req.prior_tokens = tuple(req.prior_tokens) + tuple(req.generated)
+        req.generated = []
+        if self.prefix is not None and cursor > 0:
+            self.prefix.insert(
+                tuple(req.prefill_tokens[:cursor]),
+                lambda: extract_slot_rows(self.cache, slot, cursor))
+        self.slot_mgr.release(slot)
+        self.cache = reset_slot(self.cache, slot)
+        self._next_tok[slot] = 0
+        self._cursor[slot] = 0
+        if self.spec is not None:
+            self.spec.release_slot(slot)
+        req._preempted = True  # type: ignore[attr-defined]
+        # re-tag as a fresh arrival: the victim already consumed service, so
+        # keeping its old (minimal) fair tag would pop it straight back into
+        # the slot it just vacated, ahead of the request that preempted it
+        req._wfq_tag = None  # type: ignore[attr-defined]
+        self.preemptions += 1
+        self._count("slot_preempted")
+        journal_event("slot_preempted", slot=slot, req_id=req.req_id,
+                      tenant=req.tenant, for_priority=head_prio,
+                      warm_tokens=len(req.prior_tokens),
+                      trace_id=req.trace_id)
+        self.queue.requeue(req, count=False)
+
     def _bucket_for(self, n: int) -> int:
         for b in self.buckets:
             if n <= b:
@@ -331,6 +404,14 @@ class ServingEngine:
             child_span("queue:wait", req.queued_t, trace_id=ctx.trace_id,
                        parent_id=ctx.span_id, cat="serving",
                        args={"req_id": req.req_id, "slot": slot})
+        if getattr(req, "_preempted", False):
+            # the resume half of the preemption pair: the folded prefix
+            # re-prefills (warm, via the rows _preempt inserted) and the
+            # stream continues byte-identically
+            journal_event("preempted_readmitted", slot=slot,
+                          req_id=req.req_id, tenant=req.tenant,
+                          warm_tokens=len(req.prior_tokens),
+                          trace_id=req.trace_id)
         graft = self._grafts.pop(req.req_id, None)
         if graft is not None:
             self._admit_prefilled(slot, req, *graft)
@@ -635,6 +716,7 @@ class ServingEngine:
             "total_tokens": self.total_tokens,
             "total_prefill_tokens": self.total_prefill_tokens,
             "total_completed": self.total_completed,
+            "preemptions": self.preemptions,
         }
         if self.prefix is not None:
             out["prefix"] = self.prefix.stats()

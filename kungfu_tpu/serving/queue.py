@@ -32,12 +32,17 @@ class AdmissionQueue:
         self._q: deque = deque()
         self._expired: List[Request] = []
 
-    def put(self, req: Request) -> bool:
-        """Admit at the tail; False = over capacity (backpressure)."""
+    def put(self, req: Request, force: bool = False) -> bool:
+        """Admit at the tail; False = over capacity (backpressure).
+        `force` admits up to 2x capacity — the overload ladder's extend
+        rung trades latency for completion instead of bouncing."""
         with self._lock:
-            if len(self._q) >= self.capacity:
+            limit = self.capacity * 2 if force else self.capacity
+            if len(self._q) >= limit:
                 return False
             req.queued_t = time.monotonic()  # queue:wait span anchor
+            if not req.t_admitted:
+                req.t_admitted = req.queued_t
             self._q.append(req)
             self._lock.notify()
             return True
@@ -47,7 +52,10 @@ class AdmissionQueue:
         waited its turn once; capacity is not re-checked — a re-queue must
         never drop).  Bumps the request's requeue count unless
         `count=False` (backpressure re-queues are flow control, not
-        failures — they must not pollute the failover MTTR anchors)."""
+        failures — they must not pollute the failover MTTR anchors).
+        `t_admitted` is deliberately NOT reset: a failover victim's
+        queue:wait span, deadline sweep, and fairness ordering keep the
+        original admission anchor instead of re-aging from zero."""
         with self._lock:
             if count:
                 req.requeues += 1

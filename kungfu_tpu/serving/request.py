@@ -38,6 +38,12 @@ class Request:
     eos_id: int = -1                      # -1: no early stop
     deadline_s: float = 0.0               # 0: no deadline
     prior_tokens: Tuple[int, ...] = ()    # warm-resume: already generated
+    # tenancy: tenant name ("" = anonymous -> default class); carried_age_s
+    # is how long the request had ALREADY lived when it crossed a process
+    # boundary (router -> worker), so the deadline keeps its original clock
+    # without disturbing submitted_t (which anchors local ttft/latency)
+    tenant: str = ""
+    carried_age_s: float = 0.0
     submitted_t: float = dataclasses.field(default_factory=time.monotonic)
     # distributed trace context (utils.trace): trace_id names the request's
     # trace end to end; parent_span is the CALLER's span for the current hop
@@ -53,6 +59,10 @@ class Request:
     # local bookkeeping (never serialized): last queue-entry stamp (queue
     # wait spans), decode-phase start, decode/verify rounds consumed
     queued_t: float = dataclasses.field(default_factory=time.monotonic)
+    # first-admission stamp (0 = unset); unlike queued_t it SURVIVES
+    # requeues, so a failover-touched request's queue:wait span and the
+    # fairness ordering keep the original admission anchor
+    t_admitted: float = 0.0
     decode_t0: Optional[float] = None
     decode_rounds: int = 0
 
@@ -81,7 +91,7 @@ class Request:
         if not self.deadline_s:
             return False
         now = time.monotonic() if now is None else now
-        return now - self.submitted_t > self.deadline_s
+        return now - self.submitted_t + self.carried_age_s > self.deadline_s
 
     def all_tokens(self) -> List[int]:
         return list(self.prompt) + list(self.prior_tokens) + list(self.generated)
@@ -98,6 +108,11 @@ class Request:
             "requeues": self.requeues,
             "trace_id": self.trace_id,
             "parent_span": self.parent_span,
+            "tenant": self.tenant,
+            # age already consumed on this side; the receiver folds it into
+            # its own deadline clock via carried_age_s
+            "age_s": round(
+                time.monotonic() - self.submitted_t + self.carried_age_s, 6),
         }
 
     @classmethod
@@ -113,6 +128,8 @@ class Request:
             requeues=int(d.get("requeues", 0)),
             trace_id=str(d.get("trace_id", "")),
             parent_span=str(d.get("parent_span", "")),
+            tenant=str(d.get("tenant", "")),
+            carried_age_s=float(d.get("age_s", 0.0)),
         )
 
 

@@ -34,6 +34,12 @@ from ..utils import get_logger
 from ..utils import trace as T
 from .queue import AdmissionQueue
 from .request import Request, Result
+from .tenancy import (
+    OverloadLadder,
+    RateLimiter,
+    TenantRegistry,
+    WeightedFairQueue,
+)
 
 log = get_logger("kungfu.serving")
 
@@ -51,9 +57,20 @@ class WorkerRef:
 class Router:
     def __init__(self, slots_per_worker: int = 4, queue_capacity: int = 256,
                  counters=None, probe_s: float = 0.25,
-                 request_timeout_s: float = 120.0):
+                 request_timeout_s: float = 120.0,
+                 tenants: Optional[TenantRegistry] = None):
         self.slots_per_worker = slots_per_worker
-        self.queue = AdmissionQueue(queue_capacity)
+        self.tenants = tenants
+        if tenants is not None:
+            # tenancy configured: weighted-fair queue + front-door policy
+            self.queue = WeightedFairQueue(queue_capacity, registry=tenants)
+            self.limiter = RateLimiter(tenants, counters=counters)
+            self.ladder = OverloadLadder(tenants, queue_capacity,
+                                         counters=counters)
+        else:
+            self.queue = AdmissionQueue(queue_capacity)
+            self.limiter = None
+            self.ladder = None
         self.counters = counters
         self.probe_s = probe_s
         self.request_timeout_s = request_timeout_s
@@ -113,7 +130,7 @@ class Router:
 
     # -- submission ----------------------------------------------------------------
 
-    def submit(self, req: Request) -> bool:
+    def submit(self, req: Request, force: bool = False) -> bool:
         """False = backpressure (queue full)."""
         holder: Dict[str, object] = {"event": threading.Event(),
                                      "result": None,
@@ -127,12 +144,31 @@ class Router:
             holder["inbound_parent"] = req.parent_span
         with self._lock:
             self._results[req.req_id] = holder
-        if not self.queue.put(req):
+        if not self.queue.put(req, force=force):
             with self._lock:
                 del self._results[req.req_id]
             return False
         self._gauge()
         return True
+
+    def admit(self, req: Request):
+        """Front-door admission: classify FIRST, then decide.  The v1 path
+        decided the backpressure 503 before the tenant class was known, so
+        overload hit every class as one global cliff; here the token bucket
+        and the overload ladder see the classified request before the queue
+        capacity check runs.  Returns (http_status, error) — (200, "") means
+        admitted."""
+        if self.tenants is None:
+            return (200, "") if self.submit(req) else (503, "queue full")
+        if not self.limiter.admit(req):
+            return 429, "rate limited"
+        spec = self.tenants.classify(req.tenant)
+        action = self.ladder.admit(req, spec, self.queue.depth())
+        if action == "shed":
+            return 503, "shed under overload"
+        if not self.submit(req, force=(action == "force")):
+            return 503, "queue full"
+        return 200, ""
 
     def _trace_ids(self, req: Request) -> tuple:
         """(trace_id, root_span_id) for a live request, or ("", "")."""
@@ -168,7 +204,7 @@ class Router:
                 parent_id=str(holder.get("inbound_parent", "")),
                 span_id=str(holder["root"]), cat="serving",
                 args={"req_id": req.req_id, "status": result.status,
-                      "requeues": result.requeues},
+                      "requeues": result.requeues, "tenant": req.tenant},
             )
         if result.status == "ok":
             self.completed += 1
@@ -194,6 +230,12 @@ class Router:
                           if t0 is not None else result.latency_ms)
                 if lat_ms is not None:
                     self.counters.observe_hist("request_latency_ms", lat_ms)
+                    if req.tenant:
+                        # per-tenant series (hist:request_latency_ms[T]:p99)
+                        # — what tenant-scoped SLO rules and /history?tenant=
+                        # read
+                        self.counters.observe_hist("request_latency_ms",
+                                                   lat_ms, label=req.tenant)
         else:
             self.expired += 1
             self._count("requests_expired")
@@ -210,6 +252,12 @@ class Router:
             decode_n = sum(1 for w in self._workers.values()
                            if w.tier == "decode")
             cap = self.slots_per_worker * (max(1, decode_n) if tiered else 1)
+            if self.tenants is not None and not tiered:
+                # tenanted: over-dispatch so the ENGINE queue sees the
+                # contention — priority preemption triggers at the slot
+                # layer, and a router that never sends more than
+                # slots_per_worker requests would starve it of evidence
+                cap *= 2
             candidates = [w for w in self._workers.values()
                           if w.healthy and w.in_flight < cap
                           and (not tiered or w.tier == "prefill")]
@@ -254,8 +302,12 @@ class Router:
                     continue
                 tid, root = self._trace_ids(req)
                 if tid:
-                    T.child_span("queue:wait", req.queued_t, trace_id=tid,
-                                 parent_id=root, cat="serving",
+                    # anchor at first admission, not the latest (re)queue
+                    # entry: a failover-touched request's wait span covers
+                    # its WHOLE time in line
+                    T.child_span("queue:wait",
+                                 req.t_admitted or req.queued_t,
+                                 trace_id=tid, parent_id=root, cat="serving",
                                  args={"req_id": req.req_id})
                 with self._lock:
                     self._active += 1
@@ -370,7 +422,7 @@ class Router:
                       peer=str(dead) if dead is not None else "?",
                       error=err, decode_loss=True,
                       warm_tokens=len(req.prior_tokens) if resumed else 0,
-                      trace_id=req.trace_id)
+                      tenant=req.tenant, trace_id=req.trace_id)
         self._trace_requeue(req, str(dead) if dead is not None else "?",
                             resumed, decode_loss=True)
         # beat before re-queueing: the prefill proxy stays healthy, so a
@@ -399,7 +451,7 @@ class Router:
         journal_event("request_requeued", req_id=req.req_id,
                       peer=str(w.peer), error=err,
                       warm_tokens=len(req.prior_tokens) if resumed else 0,
-                      trace_id=req.trace_id)
+                      tenant=req.tenant, trace_id=req.trace_id)
         self._trace_requeue(req, str(w.peer), resumed)
         self.queue.requeue(req)
 
@@ -546,8 +598,9 @@ class Router:
                 except (ValueError, KeyError) as e:
                     self._send(400, json.dumps({"error": str(e)}).encode())
                     return
-                if not outer.submit(req):
-                    self._send(503, b'{"error": "queue full"}')
+                code, err = outer.admit(req)
+                if code != 200:
+                    self._send(code, json.dumps({"error": err}).encode())
                     return
                 result = outer.wait(req.req_id, outer.request_timeout_s)
                 if result is None:
@@ -566,7 +619,7 @@ class Router:
         return self
 
     def stats(self) -> dict:
-        return {
+        out = {
             "queue_depth": self.queue.depth(),
             "in_flight": self.active_requests(),
             "workers": {
@@ -579,6 +632,17 @@ class Router:
             "expired": self.expired,
             "dropped": 0,  # by construction; the drill asserts it anyway
         }
+        if self.tenants is not None:
+            out["tenancy"] = {
+                "rate_limited": self.limiter.rejections,
+                "shed": self.ladder.sheds,
+                "clamped": self.ladder.clamps,
+                "extended": self.ladder.extends,
+                "overload_rung": self.ladder.rung(),
+                "queue_by_tenant": self.queue.per_tenant_depth(),
+                "served_tokens": dict(self.queue.served_tokens),
+            }
+        return out
 
     def close(self) -> None:
         self._stop.set()
@@ -648,8 +712,13 @@ class Autoscaler(threading.Thread):
         self._up_streak = self._up_streak + 1 if depth >= self.hi_depth else 0
         # idle = nothing queued, nothing in flight, AND the fleet has served
         # at least one request — a freshly provisioned fleet waiting for its
-        # first traffic is "warming", not "idle", and must not shed workers
-        idle = depth == 0 and busy == 0 and self.router.completed > 0
+        # first traffic is "warming", not "idle", and must not shed workers.
+        # A fleet mid-heal (a crashed worker's respawn not yet healthy) is
+        # not idle either: shrinking now would scale away the exact peer the
+        # supervisor is rebooting, turning a one-rank blip into lost
+        # capacity and racing the victim's rank_rejoined heal record
+        idle = (depth == 0 and busy == 0 and self.router.completed > 0
+                and self.router.healthy_count() >= size)
         self._idle_streak = self._idle_streak + 1 if idle else 0
         if self._up_streak >= self.up_after and size < self.max_size:
             if self._commit(size + 1, "scale_up", depth):

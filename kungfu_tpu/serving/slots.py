@@ -108,6 +108,21 @@ def extract_rows(small, n: int) -> Dict[tuple, np.ndarray]:
             for k, v in jax.device_get(out).items()}
 
 
+def extract_slot_rows(big, slot: int, n: int) -> Dict[tuple, np.ndarray]:
+    """extract_rows for one row of the BIG [slots, max_len, ...] cache:
+    host-copy the first `n` KV rows of `slot`.  The preemption path feeds
+    these to the radix prefix cache so the evicted request's re-prefill is
+    a warm hit.  Same discipline as extract_rows — batched device_get,
+    HOST-side slicing — so no per-(slot, length) slice programs compile."""
+    out = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(big)[0]:
+        if _leaf_name(path) in CURSOR_LEAVES:
+            continue
+        out[tuple(str(p) for p in path)] = leaf
+    return {k: np.ascontiguousarray(v[slot, :n])
+            for k, v in jax.device_get(out).items()}
+
+
 def warm_small_cache(template, rows: Dict[tuple, np.ndarray], n: int):
     """Build a batch-1 cache whose first `n` rows are `rows` and whose
     cursor sits at `n` — the graft input for a prefix-cache hit (prefill

@@ -1,0 +1,52 @@
+"""Multi-tenant serving QoS — per-tenant identity through the whole stack.
+
+The serving fleet up to v2 served one anonymous FIFO; at "millions of
+users" scale the traffic is thousands of tenants with distinct priorities
+and SLOs contending for the same KV slots, and without isolation one
+bursty tenant destroys every other tenant's p99.  This package threads a
+tenant name (the `tenant` field on every Request) from admission to
+journal:
+
+  limits.py     tenant registry (KFT_TENANTS_FILE / config-server KV,
+                hot-reloadable; unknown tenants land in the default class)
+                + token-bucket rate limiting at the router front door
+  scheduler.py  weighted-fair queueing: virtual-finish-time ordering over
+                per-tenant sub-queues, deficit accounted in TOKENS (not
+                request counts) so long prompts can't starve short ones;
+                drop-in replacement for the FIFO AdmissionQueue at both
+                the router dispatch and the engine's slot admission
+  overload.py   graded degradation ladder replacing the 503 cliff:
+                shed lowest class -> clamp max_tokens per class -> queue
+                with extended deadline, driven by the same
+                queue-composition signal the TieredAutoscaler reads
+
+Priority preemption (the fourth piece) lives in serving/engine.py: under
+pressure the engine evicts the lowest-priority in-flight slot and folds
+its generated tokens into `prior_tokens`, so re-admission re-prefills a
+deterministic greedy prefix (byte-identical resumed output) — made cheap
+by the radix prefix cache, which receives the evicted slot's KV rows.
+
+Everything here is off by default: with no tenant config the router and
+engine keep their v1 FIFO queues, anonymous traffic is one default
+tenant, and no new compile signatures exist.  See docs/serving.md
+"Multi-tenancy & QoS".
+"""
+from .limits import (
+    TENANTS_FILE_ENV,
+    RateLimiter,
+    TenantRegistry,
+    TenantSpec,
+    TokenBucket,
+)
+from .overload import OverloadLadder
+from .scheduler import WeightedFairQueue
+
+__all__ = [
+    "TENANTS_FILE_ENV",
+    "OverloadLadder",
+    "RateLimiter",
+    "TenantRegistry",
+    "TenantSpec",
+    "TokenBucket",
+    "WeightedFairQueue",
+]

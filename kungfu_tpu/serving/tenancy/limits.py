@@ -1,0 +1,256 @@
+"""Tenant registry + token-bucket admission limits.
+
+Tenant config is one JSON document, from a file (`KFT_TENANTS_FILE`) or
+the config server's KV plane (key ``tenants/config``):
+
+    {"default": {"weight": 1.0, "priority": 1},
+     "tenants": {
+       "sensitive": {"weight": 4.0, "priority": 2},
+       "bursty":    {"weight": 1.0, "priority": 0,
+                     "rate": 4.0, "burst": 6.0}}}
+
+Every field is optional.  `weight` drives the weighted-fair scheduler
+(tenancy/scheduler.py), `priority` drives preemption and the overload
+ladder's shed rung (higher = more important), `rate`/`burst` arm a
+token bucket at the router front door (requests/sec sustained, bucket
+size; 0 = unlimited).  `max_tokens_clamp` optionally pins the overload
+ladder's per-class clamp.  Unknown (and anonymous) tenants classify into
+the `default` class.
+
+The registry hot-reloads: the file's mtime is polled (at most every
+`reload_s`) on classify, and a config-server KV source re-fetches on the
+same cadence — a tenant onboarding or a weight change needs no fleet
+restart.  Reload failures keep the last good table (a typo'd push must
+not strip every tenant to default mid-traffic).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from ...monitor.journal import journal_event
+from ...utils import get_logger
+
+log = get_logger("kungfu.tenancy")
+
+TENANTS_FILE_ENV = "KFT_TENANTS_FILE"
+TENANTS_KV_KEY = "tenants/config"
+DEFAULT_CLASS = "default"
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantSpec:
+    """One tenant class: scheduling weight, preemption priority, and the
+    front-door token-bucket parameters."""
+
+    name: str = DEFAULT_CLASS
+    weight: float = 1.0
+    priority: int = 1
+    rate: float = 0.0              # sustained requests/sec; 0 = unlimited
+    burst: float = 0.0             # bucket size; 0 = rate (min 1)
+    max_tokens_clamp: int = 0      # overload clamp rung override; 0 = ladder default
+
+    def __post_init__(self):
+        if self.weight <= 0:
+            raise ValueError(f"tenant {self.name!r}: weight must be > 0")
+        if self.rate < 0 or self.burst < 0:
+            raise ValueError(f"tenant {self.name!r}: rate/burst must be >= 0")
+
+    @classmethod
+    def from_json(cls, name: str, obj: Dict[str, Any]) -> "TenantSpec":
+        return cls(
+            name=name,
+            weight=float(obj.get("weight", 1.0)),
+            priority=int(obj.get("priority", 1)),
+            rate=float(obj.get("rate", 0.0)),
+            burst=float(obj.get("burst", 0.0)),
+            max_tokens_clamp=int(obj.get("max_tokens_clamp", 0)),
+        )
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"weight": self.weight, "priority": self.priority,
+                "rate": self.rate, "burst": self.burst,
+                "max_tokens_clamp": self.max_tokens_clamp}
+
+
+class TenantRegistry:
+    """Tenant-name -> TenantSpec table with hot reload.
+
+    `classify` never fails: unknown tenants (and the anonymous "" tenant)
+    get the default class, so untenanted traffic flows exactly as before
+    tenancy existed — one default tenant."""
+
+    def __init__(self, specs: Optional[Dict[str, TenantSpec]] = None,
+                 default: Optional[TenantSpec] = None, path: str = "",
+                 client=None, reload_s: float = 0.25):
+        self._lock = threading.Lock()
+        self._specs: Dict[str, TenantSpec] = dict(specs or {})
+        self._default = default or TenantSpec()
+        self._path = path
+        self._client = client
+        self._reload_s = reload_s
+        self._checked_t = 0.0
+        self._mtime = 0.0
+        self.reloads = 0
+        if path:
+            self._reload_file(initial=True)
+        elif client is not None:
+            self._reload_kv()
+
+    # -- construction ------------------------------------------------------------
+
+    @classmethod
+    def from_env(cls, client=None) -> Optional["TenantRegistry"]:
+        """The deployment entry point: a registry when tenancy is
+        configured (KFT_TENANTS_FILE, or a config-server KV document),
+        else None — callers keep the single-tenant FIFO path."""
+        path = os.environ.get(TENANTS_FILE_ENV, "")
+        if path:
+            return cls(path=path)
+        if client is not None:
+            try:
+                if client.kv_get(TENANTS_KV_KEY) is not None:
+                    return cls(client=client)
+            except OSError:
+                pass
+        return None
+
+    @staticmethod
+    def _parse(obj: Dict[str, Any]):
+        default = TenantSpec.from_json(DEFAULT_CLASS,
+                                       obj.get("default", {}) or {})
+        specs = {name: TenantSpec.from_json(name, spec or {})
+                 for name, spec in (obj.get("tenants", {}) or {}).items()}
+        return specs, default
+
+    def _adopt(self, obj: Dict[str, Any]) -> None:
+        specs, default = self._parse(obj)
+        with self._lock:
+            self._specs, self._default = specs, default
+            self.reloads += 1
+
+    def _reload_file(self, initial: bool = False) -> None:
+        try:
+            mtime = os.stat(self._path).st_mtime
+            if not initial and mtime == self._mtime:
+                return
+            with open(self._path) as f:
+                obj = json.load(f)
+            self._adopt(obj)
+            self._mtime = mtime
+            if not initial:
+                log.info("tenant config reloaded from %s (%d tenants)",
+                         self._path, len(self._specs))
+        except (OSError, ValueError) as e:
+            # keep the last good table — a torn write or a typo'd push
+            # must not demote every tenant to the default class
+            log.warning("tenant config %s unreadable (%s); keeping %d "
+                        "tenants", self._path, e, len(self._specs))
+
+    def _reload_kv(self) -> None:
+        try:
+            doc = self._client.kv_get(TENANTS_KV_KEY)
+            if doc is None:
+                return
+            if isinstance(doc, str):
+                doc = json.loads(doc)
+            self._adopt(doc)
+        except (OSError, ValueError) as e:
+            log.warning("tenant KV config unreadable (%s); keeping %d "
+                        "tenants", e, len(self._specs))
+
+    def _maybe_reload(self) -> None:
+        now = time.monotonic()
+        if now - self._checked_t < self._reload_s:
+            return
+        self._checked_t = now
+        if self._path:
+            self._reload_file()
+        elif self._client is not None:
+            self._reload_kv()
+
+    # -- lookup ------------------------------------------------------------------
+
+    def classify(self, tenant: str) -> TenantSpec:
+        self._maybe_reload()
+        with self._lock:
+            return self._specs.get(tenant or "", self._default)
+
+    def default(self) -> TenantSpec:
+        with self._lock:
+            return self._default
+
+    def tenants(self) -> Dict[str, TenantSpec]:
+        with self._lock:
+            return dict(self._specs)
+
+    def to_json(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"default": self._default.to_json(),
+                    "tenants": {n: s.to_json()
+                                for n, s in sorted(self._specs.items())}}
+
+
+class TokenBucket:
+    """Classic token bucket: `burst` capacity refilled at `rate`/sec.
+    Not internally locked — RateLimiter serializes access."""
+
+    def __init__(self, rate: float, burst: float):
+        self.rate = max(0.0, rate)
+        self.burst = max(1.0, burst or rate)
+        self.tokens = self.burst
+        self._t = time.monotonic()
+
+    def allow(self, n: float = 1.0, now: Optional[float] = None) -> bool:
+        if self.rate <= 0:
+            return True
+        now = time.monotonic() if now is None else now
+        # max(0, ...): a caller-supplied clock running behind the bucket's
+        # birth time must not refill negatively and eat the burst
+        self.tokens = min(self.burst,
+                          self.tokens + max(0.0, now - self._t) * self.rate)
+        self._t = now
+        if self.tokens >= n:
+            self.tokens -= n
+            return True
+        return False
+
+
+class RateLimiter:
+    """Per-tenant token buckets at the router front door.  A rejection is
+    an explicit 429 (flow control, never a drop) journaled with the
+    tenant and the request's trace id — the fairness drill's first
+    intervention signal."""
+
+    def __init__(self, registry: TenantRegistry, counters=None):
+        self.registry = registry
+        self.counters = counters
+        self._lock = threading.Lock()
+        self._buckets: Dict[str, TokenBucket] = {}
+        self.rejections = 0
+
+    def admit(self, req) -> bool:
+        spec = self.registry.classify(req.tenant)
+        if spec.rate <= 0:
+            return True
+        with self._lock:
+            bucket = self._buckets.get(req.tenant)
+            # re-arm on config change so a rate push applies immediately
+            if (bucket is None or bucket.rate != spec.rate
+                    or bucket.burst != max(1.0, spec.burst or spec.rate)):
+                bucket = self._buckets[req.tenant] = TokenBucket(
+                    spec.rate, spec.burst)
+            ok = bucket.allow()
+            if not ok:
+                self.rejections += 1
+        if not ok:
+            journal_event("tenant_rate_limited", tenant=req.tenant,
+                          tenant_class=spec.name, req_id=req.req_id,
+                          rate=spec.rate, trace_id=req.trace_id)
+            if self.counters is not None:
+                self.counters.inc_event("tenant_rate_limited")
+        return ok

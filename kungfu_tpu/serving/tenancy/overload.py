@@ -1,0 +1,136 @@
+"""Graded overload control — a degradation ladder instead of a 503 cliff.
+
+The v1 front door had exactly two states: admit, or 503 when the
+admission queue hit capacity.  Under a burst that cliff punishes every
+tenant equally — the latency-sensitive tenant's request is just as
+likely to bounce as the bursty tenant's.  The ladder degrades in grades,
+keyed to the same queue-depth signal the TieredAutoscaler reads:
+
+    pressure = depth / capacity
+
+    rung      pressure      behaviour
+    admit     < shed_at     normal admission
+    shed      >= shed_at    reject (503) the LOWEST priority class only
+    clamp     >= clamp_at   + clamp max_new_tokens for surviving classes
+                            (spec.max_tokens_clamp, or `clamp_tokens`)
+    extend    >= extend_at  + extend the deadline and force-admit up to
+                            2x capacity — trade latency for completion
+
+Every rung transition is journaled (`overload_rung_changed`) and gauged
+(`overload_rung`: 0..3), and every per-request intervention journals
+with the tenant and trace id (`overload_shed` / `overload_clamp` /
+`overload_deadline_extended`) — the drill's evidence that degradation
+was graded, not a cliff.  Shedding only ever targets a strictly-lowest
+priority class: if every configured class shares one priority there is
+nothing "lowest" to shed and the ladder skips straight to clamping, so
+a uniform fleet can never talk itself into rejecting all traffic.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional, Tuple
+
+from ...monitor.journal import journal_event
+from ..request import Request
+from .limits import TenantRegistry, TenantSpec
+
+RUNGS = ("admit", "shed", "clamp", "extend")
+
+
+class OverloadLadder:
+    def __init__(self, registry: TenantRegistry, capacity: int,
+                 counters=None, shed_at: float = 0.75,
+                 clamp_at: float = 0.9, extend_at: float = 1.0,
+                 clamp_tokens: int = 32, extend_s: float = 30.0):
+        self.registry = registry
+        self.capacity = max(1, capacity)
+        self.counters = counters
+        self.shed_at = shed_at
+        self.clamp_at = clamp_at
+        self.extend_at = extend_at
+        self.clamp_tokens = clamp_tokens
+        self.extend_s = extend_s
+        self._lock = threading.Lock()
+        self._rung = "admit"
+        self.sheds = 0
+        self.clamps = 0
+        self.extends = 0
+
+    # -- rung tracking -----------------------------------------------------------
+
+    def _rung_for(self, depth: int) -> str:
+        pressure = depth / self.capacity
+        if pressure >= self.extend_at:
+            return "extend"
+        if pressure >= self.clamp_at:
+            return "clamp"
+        if pressure >= self.shed_at:
+            return "shed"
+        return "admit"
+
+    def _update_rung(self, depth: int) -> str:
+        rung = self._rung_for(depth)
+        with self._lock:
+            prev, self._rung = self._rung, rung
+        if rung != prev:
+            journal_event("overload_rung_changed", from_rung=prev,
+                          to_rung=rung, depth=depth,
+                          pressure=round(depth / self.capacity, 3))
+            if self.counters is not None:
+                self.counters.set_gauge("overload_rung", RUNGS.index(rung))
+        return rung
+
+    def rung(self) -> str:
+        with self._lock:
+            return self._rung
+
+    def _priority_range(self) -> Tuple[int, int]:
+        prios = {s.priority for s in self.registry.tenants().values()}
+        prios.add(self.registry.default().priority)
+        return min(prios), max(prios)
+
+    # -- per-request decision ----------------------------------------------------
+
+    def admit(self, req: Request, spec: Optional[TenantSpec] = None,
+              depth: int = 0) -> str:
+        """Decide the request's fate at the current depth.  Returns
+        "admit" (normal put), "shed" (caller answers 503), or "force"
+        (caller puts with force=True, past nominal capacity).  Clamp and
+        deadline-extension mutate the request in place before admission."""
+        spec = spec or self.registry.classify(req.tenant)
+        rung = self._update_rung(depth)
+        if rung == "admit":
+            return "admit"
+        floor, ceil = self._priority_range()
+        if spec.priority <= floor < ceil:
+            self.sheds += 1
+            journal_event("overload_shed", tenant=req.tenant,
+                          tenant_class=spec.name, req_id=req.req_id,
+                          rung=rung, depth=depth, trace_id=req.trace_id)
+            if self.counters is not None:
+                self.counters.inc_event("overload_shed")
+            return "shed"
+        if rung in ("clamp", "extend"):
+            clamp = spec.max_tokens_clamp or self.clamp_tokens
+            if req.max_new_tokens > clamp:
+                self.clamps += 1
+                journal_event("overload_clamp", tenant=req.tenant,
+                              req_id=req.req_id,
+                              max_new_tokens=req.max_new_tokens,
+                              clamped_to=clamp, trace_id=req.trace_id)
+                if self.counters is not None:
+                    self.counters.inc_event("overload_clamp")
+                req.max_new_tokens = clamp
+        if rung == "extend":
+            if req.deadline_s > 0:
+                self.extends += 1
+                journal_event("overload_deadline_extended",
+                              tenant=req.tenant, req_id=req.req_id,
+                              deadline_s=req.deadline_s,
+                              extended_to=req.deadline_s + self.extend_s,
+                              trace_id=req.trace_id)
+                if self.counters is not None:
+                    self.counters.inc_event("overload_deadline_extended")
+                req.deadline_s += self.extend_s
+            return "force"
+        return "admit"

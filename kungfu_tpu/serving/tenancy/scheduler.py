@@ -1,0 +1,183 @@
+"""Weighted-fair queueing over per-tenant sub-queues.
+
+Start-time fair queueing (SFQ) with the cost measured in TOKENS
+(prefill + remaining decode budget), not request counts — a tenant
+sending 2k-token prompts pays 2k-token shares, so long prompts can't
+starve short ones no matter how the arrivals interleave.
+
+Each request gets a virtual start tag max(v, F_tenant) and a finish tag
+start + cost/weight; `pop` serves the minimum finish tag among the
+sub-queue heads and advances the virtual clock to the served start tag.
+Properties that matter here:
+
+  * work-conserving: an idle tenant's share redistributes instantly
+    (its next arrival starts at the CURRENT virtual time, not at its
+    stale finish tag — no banked credit, no punishment for idling)
+  * starvation-free: finish tags grow monotonically per tenant, so a
+    backlogged heavy tenant cannot hold the minimum forever
+  * single-tenant degenerate case is EXACTLY FIFO — tags are assigned
+    in arrival order from one monotone clock — which is what keeps the
+    untenanted v1 path byte-identical
+
+The surface mirrors AdmissionQueue (put/requeue/pop/drain_expired/
+depth/items/snapshot) so the router dispatch loop and the engine's slot
+admission swap it in without caring which queue they hold.  Tags ride on
+the request as `_wfq_*` attributes: they survive router-side requeues
+(a failover victim keeps its place in the fair order) and simply vanish
+across the process boundary to the worker, whose own queue re-tags on
+arrival.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from ..request import Request
+from .limits import TenantRegistry
+
+
+class WeightedFairQueue:
+    """Drop-in AdmissionQueue replacement ordering by virtual finish time."""
+
+    def __init__(self, capacity: int = 256,
+                 registry: Optional[TenantRegistry] = None):
+        self.capacity = capacity
+        self.registry = registry or TenantRegistry()
+        self._lock = threading.Condition()
+        self._queues: Dict[str, deque] = {}
+        self._finish: Dict[str, float] = {}   # last finish tag per tenant
+        self._vtime = 0.0
+        self._size = 0
+        self._expired: List[Request] = []
+        self.served_tokens: Dict[str, int] = {}  # per-tenant fairness ledger
+
+    @staticmethod
+    def _cost(req: Request) -> float:
+        # tokens this request will occupy a slot for; floor of 1 keeps the
+        # tags strictly increasing even for degenerate empty requests
+        return float(max(1, len(req.prefill_tokens) + req.remaining_new_tokens))
+
+    def _tag(self, req: Request) -> None:
+        spec = self.registry.classify(req.tenant)
+        start = max(self._vtime, self._finish.get(req.tenant, 0.0))
+        finish = start + self._cost(req) / spec.weight
+        self._finish[req.tenant] = finish
+        req._wfq_start = start   # type: ignore[attr-defined]
+        req._wfq_tag = finish    # type: ignore[attr-defined]
+
+    def put(self, req: Request, force: bool = False) -> bool:
+        """Admit into the tenant's sub-queue; False = over capacity.
+        `force` admits up to 2x capacity — the overload ladder's extend
+        rung trades latency for completion and must not be refused by the
+        very queue it is relieving."""
+        with self._lock:
+            limit = self.capacity * 2 if force else self.capacity
+            if self._size >= limit:
+                return False
+            req.queued_t = time.monotonic()
+            if not req.t_admitted:
+                req.t_admitted = req.queued_t
+            self._tag(req)
+            self._queues.setdefault(req.tenant, deque()).append(req)
+            self._size += 1
+            self._lock.notify()
+            return True
+
+    def requeue(self, req: Request, count: bool = True) -> None:
+        """Front of the tenant's sub-queue, KEEPING the existing fair tag
+        (the request already paid for its place in the order; re-tagging
+        would send a failover victim to the back of its tenant's line).
+        Never refuses — a re-queue must not drop.  `t_admitted` is
+        preserved so the queue:wait span and deadline sweep keep the
+        original admission anchor."""
+        with self._lock:
+            if count:
+                req.requeues += 1
+            req.queued_t = time.monotonic()
+            if getattr(req, "_wfq_tag", None) is None:
+                self._tag(req)
+            self._queues.setdefault(req.tenant, deque()).appendleft(req)
+            self._size += 1
+            self._lock.notify()
+
+    def _pop_min(self, now: float) -> Optional[Request]:
+        """Min-finish-tag head across sub-queues, sweeping expired heads."""
+        while True:
+            best_tenant, best_tag = None, None
+            for tenant, q in self._queues.items():
+                while q and q[0].expired(now):
+                    self._expired.append(q.popleft())
+                    self._size -= 1
+                if not q:
+                    continue
+                tag = getattr(q[0], "_wfq_tag", 0.0)
+                if best_tag is None or tag < best_tag:
+                    best_tenant, best_tag = tenant, tag
+            if best_tenant is None:
+                # drop empty sub-queues so a departed tenant costs nothing
+                self._queues = {t: q for t, q in self._queues.items() if q}
+                return None
+            req = self._queues[best_tenant].popleft()
+            self._size -= 1
+            self._vtime = max(self._vtime, getattr(req, "_wfq_start", 0.0))
+            self.served_tokens[best_tenant] = (
+                self.served_tokens.get(best_tenant, 0) + int(self._cost(req)))
+            return req
+
+    def pop(self, timeout_s: float = 0.0) -> Optional[Request]:
+        deadline = time.monotonic() + timeout_s
+        with self._lock:
+            while True:
+                now = time.monotonic()
+                req = self._pop_min(now)
+                if req is not None:
+                    return req
+                remaining = deadline - now
+                if remaining <= 0:
+                    return None
+                self._lock.wait(remaining)
+
+    def head_priority(self) -> Optional[int]:
+        """Priority class of the request `pop` would serve next — the
+        engine's preemption trigger reads this without consuming it."""
+        with self._lock:
+            best_tag, best_req = None, None
+            for q in self._queues.values():
+                if not q:
+                    continue
+                tag = getattr(q[0], "_wfq_tag", 0.0)
+                if best_tag is None or tag < best_tag:
+                    best_tag, best_req = tag, q[0]
+            if best_req is None:
+                return None
+            return self.registry.classify(best_req.tenant).priority
+
+    def drain_expired(self) -> List[Request]:
+        with self._lock:
+            out, self._expired = self._expired, []
+            return out
+
+    def depth(self) -> int:
+        with self._lock:
+            return self._size
+
+    def items(self) -> List[Request]:
+        """Queued requests in fair-service order (approximately): all
+        sub-queues merged by finish tag — the composition signal the
+        autoscaler and overload ladder read."""
+        with self._lock:
+            out: List[Request] = []
+            for q in self._queues.values():
+                out.extend(q)
+            out.sort(key=lambda r: getattr(r, "_wfq_tag", 0.0))
+            return out
+
+    def per_tenant_depth(self) -> Dict[str, int]:
+        with self._lock:
+            return {t: len(q) for t, q in self._queues.items() if q}
+
+    def snapshot(self) -> Tuple[int, int]:
+        with self._lock:
+            return self._size, len(self._expired)

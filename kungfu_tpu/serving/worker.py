@@ -190,10 +190,15 @@ class ServingWorker:
                 "draft and target must share a vocab")
             spec = SpecDecoder(draft_cfg, draft_params, slots=args.slots,
                                k=args.spec_k, counters=self.counters)
+        from .tenancy import TenantRegistry
+
+        # workers inherit KFT_TENANTS_FILE through the environment; when
+        # unset this is None and the engine keeps its v1 FIFO queue
+        tenants = TenantRegistry.from_env()
         self.engine = ServingEngine(
             cfg, params, slots=args.slots,
             queue_capacity=args.queue_capacity, counters=self.counters,
-            prefix_cache=prefix, spec=spec,
+            prefix_cache=prefix, spec=spec, tenants=tenants,
         )
         self.decode_pool = None
         if self.tier == "prefill" and args.config_server:
@@ -429,7 +434,8 @@ class ServingWorker:
                 journal_event("kv_shipped", req_id=req.req_id,
                               tokens=int(meta.get("cursor", 0)),
                               origin_rank=int(meta.get("origin_rank", -1)),
-                              rank=outer.rank, trace_id=req.trace_id,
+                              rank=outer.rank, tenant=req.tenant,
+                              trace_id=req.trace_id,
                               admit_ms=round((time.monotonic() - t0) * 1e3, 3))
                 if outer.counters is not None:
                     outer.counters.inc_event("kv_ships_received")

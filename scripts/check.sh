@@ -133,6 +133,15 @@ echo "== trace drill: stitched cross-process request traces + tail attribution (
 # dominant phase (docs/observability.md "Request tracing")
 JAX_PLATFORMS=cpu python -m kungfu_tpu.chaos --trace-drill --timeout 300
 
+echo "== fairness drill: multi-tenant QoS under an adversarial mix (CPU) =="
+# a tenanted fleet (sensitive/batch/bursty classes) under a burst@ traffic
+# shape plus a decode delay: the bursty tenant's overrun must be journaled
+# as tenant_rate_limited 429s, the sensitive class must preempt a batch
+# slot (slot_preempted -> warm preempted_readmitted, byte-identical greedy
+# replay), the sensitive p99 must stay inside its tenant= SLO rule, and
+# zero admitted requests drop (docs/serving.md "Multi-tenancy & QoS")
+JAX_PLATFORMS=cpu python -m kungfu_tpu.chaos --fairness-drill --timeout 300
+
 echo "== straggler drill: slow rank fingered, not killed (CPU) =="
 # a slow@-injected rank (per-step sleep > heartbeat timeout) must be
 # flagged by the fleet /stragglers detector (journal straggler_suspected

@@ -744,6 +744,9 @@ class TestDisagg:
                 def active_requests(self):
                     return 0
 
+                def healthy_count(self):
+                    return 99  # all-healthy: idle veto stays out of the way
+
             # prefill-bound backlog: queued prompt tokens dominate
             client = ConfigClient(srv.url)
             r = _R({"depth": 8, "prefill_tokens": 4000, "decode_tokens": 10})
@@ -884,6 +887,7 @@ class _StubRouter:
         self._depth = 0
         self.busy = 0
         self.completed = 0
+        self.healthy = 99  # all-healthy fleet unless a test says otherwise
         self.queue = self
 
     def depth(self):
@@ -891,6 +895,9 @@ class _StubRouter:
 
     def active_requests(self):
         return self.busy
+
+    def healthy_count(self):
+        return self.healthy
 
 
 class TestAutoscaler:
@@ -942,6 +949,26 @@ class TestAutoscaler:
         finally:
             srv.stop()
 
+    def test_scale_down_vetoed_mid_heal(self):
+        # a crashed worker's respawn is not yet healthy: the fleet is
+        # healing, not idle — shrinking would scale away the exact peer the
+        # supervisor is rebooting (and race its rank_rejoined record)
+        srv = self._server()
+        try:
+            router = _StubRouter()
+            router.completed = 7
+            router.healthy = 1  # 2-worker document, 1 healthy: mid-heal
+            scaler = self._scaler(srv, router)
+            for _ in range(5):
+                scaler._tick()
+            assert not scaler.events
+            router.healthy = 2  # victim rejoined: idle may now count
+            scaler._tick()
+            scaler._tick()
+            assert [e["kind"] for e in scaler.events] == ["scale_down"]
+        finally:
+            srv.stop()
+
     def test_min_size_floor(self):
         srv = self._server(np=1)
         try:
@@ -977,6 +1004,115 @@ class TestAutoscaler:
             assert [e["kind"] for e in scaler.events] == ["scale_up"]
         finally:
             srv.stop()
+
+
+class TestWeightedFairQueueProperty:
+    """Seeded-thread property test for the WFQ that replaces FIFO when
+    tenancy is configured (kungfu_tpu/serving/tenancy/scheduler.py): under
+    concurrent producers, consumers, and requeues, no request is lost or
+    double-served, and a fully backlogged queue serves token shares in
+    weight order."""
+
+    def _fixture(self, weights):
+        import random
+
+        from kungfu_tpu.serving.tenancy import (
+            TenantRegistry, TenantSpec, WeightedFairQueue)
+
+        specs = {t: TenantSpec(name=t, weight=w) for t, w in weights.items()}
+        reg = TenantRegistry(specs=specs)
+        q = WeightedFairQueue(capacity=4096, registry=reg)
+        rng = random.Random(1234)
+        # every tenant offers the SAME sequence of shapes, so offered token
+        # volume is identical per tenant and shares are comparable
+        shapes = [(rng.randint(1, 12), rng.randint(1, 16))
+                  for _ in range(60)]
+        reqs = []
+        for i, (plen, new) in enumerate(shapes):
+            for tenant in weights:
+                reqs.append(Request(
+                    req_id=f"{tenant}-{i}", prompt=tuple(range(1, plen + 1)),
+                    max_new_tokens=new, tenant=tenant))
+        rng.shuffle(reqs)
+        return q, reqs
+
+    @staticmethod
+    def _cost(req):
+        return max(1, len(req.prefill_tokens) + req.remaining_new_tokens)
+
+    def test_backlogged_shares_follow_weights(self):
+        q, reqs = self._fixture({"a": 1.0, "b": 2.0, "c": 4.0})
+        for r in reqs:
+            assert q.put(r)
+        # with every tenant backlogged, an early service window splits
+        # token shares ~1:2:4; count the first third of the total volume
+        budget = sum(self._cost(r) for r in reqs) // 3
+        shares = {"a": 0, "b": 0, "c": 0}
+        while budget > 0:
+            r = q.pop(timeout_s=0)
+            shares[r.tenant] += self._cost(r)
+            budget -= self._cost(r)
+        assert shares["c"] > shares["b"] > shares["a"]
+        assert shares["c"] >= 2.5 * shares["a"]
+        # no starvation: the weight-1 tenant was served inside the window
+        assert shares["a"] > 0
+
+    def test_seeded_threads_no_loss_no_double_serve(self):
+        import random
+
+        q, reqs = self._fixture({"a": 1.0, "b": 2.0, "c": 4.0})
+        served = []
+        lock = threading.Lock()
+        requeued_once = set()
+        stop = threading.Event()
+
+        def producer(seed, chunk):
+            rng = random.Random(seed)
+            for req in chunk:
+                assert q.put(req)
+                if rng.random() < 0.2:
+                    time.sleep(0.0005)
+
+        def consumer(seed):
+            rng = random.Random(seed)
+            while not stop.is_set():
+                req = q.pop(timeout_s=0.02)
+                if req is None:
+                    continue
+                with lock:
+                    first_bounce = req.req_id not in requeued_once
+                    if first_bounce:
+                        requeued_once.add(req.req_id)
+                if first_bounce and rng.random() < 0.15:
+                    q.requeue(req)  # failover path: keeps the fair tag
+                    continue
+                with lock:
+                    served.append(req)
+
+        producers = [threading.Thread(target=producer,
+                                      args=(100 + i, reqs[i::4]))
+                     for i in range(4)]
+        consumers = [threading.Thread(target=consumer, args=(200 + i,))
+                     for i in range(3)]
+        for t in producers + consumers:
+            t.start()
+        for t in producers:
+            t.join(timeout=30)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            with lock:
+                if len(served) == len(reqs):
+                    break
+            time.sleep(0.01)
+        stop.set()
+        for t in consumers:
+            t.join(timeout=10)
+        ids = [r.req_id for r in served]
+        assert len(ids) == len(reqs), f"lost {len(reqs) - len(ids)} requests"
+        assert len(set(ids)) == len(ids), "a request was double-served"
+        assert q.depth() == 0
+        requeued = [r for r in served if r.requeues > 0]
+        assert requeued, "the seeded mix never exercised the requeue path"
 
 
 # -- multi-process drill ---------------------------------------------------------------

@@ -1,0 +1,442 @@
+"""Multi-tenant serving QoS (kungfu_tpu/serving/tenancy/).
+
+Fast tier, no subprocesses: the tenant registry (JSON schema, unknown ->
+default, mtime hot reload, bad-push resilience), the token bucket and the
+front-door rate limiter (journaled 429s, config re-arm), weighted-fair
+queue semantics (token-cost shares, FIFO degenerate case, requeue keeps
+the fair tag, expiry sweep, head_priority), the graded overload ladder
+(rung transitions, lowest-class-only shed, clamp/extend mutations,
+force-admit past capacity), the per-tenant SLO selector splice, the
+`burst@` chaos-grammar shape, t_admitted requeue preservation, and the
+router front door's classify-before-backpressure ordering.  The
+end-to-end adversarial mix runs as `python -m kungfu_tpu.chaos
+--fairness-drill` (docs/serving.md "Multi-tenancy & QoS").
+"""
+import json
+import os
+import time
+
+import pytest
+
+from kungfu_tpu.monitor import journal as J
+from kungfu_tpu.serving.queue import AdmissionQueue
+from kungfu_tpu.serving.request import Request
+from kungfu_tpu.serving.tenancy import (
+    OverloadLadder,
+    RateLimiter,
+    TenantRegistry,
+    TenantSpec,
+    TokenBucket,
+    WeightedFairQueue,
+)
+
+pytestmark = pytest.mark.tenancy
+
+
+def _req(i=0, tenant="", new=8, prompt=(1, 2, 3), **kw):
+    return Request(req_id=f"r{i}", prompt=tuple(prompt),
+                   max_new_tokens=new, tenant=tenant, **kw)
+
+
+def _registry(tmp_path, doc=None):
+    path = tmp_path / "tenants.json"
+    path.write_text(json.dumps(doc or {
+        "default": {"weight": 1.0, "priority": 1},
+        "tenants": {
+            "gold": {"weight": 4.0, "priority": 2},
+            "batch": {"weight": 1.0, "priority": 0},
+            "bursty": {"weight": 1.0, "priority": 0,
+                       "rate": 2.0, "burst": 2.0},
+        },
+    }))
+    return TenantRegistry(path=str(path), reload_s=0.0), path
+
+
+@pytest.fixture
+def journal_file(tmp_path, monkeypatch):
+    path = tmp_path / "journal.jsonl"
+    monkeypatch.setenv(J.JOURNAL_FILE_ENV, str(path))
+    monkeypatch.delenv(J.JOURNAL_DIR_ENV, raising=False)
+    J._reset_for_tests()
+    yield str(path)
+    J._reset_for_tests()
+
+
+class TestTenantRegistry:
+    def test_spec_validation(self):
+        with pytest.raises(ValueError):
+            TenantSpec(name="x", weight=0.0)
+        with pytest.raises(ValueError):
+            TenantSpec(name="x", rate=-1.0)
+        spec = TenantSpec.from_json("gold", {"weight": 4, "priority": 2})
+        assert spec == TenantSpec.from_json("gold", spec.to_json())
+
+    def test_classify_unknown_and_anonymous_to_default(self, tmp_path):
+        reg, _ = _registry(tmp_path)
+        assert reg.classify("gold").weight == 4.0
+        assert reg.classify("nobody").name == "default"
+        assert reg.classify("").name == "default"
+        assert reg.classify("nobody").priority == 1
+
+    def test_hot_reload_on_mtime(self, tmp_path):
+        reg, path = _registry(tmp_path)
+        assert reg.classify("gold").weight == 4.0
+        doc = json.loads(path.read_text())
+        doc["tenants"]["gold"]["weight"] = 9.0
+        path.write_text(json.dumps(doc))
+        os.utime(path, (time.time() + 5, time.time() + 5))
+        assert reg.classify("gold").weight == 9.0
+        assert reg.reloads >= 2
+
+    def test_bad_push_keeps_last_good_table(self, tmp_path):
+        reg, path = _registry(tmp_path)
+        path.write_text("{not json")
+        os.utime(path, (time.time() + 5, time.time() + 5))
+        assert reg.classify("gold").weight == 4.0  # old table survives
+
+    def test_from_env_unconfigured_is_none(self, monkeypatch):
+        monkeypatch.delenv("KFT_TENANTS_FILE", raising=False)
+        assert TenantRegistry.from_env() is None
+
+
+class TestRateLimiter:
+    def test_token_bucket_deterministic(self):
+        b = TokenBucket(rate=2.0, burst=2.0)
+        t0 = b._t
+        assert [b.allow(now=t0) for _ in range(3)] == [True, True, False]
+        assert b.allow(now=t0 + 0.5)          # one token refilled
+        assert not b.allow(now=t0 + 0.5)
+        assert b.allow(now=t0 - 100.0) is False  # clock regression: no refill
+
+    def test_unlimited_tenant_never_limited(self, tmp_path):
+        reg, _ = _registry(tmp_path)
+        lim = RateLimiter(reg)
+        for i in range(50):
+            assert lim.admit(_req(i, "gold"))
+        assert lim.rejections == 0
+
+    def test_rejection_journaled_with_tenant(self, tmp_path, journal_file):
+        reg, _ = _registry(tmp_path)
+        lim = RateLimiter(reg)
+        verdicts = [lim.admit(_req(i, "bursty")) for i in range(10)]
+        assert verdicts[:2] == [True, True]  # the burst of 2
+        assert not all(verdicts)
+        assert lim.rejections >= 1
+        events = J.filter_events(J.read_journal(journal_file),
+                                 "tenant_rate_limited", tenant="bursty")
+        assert len(events) == lim.rejections
+        assert events[0]["rate"] == 2.0
+        assert events[0]["req_id"]
+
+    def test_bucket_rearmed_on_config_change(self, tmp_path):
+        reg, path = _registry(tmp_path)
+        lim = RateLimiter(reg)
+        while lim.admit(_req(0, "bursty")):
+            pass  # drain the bucket dry
+        doc = json.loads(path.read_text())
+        doc["tenants"]["bursty"].update(rate=1000.0, burst=1000.0)
+        path.write_text(json.dumps(doc))
+        os.utime(path, (time.time() + 5, time.time() + 5))
+        assert lim.admit(_req(1, "bursty"))  # fresh bucket, new burst
+
+
+class TestWeightedFairQueue:
+    def test_single_tenant_is_fifo(self, tmp_path):
+        reg, _ = _registry(tmp_path)
+        q = WeightedFairQueue(capacity=16, registry=reg)
+        for i in range(6):
+            assert q.put(_req(i))
+        assert [q.pop(timeout_s=0).req_id for _ in range(6)] == [
+            f"r{i}" for i in range(6)]
+
+    def test_token_cost_shares_follow_weights(self, tmp_path):
+        reg, _ = _registry(tmp_path)
+        q = WeightedFairQueue(capacity=128, registry=reg)
+        n = 0
+        for _ in range(20):
+            q.put(_req(n, "gold", new=8)); n += 1
+            q.put(_req(n, "batch", new=8)); n += 1
+        first = [q.pop(timeout_s=0).tenant for _ in range(10)]
+        # weight 4 vs 1: the early service order is dominated by gold
+        assert first.count("gold") >= 7
+        # the ledger counts tokens, not requests
+        assert q.served_tokens["gold"] > 0
+
+    def test_long_prompts_pay_token_cost(self, tmp_path):
+        reg, _ = _registry(tmp_path)
+        q = WeightedFairQueue(capacity=64, registry=reg)
+        # equal weights: batch sends 60-token work, default sends 6-token
+        # work; per round-robin-by-tokens, default gets ~10 pops per batch pop
+        for i in range(8):
+            q.put(_req(i, "batch", new=57, prompt=(1, 2, 3)))
+        for i in range(8, 28):
+            q.put(_req(i, "", new=3, prompt=(1, 2, 3)))
+        first12 = [q.pop(timeout_s=0).tenant for _ in range(12)]
+        assert first12.count("batch") <= 2
+
+    def test_requeue_keeps_tag_and_front_position(self, tmp_path):
+        reg, _ = _registry(tmp_path)
+        q = WeightedFairQueue(capacity=16, registry=reg)
+        a, b = _req(0, "batch"), _req(1, "batch")
+        q.put(a), q.put(b)
+        got = q.pop(timeout_s=0)
+        assert got is a
+        tag = got._wfq_tag
+        q.requeue(got)
+        assert got._wfq_tag == tag     # paid-for place kept
+        assert got.requeues == 1
+        assert q.pop(timeout_s=0) is a  # ahead of b again
+
+    def test_idle_tenant_banks_no_credit(self, tmp_path):
+        reg, _ = _registry(tmp_path)
+        q = WeightedFairQueue(capacity=64, registry=reg)
+        for i in range(4):
+            q.put(_req(i, "batch"))
+        for _ in range(4):
+            q.pop(timeout_s=0)
+        # gold idled through all of that; its first arrival starts at the
+        # CURRENT virtual time, not at zero
+        late = _req(99, "gold")
+        q.put(late)
+        assert late._wfq_start >= 0.0
+        assert late._wfq_start == pytest.approx(q._vtime)
+
+    def test_expired_heads_swept_to_drain(self, tmp_path):
+        reg, _ = _registry(tmp_path)
+        q = WeightedFairQueue(capacity=16, registry=reg)
+        dead = _req(0, "batch", deadline_s=0.001)
+        dead.submitted_t = time.monotonic() - 10
+        live = _req(1, "batch")
+        q.put(dead), q.put(live)
+        assert q.pop(timeout_s=0) is live
+        drained = q.drain_expired()
+        assert [r.req_id for r in drained] == ["r0"]
+        assert q.depth() == 0
+
+    def test_capacity_and_force(self, tmp_path):
+        reg, _ = _registry(tmp_path)
+        q = WeightedFairQueue(capacity=2, registry=reg)
+        assert q.put(_req(0)) and q.put(_req(1))
+        assert not q.put(_req(2))
+        assert q.put(_req(3), force=True)  # extend rung: up to 2x
+        assert q.depth() == 3
+
+    def test_head_priority(self, tmp_path):
+        reg, _ = _registry(tmp_path)
+        q = WeightedFairQueue(capacity=16, registry=reg)
+        assert q.head_priority() is None
+        q.put(_req(0, "batch"))
+        assert q.head_priority() == 0
+        q.put(_req(1, "gold"))
+        # gold's tag lands ahead of batch's (weight 4) only if it is the
+        # min; either way head_priority matches the would-be pop
+        head = q.head_priority()
+        nxt = q.pop(timeout_s=0)
+        assert head == reg.classify(nxt.tenant).priority
+
+    def test_per_tenant_depth(self, tmp_path):
+        reg, _ = _registry(tmp_path)
+        q = WeightedFairQueue(capacity=16, registry=reg)
+        q.put(_req(0, "gold")), q.put(_req(1, "gold")), q.put(_req(2))
+        assert q.per_tenant_depth() == {"gold": 2, "": 1}
+
+
+class TestOverloadLadder:
+    def test_rung_transitions_journaled(self, tmp_path, journal_file):
+        reg, _ = _registry(tmp_path)
+        lad = OverloadLadder(reg, capacity=10)
+        assert lad.admit(_req(0, "gold"), depth=0) == "admit"
+        lad.admit(_req(1, "gold"), depth=8)
+        lad.admit(_req(2, "gold"), depth=12)
+        lad.admit(_req(3, "gold"), depth=0)
+        rungs = [(e["from_rung"], e["to_rung"]) for e in J.filter_events(
+            J.read_journal(journal_file), "overload_rung_changed")]
+        assert rungs == [("admit", "shed"), ("shed", "extend"),
+                         ("extend", "admit")]
+
+    def test_shed_hits_only_lowest_class(self, tmp_path, journal_file):
+        reg, _ = _registry(tmp_path)
+        lad = OverloadLadder(reg, capacity=10)
+        assert lad.admit(_req(0, "batch"), depth=8) == "shed"
+        assert lad.admit(_req(1, ""), depth=8) == "admit"      # priority 1
+        assert lad.admit(_req(2, "gold"), depth=8) == "admit"  # priority 2
+        sheds = J.filter_events(J.read_journal(journal_file), "overload_shed")
+        assert [e["tenant"] for e in sheds] == ["batch"]
+
+    def test_uniform_priorities_never_shed(self, tmp_path):
+        reg, _ = _registry(tmp_path, doc={
+            "default": {"priority": 1},
+            "tenants": {"a": {"priority": 1}, "b": {"priority": 1}},
+        })
+        lad = OverloadLadder(reg, capacity=10)
+        assert lad.admit(_req(0, "a"), depth=9) == "admit"
+
+    def test_clamp_mutates_max_new_tokens(self, tmp_path, journal_file):
+        reg, _ = _registry(tmp_path)
+        lad = OverloadLadder(reg, capacity=10, clamp_tokens=16)
+        big = _req(0, "gold", new=100)
+        assert lad.admit(big, depth=9) == "admit"
+        assert big.max_new_tokens == 16
+        small = _req(1, "gold", new=4)
+        lad.admit(small, depth=9)
+        assert small.max_new_tokens == 4  # already inside the clamp
+        clamps = J.filter_events(J.read_journal(journal_file),
+                                 "overload_clamp")
+        assert len(clamps) == 1 and clamps[0]["clamped_to"] == 16
+
+    def test_spec_clamp_override(self, tmp_path):
+        reg, _ = _registry(tmp_path, doc={
+            "tenants": {"vip": {"priority": 2, "max_tokens_clamp": 48}},
+        })
+        lad = OverloadLadder(reg, capacity=10, clamp_tokens=16)
+        r = _req(0, "vip", new=100)
+        lad.admit(r, depth=9)
+        assert r.max_new_tokens == 48
+
+    def test_extend_rung_forces_and_extends_deadline(self, tmp_path,
+                                                     journal_file):
+        reg, _ = _registry(tmp_path)
+        lad = OverloadLadder(reg, capacity=10, extend_s=30.0)
+        r = _req(0, "gold", new=4, deadline_s=10.0)
+        assert lad.admit(r, depth=12) == "force"
+        assert r.deadline_s == 40.0
+        nodeadline = _req(1, "gold", new=4)
+        assert lad.admit(nodeadline, depth=12) == "force"
+        assert nodeadline.deadline_s == 0.0  # no deadline = nothing to extend
+        ev = J.filter_events(J.read_journal(journal_file),
+                             "overload_deadline_extended")
+        assert len(ev) == 1 and ev[0]["extended_to"] == 40.0
+
+
+class TestRequestTenantFields:
+    def test_tenant_and_age_round_trip(self):
+        r = _req(0, "gold", deadline_s=5.0)
+        r.submitted_t = time.monotonic() - 2.0
+        d = r.to_json()
+        assert d["tenant"] == "gold"
+        assert d["age_s"] == pytest.approx(2.0, abs=0.25)
+        back = Request.from_json(d)
+        assert back.tenant == "gold"
+        assert back.carried_age_s == pytest.approx(2.0, abs=0.25)
+
+    def test_expiry_honours_carried_age(self):
+        r = _req(0, deadline_s=3.0)
+        r.carried_age_s = 2.5
+        r.submitted_t = time.monotonic() - 1.0  # 1s local + 2.5s carried
+        assert r.expired()
+        r.carried_age_s = 0.0
+        assert not r.expired()
+
+    def test_t_admitted_survives_requeue(self):
+        q = AdmissionQueue(capacity=4)
+        r = _req(0)
+        assert q.put(r)
+        t0 = r.t_admitted
+        assert t0 > 0
+        got = q.pop(timeout_s=0)
+        time.sleep(0.01)
+        q.requeue(got)
+        assert got.t_admitted == t0          # the original admission anchor
+        assert got.queued_t > t0             # but queued_t is the NEW wait
+
+
+class TestSLOTenantSelector:
+    def test_series_expr_splices_label(self):
+        from kungfu_tpu.monitor.slo import SLORule
+
+        r = SLORule(name="x", metric="hist:request_latency_ms:p99",
+                    op="<=", threshold=100.0, tenant="gold")
+        assert r.series_expr == "hist:request_latency_ms[gold]:p99"
+        plain = SLORule(name="y", metric="hist:request_latency_ms:p99",
+                        op="<=", threshold=100.0)
+        assert plain.series_expr == plain.metric
+        ratio = SLORule(name="z", op="<=", threshold=0.5, tenant="gold",
+                        metric="hist:queue_wait_ms:p50/hist:request_latency_ms:p50")
+        assert ratio.series_expr == ("hist:queue_wait_ms[gold]:p50"
+                                     "/hist:request_latency_ms[gold]:p50")
+        gauge = SLORule(name="g", metric="queue_depth", op="<=",
+                        threshold=10.0, tenant="gold")
+        assert gauge.series_expr == "queue_depth"  # labels are hist-only
+
+    def test_tenant_round_trips_json(self):
+        from kungfu_tpu.monitor.slo import SLORule
+
+        r = SLORule(name="x", metric="hist:m:p99", op="<=", threshold=1.0,
+                    tenant="gold")
+        assert SLORule.from_json(r.to_json()).tenant == "gold"
+
+
+class TestBurstGrammar:
+    def test_parse(self):
+        from kungfu_tpu.chaos.plan import parse_fault_plan
+
+        f = parse_fault_plan(
+            "burst@tenant=bursty:rps=20:secs=4:start_after=2"
+        ).burst_faults()[0]
+        assert (f.tenant, f.rps, f.secs, f.start_after_s) == \
+            ("bursty", 20.0, 4.0, 2.0)
+
+    def test_defaults_and_validation(self):
+        from kungfu_tpu.chaos.plan import parse_fault_plan
+
+        f = parse_fault_plan("burst@tenant=t:rps=1").burst_faults()[0]
+        assert f.secs == 3.0 and f.start_after_s == 0.0
+        with pytest.raises(ValueError):
+            parse_fault_plan("burst@tenant=t")           # rps missing
+        with pytest.raises(ValueError):
+            parse_fault_plan("burst@rps=5")              # tenant missing
+        with pytest.raises(ValueError):
+            parse_fault_plan("burst@tenant=t:rps=0")     # rate must be > 0
+
+    def test_burst_never_arms_worker_injectors(self):
+        from kungfu_tpu.chaos.plan import parse_fault_plan
+
+        plan = parse_fault_plan(
+            "burst@tenant=t:rps=5;crash_serve@tokens=9:rank=1")
+        assert not [f for f in plan.worker_faults() if f.kind == "burst"]
+        assert not [f for f in plan.serve_faults() if f.kind == "burst"]
+        assert len(plan.burst_faults()) == 1
+        assert len(plan.serve_faults()) == 1  # composes with real faults
+
+
+class TestRouterFrontDoor:
+    def test_classification_before_backpressure(self, tmp_path):
+        """The satellite bugfix: a rate-limited tenant gets its 429 even
+        when the queue is full — v1 answered 503 before classifying."""
+        from kungfu_tpu.serving.router import Router
+
+        reg, _ = _registry(tmp_path)
+        router = Router(queue_capacity=2, tenants=reg)
+        assert router.admit(_req(0, "gold"))[0] == 200
+        assert router.admit(_req(1, "gold"))[0] == 200  # queue now full
+        while router.limiter.admit(_req(90, "bursty")):
+            pass  # drain bursty's bucket
+        code, err = router.admit(_req(2, "bursty"))
+        assert (code, err) == (429, "rate limited")
+
+    def test_shed_and_force_paths(self, tmp_path):
+        from kungfu_tpu.serving.router import Router
+
+        reg, _ = _registry(tmp_path)
+        router = Router(queue_capacity=4, tenants=reg)
+        for i in range(4):
+            assert router.admit(_req(i, "gold"))[0] == 200
+        # depth 4/4 = extend rung: batch (lowest class) sheds, gold forces
+        code, err = router.admit(_req(5, "batch"))
+        assert code == 503 and "shed" in err
+        assert router.admit(_req(6, "gold"))[0] == 200  # force past capacity
+        assert router.queue.depth() == 5
+        st = router.stats()
+        assert st["tenancy"]["shed"] == 1
+        assert st["tenancy"]["overload_rung"] == "extend"
+
+    def test_untenanted_router_unchanged(self):
+        from kungfu_tpu.serving.router import Router
+
+        router = Router(queue_capacity=2)
+        assert isinstance(router.queue, AdmissionQueue)
+        assert router.limiter is None and router.ladder is None
+        assert router.admit(_req(0))[0] == 200
+        assert router.admit(_req(1))[0] == 200
+        assert router.admit(_req(2)) == (503, "queue full")
+        assert "tenancy" not in router.stats()
