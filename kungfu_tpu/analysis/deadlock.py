@@ -1,0 +1,150 @@
+"""Deadlock-freedom for chunk-level schedules: the wait-for graph.
+
+A transfer's DMA can only land when three things hold on real hardware:
+
+  1. its payload exists — the sends that produced the source value have
+     landed (payload dependency);
+  2. its destination slot is free — the slot's previous occupant has been
+     CONSUMED (the dst's send that reads that data has issued), because a
+     receiver only recycles a recv buffer after draining it (the DMA
+     semaphore handshake in ops/ring_kernels.py);
+  3. the link has a send credit — with a bounded in-flight budget C per
+     (src, dst) link, the k-th DMA on a link waits for the (k-C)-th's
+     consumption (the 2-slot staging pipeline PR 9 designed around is
+     credits=2).
+
+Edges 2 and 3 can point FORWARD in schedule order (the previous occupant's
+consumer may be scheduled in the same or a later round) — a cycle through
+such edges is a real runtime deadlock: every DMA in the cycle waits on a
+slot or credit only another member of the cycle can release.  The classic
+instance is the single-shared-recv-slot ring: hop s+1 into rank r waits on
+r's hop-s+1 send, which waits on r+1's slot, ... all the way around — an
+n-cycle this module reports and the per-hop / double-buffered slot layouts
+break.
+
+`verify_deadlock_free` assumes a schedule that already passed the dataflow
+and slot-race checks (verify_schedule orders them that way).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Set, Tuple
+
+from .findings import ERROR, Finding, RULE_SCHED_DEADLOCK
+from .schedule import COPY, Schedule, Transfer
+
+Tid = Tuple[int, int]  # (round index, position within round)
+
+
+def _wait_for_graph(sched: Schedule) -> Tuple[
+        Dict[Tid, Set[Tid]], Dict[Tid, Transfer]]:
+    """Build the wait-for graph: edge t -> u means t's DMA cannot land
+    until u has landed AND (for slot/credit edges) u's data is drained."""
+    transfers: Dict[Tid, Transfer] = {}
+    producers: Dict[Tuple[int, str], frozenset] = {}
+    consumed_by: Dict[Tid, Set[Tid]] = {}
+    deps: Dict[Tid, Set[Tid]] = {}
+    slot_writes: Dict[Tuple[int, str], List[List[Tid]]] = {}
+    link_writes: Dict[Tuple[int, int], List[List[Tid]]] = {}
+
+    for k, rnd in enumerate(sched.rounds):
+        reads: List[Tuple[Tid, Transfer, frozenset]] = []
+        for i, t in enumerate(rnd):
+            tid = (k, i)
+            transfers[tid] = t
+            deps[tid] = set()
+            consumed_by[tid] = set()
+            reads.append((tid, t,
+                          producers.get((t.src, t.chunk), frozenset())))
+        # payload deps against the pre-round state
+        round_slot: Dict[Tuple[int, str], List[Tid]] = {}
+        round_link: Dict[Tuple[int, int], List[Tid]] = {}
+        for tid, t, prod in reads:
+            deps[tid] |= set(prod)
+            for u in prod:
+                consumed_by[u].add(tid)
+            round_slot.setdefault((t.dst, t.slot), []).append(tid)
+            round_link.setdefault((t.src, t.dst), []).append(tid)
+        for key, tids in round_slot.items():
+            slot_writes.setdefault(key, []).append(tids)
+        for key, tids in round_link.items():
+            link_writes.setdefault(key, []).append(tids)
+        # apply writes
+        for tid, t, _prod in reads:
+            key = (t.dst, t.chunk)
+            if t.op == COPY:
+                producers[key] = frozenset((tid,))
+            else:
+                producers[key] = producers.get(key, frozenset()) | {tid}
+
+    # slot-reuse edges: a write waits for the previous occupant's
+    # consumers (or just its landing, when the value is terminal output)
+    for _key, groups in slot_writes.items():
+        for prev, cur in zip(groups, groups[1:]):
+            blockers = set(prev)
+            for u in prev:
+                blockers |= consumed_by[u]
+            for tid in cur:
+                deps[tid] |= blockers - {tid}
+    # bounded-credit edges per link
+    if sched.credits:
+        c = int(sched.credits)
+        for _key, groups in link_writes.items():
+            for i in range(c, len(groups)):
+                blockers: Set[Tid] = set(groups[i - c])
+                for u in groups[i - c]:
+                    blockers |= consumed_by[u]
+                for tid in groups[i]:
+                    deps[tid] |= blockers - {tid}
+    return deps, transfers
+
+
+def _find_cycle(deps: Dict[Tid, Set[Tid]]) -> List[Tid]:
+    """Iterative DFS; returns one cycle as a node list, or []."""
+    WHITE, GREY, BLACK = 0, 1, 2
+    color = {v: WHITE for v in deps}
+    parent: Dict[Tid, Tid] = {}
+    for root in deps:
+        if color[root] != WHITE:
+            continue
+        stack: List[Tuple[Tid, iter]] = [(root, iter(sorted(deps[root])))]
+        color[root] = GREY
+        while stack:
+            node, it = stack[-1]
+            advanced = False
+            for nxt in it:
+                if color[nxt] == WHITE:
+                    color[nxt] = GREY
+                    parent[nxt] = node
+                    stack.append((nxt, iter(sorted(deps[nxt]))))
+                    advanced = True
+                    break
+                if color[nxt] == GREY:
+                    # back edge: unwind node -> ... -> nxt
+                    cycle = [node]
+                    cur = node
+                    while cur != nxt:
+                        cur = parent[cur]
+                        cycle.append(cur)
+                    cycle.reverse()
+                    return cycle
+            if not advanced:
+                color[node] = BLACK
+                stack.pop()
+        # loop continues to next root
+    return []
+
+
+def verify_deadlock_free(sched: Schedule) -> List[Finding]:
+    deps, transfers = _wait_for_graph(sched)
+    cycle = _find_cycle(deps)
+    if not cycle:
+        return []
+    hops = " -> ".join(
+        f"[round{k} {transfers[(k, i)].where()}]" for k, i in cycle)
+    credit = (f" under credits={sched.credits}" if sched.credits else "")
+    return [Finding(
+        rule=RULE_SCHED_DEADLOCK, severity=ERROR,
+        message=(f"wait-for cycle of {len(cycle)} DMAs{credit}: {hops} "
+                 "-> (back to start); every DMA in the cycle waits on a "
+                 "slot or credit only another member releases"),
+        path=(sched.name,), source=f"schedule:{sched.name}")]

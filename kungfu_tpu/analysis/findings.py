@@ -26,6 +26,27 @@ RULE_REPLICATION = "unreduced-gradient"
 ALL_RULES = (RULE_AXIS, RULE_DEADLOCK, RULE_PERMUTATION, RULE_WIRE_DTYPE,
              RULE_REPLICATION)
 
+#: chunk-level schedule oracle rules (analysis/schedule.py, analysis/deadlock.py)
+RULE_SCHED_DATAFLOW = "schedule-dataflow"
+RULE_SCHED_DEADLOCK = "schedule-deadlock"
+RULE_SCHED_SLOT = "schedule-slot-race"
+
+SCHEDULE_RULES = (RULE_SCHED_DATAFLOW, RULE_SCHED_DEADLOCK, RULE_SCHED_SLOT)
+
+#: control-plane AST lint rules (analysis/hostlint.py, analysis/envaudit.py)
+RULE_BARE_PUT = "bare-put"
+RULE_JOURNAL_KIND = "journal-kind"
+RULE_LOCK_ORDER = "lock-order"
+RULE_THREAD_LIFECYCLE = "thread-lifecycle"
+RULE_WALL_CLOCK = "wall-clock-duration"
+RULE_ENV_DRIFT = "env-drift"
+
+HOST_RULES = (RULE_BARE_PUT, RULE_JOURNAL_KIND, RULE_LOCK_ORDER,
+              RULE_THREAD_LIFECYCLE, RULE_WALL_CLOCK, RULE_ENV_DRIFT)
+
+#: every rule any kf-verify front can emit (CLI --suppress validates here)
+EVERY_RULE = ALL_RULES + SCHEDULE_RULES + HOST_RULES
+
 
 @dataclasses.dataclass(frozen=True)
 class Finding:

@@ -1,0 +1,510 @@
+"""Control-plane AST lint — protocol invariants the test suite rarely sits on.
+
+The MLPerf TPU-pod lesson (PAPERS.md) is that control-plane failure modes
+only appear under contention: a healer and an autoscaler racing on the
+cluster document, a duration measured across an NTP step, a non-daemon
+thread pinning a dead process.  These are invariants, not behaviours —
+so kf-verify checks them statically over every module in `kungfu_tpu/`:
+
+  bare-put             every `put_cluster` outside the config server must
+                       pass `version=` (conditional PUT).  An unconditional
+                       write can silently undo a concurrent healer's CAS.
+  journal-kind         every journal emit call site must use a kind
+                       registered in monitor.journal.EVENT_KINDS and (for
+                       direct `journal_event` calls) pass its required
+                       fields.  Wrapper *definitions* forwarding a kind
+                       parameter are skipped — their call sites are checked.
+  lock-order           locks must be acquired in one consistent global
+                       order: nested `with ...lock:` pairs form a digraph
+                       whose cycles are potential ABBA deadlocks.
+  thread-lifecycle     every `threading.Thread(...)` must be daemonized or
+                       have a `.join()` somewhere in its module (teardown
+                       path) — otherwise a crash leaves a zombie process.
+  wall-clock-duration  `time.time()` must not feed subtraction: durations
+                       belong on the monotonic clock (the PR-4 NTP bug —
+                       a stepped clock once produced negative heal MTTRs —
+                       as a permanent rule).
+
+Findings report through the shared Finding machinery; intentional
+exceptions live in ALLOWLIST below, keyed `rule:relpath:function`, each
+with a one-line justification (the documented suppression story the
+acceptance criteria require).
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .findings import (
+    ERROR,
+    Finding,
+    RULE_BARE_PUT,
+    RULE_JOURNAL_KIND,
+    RULE_LOCK_ORDER,
+    RULE_THREAD_LIFECYCLE,
+    RULE_WALL_CLOCK,
+)
+
+#: suppression key -> why the occurrence is intentional.  Keys are
+#: `rule:relpath:function` (function "" = module level).
+ALLOWLIST: Dict[str, str] = {
+    "wall-clock-duration:run/launcher.py:_stalest_worker":
+        "compares against heartbeat-file mtimes, which are wall-clock by "
+        "nature; the slow-but-alive re-judgment below absorbs NTP steps",
+    "journal-kind:monitor/journal.py:journal_event":
+        "the emitter itself forwards an arbitrary kind; every caller is "
+        "linted instead",
+}
+
+#: wrapper callables whose first positional argument is a journal kind
+JOURNAL_CALLEES = {"journal_event", "journal", "_journal", "_transition"}
+
+#: files the scan skips entirely
+SKIP_PARTS = ("torch",)
+SKIP_FILES = ("testing/bad_host.py",)
+
+
+def _fn(rule: str, rel: str, node: ast.AST, func: str, msg: str) -> Finding:
+    return Finding(rule=rule, severity=ERROR, message=msg,
+                   path=(rel, func or "<module>"),
+                   source=f"{rel}:{getattr(node, 'lineno', 0)}")
+
+
+def _suppressed(rule: str, rel: str, func: str,
+                allow: Dict[str, str]) -> bool:
+    return f"{rule}:{rel}:{func}" in allow
+
+
+class _FuncScope:
+    """Per-function facts collected in one pass: local constant-string
+    bindings (for journal-kind resolution), names assigned from
+    time.time() (wall-clock taint), and dict-literal bindings."""
+
+    def __init__(self, node) -> None:
+        self.node = node
+        self.params = {a.arg for a in node.args.args
+                       + node.args.kwonlyargs
+                       + node.args.posonlyargs} if node else set()
+        self.str_consts: Dict[str, List[str]] = {}
+        self.dict_keys: Dict[str, List[str]] = {}
+        self.wall_names: Set[str] = set()
+
+
+def _is_time_time(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "time"
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "time")
+
+
+def _str_values(node: ast.AST) -> Optional[List[str]]:
+    """Constant-fold a string expression: literal or IfExp of literals."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, ast.IfExp):
+        a = _str_values(node.body)
+        b = _str_values(node.orelse)
+        if a is not None and b is not None:
+            return a + b
+    return None
+
+
+def _collect_scope(fnode) -> _FuncScope:
+    scope = _FuncScope(fnode)
+    for node in ast.walk(fnode):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not fnode:
+            continue  # walk still descends, but bindings are close enough
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            vals = _str_values(node.value)
+            if vals is not None:
+                scope.str_consts.setdefault(name, []).extend(vals)
+            if _is_time_time(node.value):
+                scope.wall_names.add(name)
+            if isinstance(node.value, ast.Dict):
+                keys = [k.value for k in node.value.keys
+                        if isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)]
+                if len(keys) == len(node.value.keys):
+                    scope.dict_keys.setdefault(name, []).extend(keys)
+    return scope
+
+
+def _lock_key(expr: ast.AST, rel: str, cls: str) -> Optional[str]:
+    """A stable identity for a lock expression, or None if not lock-ish."""
+    if isinstance(expr, ast.Attribute):
+        name = expr.attr
+        if "lock" not in name.lower():
+            return None
+        if isinstance(expr.value, ast.Name) and expr.value.id == "self":
+            return f"{rel}::{cls}.{name}" if cls else f"{rel}::{name}"
+        return f"{rel}::<attr>.{name}"
+    if isinstance(expr, ast.Name):
+        if "lock" not in expr.id.lower():
+            return None
+        return f"{rel}::{expr.id}"
+    return None
+
+
+def lint_source(source: str, rel: str,
+                allow: Optional[Dict[str, str]] = None,
+                registry: Optional[Dict[str, tuple]] = None,
+                lock_edges: Optional[Dict[Tuple[str, str], str]] = None,
+                ) -> List[Finding]:
+    """Lint one module's source.  `lock_edges` accumulates the global
+    acquisition-order graph across files (edge -> first site)."""
+    allow = ALLOWLIST if allow is None else allow
+    if registry is None:
+        from ..monitor.journal import EVENT_KINDS
+        registry = EVENT_KINDS
+    out: List[Finding] = []
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        out.append(_fn(RULE_JOURNAL_KIND, rel, ast.Module(body=[]), "",
+                       f"unparseable module: {e}"))
+        return out
+
+    # enclosing-function and enclosing-class maps
+    func_of: Dict[ast.AST, ast.AST] = {}
+    cls_of: Dict[ast.AST, str] = {}
+
+    def _assign_owners(node, fn, cls):
+        for child in ast.iter_child_nodes(node):
+            nfn, ncls = fn, cls
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                nfn = child
+            elif isinstance(child, ast.ClassDef):
+                ncls = child.name
+            func_of[child] = nfn
+            cls_of[child] = ncls
+            _assign_owners(child, nfn, ncls)
+
+    _assign_owners(tree, None, "")
+    scopes: Dict[ast.AST, _FuncScope] = {}
+
+    def scope_for(node) -> Optional[_FuncScope]:
+        fn = func_of.get(node)
+        if fn is None:
+            return None
+        if fn not in scopes:
+            scopes[fn] = _collect_scope(fn)
+        return scopes[fn]
+
+    def fname(node) -> str:
+        fn = func_of.get(node)
+        return fn.name if fn is not None else ""
+
+    module_has_join = any(
+        isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+        and n.func.attr == "join"
+        and not isinstance(n.func.value, ast.Constant)
+        and not (isinstance(n.func.value, ast.Attribute)
+                 and n.func.value.attr == "path")
+        and not (isinstance(n.func.value, ast.Name)
+                 and n.func.value.id in ("os", "posixpath", "path"))
+        for n in ast.walk(tree))
+
+    for node in ast.walk(tree):
+        func = fname(node)
+
+        # -- bare-put ---------------------------------------------------
+        if isinstance(node, ast.Call):
+            callee = (node.func.attr if isinstance(node.func, ast.Attribute)
+                      else node.func.id if isinstance(node.func, ast.Name)
+                      else None)
+            if callee == "put_cluster" \
+                    and not rel.endswith("elastic/config_server.py") \
+                    and not (rel.endswith("elastic/config_client.py")
+                             and func == "put_cluster"):
+                has_version = any(kw.arg == "version" for kw in node.keywords)
+                if len(node.args) >= 2:
+                    has_version = True  # positional version
+                if not has_version \
+                        and not _suppressed(RULE_BARE_PUT, rel, func, allow):
+                    out.append(_fn(
+                        RULE_BARE_PUT, rel, node, func,
+                        "put_cluster without version= — an unconditional "
+                        "PUT races the healer/autoscaler CAS discipline "
+                        "(pass the version read with the document)"))
+
+            # -- journal-kind ------------------------------------------
+            if callee in JOURNAL_CALLEES and node.args:
+                scope = scope_for(node)
+                a0 = node.args[0]
+                kinds = _str_values(a0)
+                forwarded = (isinstance(a0, ast.Name) and scope is not None
+                             and a0.id in scope.params
+                             and a0.id not in scope.str_consts)
+                if kinds is None and isinstance(a0, ast.Name) \
+                        and scope is not None:
+                    kinds = scope.str_consts.get(a0.id)
+                if not forwarded \
+                        and not _suppressed(RULE_JOURNAL_KIND, rel, func,
+                                            allow):
+                    if kinds is None:
+                        out.append(_fn(
+                            RULE_JOURNAL_KIND, rel, node, func,
+                            "journal emit with a kind this lint cannot "
+                            "resolve to a constant — use a literal or a "
+                            "local constant, or allowlist the wrapper"))
+                    else:
+                        for kind in kinds:
+                            if kind not in registry:
+                                out.append(_fn(
+                                    RULE_JOURNAL_KIND, rel, node, func,
+                                    f"journal kind {kind!r} is not "
+                                    "registered in monitor.journal."
+                                    "EVENT_KINDS"))
+                                continue
+                            if callee != "journal_event":
+                                continue  # wrappers add their own fields
+                            required = registry[kind]
+                            given = {kw.arg for kw in node.keywords
+                                     if kw.arg}
+                            unresolved_star = False
+                            for kw in node.keywords:
+                                if kw.arg is None:  # **expansion
+                                    keys = None
+                                    if isinstance(kw.value, ast.Name) \
+                                            and scope is not None:
+                                        keys = scope.dict_keys.get(
+                                            kw.value.id)
+                                    if keys is None:
+                                        unresolved_star = True
+                                    else:
+                                        given.update(keys)
+                            missing = [f for f in required
+                                       if f not in given]
+                            if missing and not unresolved_star:
+                                out.append(_fn(
+                                    RULE_JOURNAL_KIND, rel, node, func,
+                                    f"journal_event({kind!r}) missing "
+                                    f"required field(s) {missing} "
+                                    f"(EVENT_KINDS requires "
+                                    f"{list(required)})"))
+
+            # -- thread-lifecycle --------------------------------------
+            thread_ctor = (
+                (isinstance(node.func, ast.Attribute)
+                 and node.func.attr == "Thread"
+                 and isinstance(node.func.value, ast.Name)
+                 and node.func.value.id == "threading")
+                or (isinstance(node.func, ast.Name)
+                    and node.func.id == "Thread"))
+            if thread_ctor:
+                daemon = any(
+                    kw.arg == "daemon" and isinstance(kw.value, ast.Constant)
+                    and kw.value.value is True for kw in node.keywords)
+                if not daemon and not module_has_join \
+                        and not _suppressed(RULE_THREAD_LIFECYCLE, rel,
+                                            func, allow):
+                    out.append(_fn(
+                        RULE_THREAD_LIFECYCLE, rel, node, func,
+                        "threading.Thread neither daemon=True nor joined "
+                        "anywhere in this module — teardown can hang on it"))
+
+        # -- wall-clock-duration ---------------------------------------
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub):
+            scope = scope_for(node)
+            tainted = []
+            for side in (node.left, node.right):
+                if _is_time_time(side):
+                    tainted.append("time.time()")
+                elif isinstance(side, ast.Name) and scope is not None \
+                        and side.id in scope.wall_names:
+                    tainted.append(side.id)
+            if tainted and not _suppressed(RULE_WALL_CLOCK, rel, func,
+                                           allow):
+                out.append(_fn(
+                    RULE_WALL_CLOCK, rel, node, func,
+                    f"duration computed from wall clock ({', '.join(tainted)}"
+                    " in a subtraction) — an NTP step corrupts it; use "
+                    "time.monotonic() (the PR-4 negative-MTTR bug)"))
+
+    if lock_edges is not None:
+        _collect_lock_nesting(tree, rel, "", [], lock_edges)
+    return out
+
+
+def _collect_lock_nesting(node: ast.AST, rel: str, cls: str,
+                          held: List[str],
+                          edges: Dict[Tuple[str, str], str]) -> None:
+    """Top-down pass tracking syntactically-held locks.  A function body
+    starts with nothing held (a closure defined under a lock does not run
+    under it), and `with a, b:` acquires left-to-right."""
+    for child in ast.iter_child_nodes(node):
+        ncls, nheld = cls, held
+        if isinstance(child, ast.ClassDef):
+            ncls = child.name
+        elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+            nheld = []
+        elif isinstance(child, (ast.With, ast.AsyncWith)):
+            keys = []
+            for item in child.items:
+                k = _lock_key(item.context_expr, rel, cls)
+                if k is not None:
+                    keys.append(k)
+            nheld = held + keys
+            ordered = nheld
+            for i, outer in enumerate(ordered):
+                for inner in ordered[i + 1:]:
+                    if outer != inner:
+                        edges.setdefault((outer, inner),
+                                         f"{rel}:{child.lineno}")
+        _collect_lock_nesting(child, rel, ncls, nheld, edges)
+
+
+def _lock_cycle_findings(edges: Dict[Tuple[str, str], str]) -> List[Finding]:
+    graph: Dict[str, Set[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    WHITE, GREY, BLACK = 0, 1, 2
+    color = {v: WHITE for v in graph}
+    parent: Dict[str, str] = {}
+    cycle: List[str] = []
+    for root in sorted(graph):
+        if color[root] != WHITE or cycle:
+            continue
+        stack = [(root, iter(sorted(graph[root])))]
+        color[root] = GREY
+        while stack and not cycle:
+            v, it = stack[-1]
+            advanced = False
+            for w in it:
+                if color[w] == WHITE:
+                    color[w] = GREY
+                    parent[w] = v
+                    stack.append((w, iter(sorted(graph[w]))))
+                    advanced = True
+                    break
+                if color[w] == GREY:
+                    cyc = [v]
+                    cur = v
+                    while cur != w:
+                        cur = parent[cur]
+                        cyc.append(cur)
+                    cycle = list(reversed(cyc))
+                    break
+            if not advanced and not cycle:
+                color[v] = BLACK
+                stack.pop()
+    if not cycle:
+        return []
+    hops = " -> ".join(cycle + [cycle[0]])
+    sites = "; ".join(
+        f"{a}->{b} at {edges[(a, b)]}"
+        for a, b in zip(cycle, cycle[1:] + [cycle[0]]) if (a, b) in edges)
+    return [Finding(
+        rule=RULE_LOCK_ORDER, severity=ERROR,
+        message=(f"inconsistent lock acquisition order (potential ABBA "
+                 f"deadlock): {hops} ({sites})"),
+        path=("lock-order",), source=sites.split(";")[0])]
+
+
+def default_paths(root: Optional[str] = None) -> List[str]:
+    root = root or os.path.join(os.path.dirname(__file__), "..")
+    root = os.path.abspath(root)
+    out = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        rel_dir = os.path.relpath(dirpath, root)
+        if any(part in SKIP_PARTS for part in rel_dir.split(os.sep)):
+            dirnames[:] = []
+            continue
+        for f in sorted(filenames):
+            if not f.endswith(".py"):
+                continue
+            rel = os.path.normpath(os.path.join(rel_dir, f))
+            if rel.replace(os.sep, "/") in SKIP_FILES:
+                continue
+            out.append(os.path.join(dirpath, f))
+    return sorted(out)
+
+
+def lint_paths(paths: Optional[Iterable[str]] = None,
+               root: Optional[str] = None,
+               allow: Optional[Dict[str, str]] = None) -> List[Finding]:
+    """Lint a set of files (default: all of kungfu_tpu/); lock-order is a
+    whole-program property, so its cycle check runs over the union."""
+    root = os.path.abspath(
+        root or os.path.join(os.path.dirname(__file__), ".."))
+    files = list(paths) if paths is not None else default_paths(root)
+    out: List[Finding] = []
+    lock_edges: Dict[Tuple[str, str], str] = {}
+    for path in files:
+        rel = os.path.relpath(os.path.abspath(path), root)
+        rel = rel.replace(os.sep, "/")
+        try:
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+        except OSError as e:
+            out.append(Finding(
+                rule=RULE_JOURNAL_KIND, severity=ERROR,
+                message=f"unreadable file: {e}", path=(rel,), source=rel))
+            continue
+        out.extend(lint_source(src, rel, allow=allow,
+                               lock_edges=lock_edges))
+    out.extend(_lock_cycle_findings(lock_edges))
+    return out
+
+
+# ---------------------------------------------------------------------
+# registry <-> docs cross-check
+# ---------------------------------------------------------------------
+
+def docs_event_findings(docs_dir: Optional[str] = None) -> List[Finding]:
+    """The three-way drift check: the docs/observability.md event table
+    must list only registered kinds, and every registered kind must be
+    documented (backticked) somewhere under docs/."""
+    import re
+    from ..monitor.journal import EVENT_KINDS
+    docs_dir = docs_dir or os.path.join(
+        os.path.dirname(__file__), "..", "..", "docs")
+    docs_dir = os.path.abspath(docs_dir)
+    out: List[Finding] = []
+    documented: Set[str] = set()
+    table_kinds: Set[str] = set()
+    for name in sorted(os.listdir(docs_dir) if os.path.isdir(docs_dir)
+                       else []):
+        if not name.endswith(".md"):
+            continue
+        with open(os.path.join(docs_dir, name), encoding="utf-8") as f:
+            text = f.read()
+        documented.update(re.findall(r"`([a-z][a-z0-9_]+)`", text))
+        if name == "observability.md":
+            for line in text.splitlines():
+                m = re.match(r"\|\s*`([a-z][a-z0-9_]+)`(?:\s*/\s*"
+                             r"`([a-z][a-z0-9_]+)`)*\s*\|", line)
+                if m:
+                    table_kinds.update(
+                        re.findall(r"`([a-z][a-z0-9_]+)`",
+                                   line.split("|")[1]))
+    for kind in sorted(table_kinds - set(EVENT_KINDS)):
+        out.append(Finding(
+            rule=RULE_JOURNAL_KIND, severity=ERROR,
+            message=(f"docs/observability.md event table lists {kind!r}, "
+                     "which is not registered in EVENT_KINDS"),
+            path=("docs", "observability.md"), source="docs/observability.md"))
+    for kind in sorted(set(EVENT_KINDS) - documented):
+        out.append(Finding(
+            rule=RULE_JOURNAL_KIND, severity=ERROR,
+            message=(f"journal kind {kind!r} is registered but documented "
+                     "nowhere under docs/ (add it to the observability.md "
+                     "event table)"),
+            path=("docs",), source="docs/"))
+    return out
+
+
+def hostlint_findings(root: Optional[str] = None,
+                      allow: Optional[Dict[str, str]] = None,
+                      docs: bool = True) -> List[Finding]:
+    out = lint_paths(root=root, allow=allow)
+    if docs:
+        out.extend(docs_event_findings())
+    return out

@@ -1,0 +1,677 @@
+"""Chunk-level collective-schedule IR + the kf-verify dataflow oracle.
+
+A `Schedule` is the static description of one collective as rounds of
+`{src, dst, chunk, slot, op}` transfers — the granularity the PR-9/12
+Pallas ring machinery actually executes (per-hop DMA into a named scratch
+slot), not the whole-tensor graph edges the PR-2 oracle checks.  The GC3
+lesson (PAPERS.md) is that a schedule *search* is only safe behind an
+independent checker; this module is that checker's front half:
+
+  * `verify_dataflow` — symbolic chunk-set simulation.  Each (rank, chunk)
+    value is the frozenset of contributing ranks; a reduce unions two
+    DISJOINT sets (overlap = a contribution applied twice), a copy moves a
+    set verbatim.  After the last round every rank must hold exactly the
+    chunks its declared lax equivalent owes it, complete (all owed
+    contributions, each applied exactly once).
+  * `verify_slots` — slot-race freedom: a scratch slot at one rank is
+    written by at most one in-flight DMA (one source) per round.
+  * `schedule_cost` — per-round wire bytes per link medium, the numbers
+    the fitted α-β model (planner/cost.py) prices.  The round-trip tests
+    assert the shipped descriptors reproduce cost.py's decompositions.
+
+Deadlock-freedom (the wait-for graph over slots and credits) lives in
+analysis/deadlock.py; `verify_schedule` runs all three.
+
+Descriptors for every shipped schedule are compiled here from
+ops/ring_kernels.py's slot layout and planner/cost.py's round
+decompositions: ring RS/AG/AR (`_chunk_index`: rank d sends chunk
+(d-s-1) mod n at hop s into the dst's per-hop recv slot), the heap
+binary tree, tree-star, the hierarchical rotated multi-root schedule
+(cost.py's idealization: intra-host ring at row granularity + rotated
+recursive halving/doubling across hosts), and the fused ag-matmul /
+matmul-RS single legs.  planner/validate.py routes every enumerated plan
+through `schedule_for_plan`, so a future synthesized schedule inherits
+the oracle by emitting this IR.
+
+Chunk ids and slot ids are plain strings so a Schedule round-trips
+through JSON (`to_json`/`from_json`) — the synthesis contract.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .findings import (
+    ERROR,
+    Finding,
+    RULE_SCHED_DATAFLOW,
+    RULE_SCHED_SLOT,
+)
+
+REDUCE = "reduce"
+COPY = "copy"
+
+ALL_REDUCE = "all_reduce"
+REDUCE_SCATTER = "reduce_scatter"
+ALL_GATHER = "all_gather"
+
+
+@dataclasses.dataclass(frozen=True)
+class Transfer:
+    """One DMA: `src` sends its current value of `chunk` into scratch
+    `slot` at `dst`; `op` says whether the dst accumulates (reduce) or
+    overwrites (copy).  `elems` is the wire payload in elements."""
+
+    src: int
+    dst: int
+    chunk: str
+    slot: str
+    op: str
+    elems: int
+
+    def where(self) -> str:
+        return f"r{self.src}->r{self.dst} chunk {self.chunk} slot {self.slot}"
+
+
+Round = Tuple[Transfer, ...]
+
+
+@dataclasses.dataclass
+class Schedule:
+    """Rounds of transfers over a topology digest.
+
+    Attributes:
+      name: stable id ("ring-rs:n4", ...).
+      world: number of ranks.
+      collective: "all_reduce" | "reduce_scatter" | "all_gather".
+      lax_equivalent: the lax op whose ownership layout the final state
+        must match (documentation + the dataflow oracle's contract).
+      elems: logical payload in elements.
+      chunk_elems: chunk id -> wire elements for one hop of that chunk.
+      owners: reduce_scatter: chunk -> final owner rank;
+              all_gather: chunk -> initial owner rank; else empty.
+      rounds: the schedule body.
+      hosts: optional host grouping; classifies each (src, dst) link as
+        "ici" (same host) or "dcn" for cost annotation.
+      credits: optional per-(src,dst)-link in-flight DMA budget — the
+        bounded-credit handshake (PR 9's 2-slot staging pipeline is
+        credits=2).  None means slot reuse is the only constraint.
+    """
+
+    name: str
+    world: int
+    collective: str
+    lax_equivalent: str
+    elems: int
+    chunk_elems: Dict[str, int]
+    owners: Dict[str, int]
+    rounds: Tuple[Round, ...]
+    hosts: Optional[Tuple[Tuple[int, ...], ...]] = None
+    credits: Optional[int] = None
+    notes: str = ""
+
+    # -- topology -----------------------------------------------------
+    def medium(self, src: int, dst: int) -> str:
+        if self.hosts is None:
+            return "ici"
+        for grp in self.hosts:
+            if src in grp:
+                return "ici" if dst in grp else "dcn"
+        return "dcn"
+
+    # -- ownership contract -------------------------------------------
+    def full_set(self, chunk: str) -> frozenset:
+        if self.collective == ALL_GATHER:
+            return frozenset((self.owners[chunk],))
+        return frozenset(range(self.world))
+
+    def initial(self) -> List[Dict[str, frozenset]]:
+        holds: List[Dict[str, frozenset]] = [dict() for _ in range(self.world)]
+        for c in self.chunk_elems:
+            if self.collective == ALL_GATHER:
+                holds[self.owners[c]][c] = frozenset((self.owners[c],))
+            else:
+                for r in range(self.world):
+                    holds[r][c] = frozenset((r,))
+        return holds
+
+    def owed(self, rank: int) -> Tuple[str, ...]:
+        """Chunks `rank` must hold complete after the last round."""
+        if self.collective == REDUCE_SCATTER:
+            return tuple(c for c, o in self.owners.items() if o == rank)
+        return tuple(self.chunk_elems)
+
+    # -- JSON round-trip (the synthesis hand-off format) --------------
+    def to_json(self) -> str:
+        return json.dumps({
+            "name": self.name,
+            "world": self.world,
+            "collective": self.collective,
+            "lax_equivalent": self.lax_equivalent,
+            "elems": self.elems,
+            "chunk_elems": self.chunk_elems,
+            "owners": self.owners,
+            "hosts": [list(h) for h in self.hosts] if self.hosts else None,
+            "credits": self.credits,
+            "notes": self.notes,
+            "rounds": [[dataclasses.asdict(t) for t in rnd]
+                       for rnd in self.rounds],
+        }, sort_keys=True)
+
+    @classmethod
+    def from_json(cls, text: str) -> "Schedule":
+        doc = json.loads(text)
+        return cls(
+            name=doc["name"],
+            world=int(doc["world"]),
+            collective=doc["collective"],
+            lax_equivalent=doc["lax_equivalent"],
+            elems=int(doc["elems"]),
+            chunk_elems={str(k): int(v)
+                         for k, v in doc["chunk_elems"].items()},
+            owners={str(k): int(v) for k, v in doc["owners"].items()},
+            rounds=tuple(tuple(Transfer(**t) for t in rnd)
+                         for rnd in doc["rounds"]),
+            hosts=(tuple(tuple(h) for h in doc["hosts"])
+                   if doc.get("hosts") else None),
+            credits=doc.get("credits"),
+            notes=doc.get("notes", ""),
+        )
+
+
+def _finding(rule: str, sched: Schedule, rnd: Optional[int],
+             message: str) -> Finding:
+    path = (sched.name,) if rnd is None else (sched.name, f"round{rnd}")
+    return Finding(rule=rule, severity=ERROR, message=message, path=path,
+                   source=f"schedule:{sched.name}")
+
+
+def verify_structure(sched: Schedule) -> List[Finding]:
+    """Cheap shape checks the other verifiers assume."""
+    out: List[Finding] = []
+    for k, rnd in enumerate(sched.rounds):
+        for t in rnd:
+            if not (0 <= t.src < sched.world and 0 <= t.dst < sched.world):
+                out.append(_finding(
+                    RULE_SCHED_DATAFLOW, sched, k,
+                    f"transfer {t.where()} names a rank outside "
+                    f"[0, {sched.world})"))
+            elif t.src == t.dst:
+                out.append(_finding(
+                    RULE_SCHED_DATAFLOW, sched, k,
+                    f"self-send {t.where()} (local data never crosses "
+                    "the wire)"))
+            if t.chunk not in sched.chunk_elems:
+                out.append(_finding(
+                    RULE_SCHED_DATAFLOW, sched, k,
+                    f"transfer {t.where()} references undeclared chunk "
+                    f"{t.chunk!r}"))
+            if t.op not in (REDUCE, COPY):
+                out.append(_finding(
+                    RULE_SCHED_DATAFLOW, sched, k,
+                    f"transfer {t.where()} has unknown op {t.op!r}"))
+    return out
+
+
+def verify_dataflow(sched: Schedule) -> List[Finding]:
+    """Symbolic chunk-set simulation: correctness of the final layout and
+    exactly-once reduction of every contribution."""
+    out = verify_structure(sched)
+    if out:
+        return out
+    holds = sched.initial()
+    for k, rnd in enumerate(sched.rounds):
+        writes: Dict[Tuple[int, str], List[Tuple[Transfer, frozenset]]] = {}
+        for t in rnd:
+            val = holds[t.src].get(t.chunk, frozenset())
+            if not val:
+                out.append(_finding(
+                    RULE_SCHED_DATAFLOW, sched, k,
+                    f"{t.where()}: r{t.src} sends chunk {t.chunk} it does "
+                    "not hold yet"))
+                continue
+            writes.setdefault((t.dst, t.chunk), []).append((t, val))
+        for (dst, chunk), arrivals in writes.items():
+            acc = holds[dst].get(chunk, frozenset())
+            for t, val in arrivals:
+                if t.op == REDUCE:
+                    dup = acc & val
+                    if dup:
+                        out.append(_finding(
+                            RULE_SCHED_DATAFLOW, sched, k,
+                            f"{t.where()}: contribution(s) "
+                            f"{sorted(dup)} reduced twice into r{dst}"))
+                    acc = acc | val
+                else:  # COPY
+                    # overwriting a stale partial with a value that
+                    # CONTAINS it is the normal AG result-overwrites-input
+                    # pattern; losing contributions the incoming value
+                    # lacks is a conflict
+                    lost = acc - val
+                    if lost:
+                        out.append(_finding(
+                            RULE_SCHED_DATAFLOW, sched, k,
+                            f"{t.where()}: copy overwrites r{dst}'s "
+                            f"{sorted(acc)} with {sorted(val)}, losing "
+                            f"contribution(s) {sorted(lost)}"))
+                    acc = val
+            holds[dst][chunk] = acc
+    for r in range(sched.world):
+        for c in sched.owed(r):
+            got = holds[r].get(c, frozenset())
+            want = sched.full_set(c)
+            if got != want:
+                missing = sorted(want - got)
+                out.append(_finding(
+                    RULE_SCHED_DATAFLOW, sched, None,
+                    f"after the last round r{r} holds chunk {c} with "
+                    f"contributions {sorted(got)}; its "
+                    f"{sched.lax_equivalent} layout owes it {sorted(want)}"
+                    + (f" (missing {missing})" if missing else "")))
+    return out
+
+
+def verify_slots(sched: Schedule) -> List[Finding]:
+    """Slot-race freedom: each (dst, slot) is written by at most one
+    source DMA per round (one source may batch several chunks into one
+    descriptor — that is a single DMA)."""
+    out: List[Finding] = []
+    for k, rnd in enumerate(sched.rounds):
+        writers: Dict[Tuple[int, str], set] = {}
+        for t in rnd:
+            writers.setdefault((t.dst, t.slot), set()).add(t.src)
+        for (dst, slot), srcs in writers.items():
+            if len(srcs) > 1:
+                out.append(_finding(
+                    RULE_SCHED_SLOT, sched, k,
+                    f"slot {slot} at r{dst} written by "
+                    f"{len(srcs)} concurrent DMAs (sources "
+                    f"{sorted(srcs)}) in one round"))
+    return out
+
+
+def verify_schedule(sched: Schedule) -> List[Finding]:
+    """The full oracle: dataflow + slot races + deadlock-freedom."""
+    from .deadlock import verify_deadlock_free
+    out = verify_dataflow(sched)
+    out.extend(verify_slots(sched))
+    if not out:  # the wait-for graph assumes a structurally sane schedule
+        out.extend(verify_deadlock_free(sched))
+    return out
+
+
+# ---------------------------------------------------------------------
+# cost annotation
+# ---------------------------------------------------------------------
+
+def schedule_cost(sched: Schedule) -> List[Dict[str, int]]:
+    """Per-round busiest-link wire elements by medium — the quantity the
+    fitted α-β model multiplies by β per round (planner/cost.py prices
+    `rounds × leg_ms(medium, wire_bytes(elems))`)."""
+    out: List[Dict[str, int]] = []
+    for rnd in sched.rounds:
+        per_link: Dict[Tuple[int, int], int] = {}
+        for t in rnd:
+            per_link[(t.src, t.dst)] = per_link.get((t.src, t.dst), 0) + t.elems
+        by_medium: Dict[str, int] = {}
+        for (src, dst), e in per_link.items():
+            med = sched.medium(src, dst)
+            by_medium[med] = max(by_medium.get(med, 0), e)
+        out.append(by_medium)
+    return out
+
+
+def rounds_by_medium(sched: Schedule) -> Dict[str, List[int]]:
+    """Busiest-link elements of every round that touches each medium."""
+    out: Dict[str, List[int]] = {}
+    for by_medium in schedule_cost(sched):
+        for med, e in by_medium.items():
+            out.setdefault(med, []).append(e)
+    return out
+
+
+# ---------------------------------------------------------------------
+# descriptors of the shipped schedules
+# ---------------------------------------------------------------------
+
+def _ring_chunk(d: int, s: int, n: int) -> int:
+    """ops/ring_kernels.py `_chunk_index`: chunk rank d handles at hop s."""
+    return (d - (s + 1) + 2 * n) % n
+
+
+def ring_reduce_scatter(n: int, elems: Optional[int] = None,
+                        hosts=None, name: Optional[str] = None) -> Schedule:
+    """The PR-9 ring RS: hop s, rank d reduces chunk (d-s-1) mod n into
+    its right neighbour's per-hop recv slot; after n-1 hops rank d owns
+    chunk d — lax.psum_scatter(scatter_dimension=0)."""
+    e = elems if elems is not None else 16 * n
+    ce = math.ceil(e / n)
+    rounds = []
+    for s in range(n - 1):
+        rounds.append(tuple(
+            Transfer(src=d, dst=(d + 1) % n, chunk=str(_ring_chunk(d, s, n)),
+                     slot=f"rs{s}", op=REDUCE, elems=ce)
+            for d in range(n)))
+    return Schedule(
+        name=name or f"ring-rs:n{n}", world=n, collective=REDUCE_SCATTER,
+        lax_equivalent="psum_scatter(scatter_dimension=0)", elems=e,
+        chunk_elems={str(c): ce for c in range(n)},
+        owners={str(c): c for c in range(n)},
+        rounds=tuple(rounds), hosts=_hosts_tuple(hosts),
+        notes="per-hop recv slots (ring_kernels.py comm slots 0..n-2)")
+
+
+def ring_all_gather(n: int, elems: Optional[int] = None,
+                    hosts=None, name: Optional[str] = None) -> Schedule:
+    """The PR-9 ring AG: hop s, rank d forwards chunk (d-s) mod n to its
+    right neighbour, landing directly in the output slot for that chunk —
+    lax.all_gather(tiled=True)."""
+    e = elems if elems is not None else 16 * n
+    ce = math.ceil(e / n)
+    rounds = []
+    for s in range(n - 1):
+        rounds.append(tuple(
+            Transfer(src=d, dst=(d + 1) % n, chunk=str((d - s) % n),
+                     slot=f"out{(d - s) % n}", op=COPY, elems=ce)
+            for d in range(n)))
+    return Schedule(
+        name=name or f"ring-ag:n{n}", world=n, collective=ALL_GATHER,
+        lax_equivalent="all_gather(tiled=True)", elems=e,
+        chunk_elems={str(c): ce for c in range(n)},
+        owners={str(c): c for c in range(n)},
+        rounds=tuple(rounds), hosts=_hosts_tuple(hosts),
+        notes="chunks land in the output slot they belong to")
+
+
+def ring_all_reduce(n: int, elems: Optional[int] = None, hosts=None,
+                    name: Optional[str] = None,
+                    credits: Optional[int] = None) -> Schedule:
+    """RS then AG — 2(n-1) rounds of ceil(e/n), cost.py's ring row."""
+    e = elems if elems is not None else 16 * n
+    rs = ring_reduce_scatter(n, e)
+    ag = ring_all_gather(n, e)
+    return Schedule(
+        name=name or f"ring-ar:n{n}", world=n, collective=ALL_REDUCE,
+        lax_equivalent="psum", elems=e, chunk_elems=dict(rs.chunk_elems),
+        owners={}, rounds=rs.rounds + ag.rounds, hosts=_hosts_tuple(hosts),
+        credits=credits,
+        notes="chunked RS->AG; the Pallas pair executes the same routing")
+
+
+def _heap_depth(i: int) -> int:
+    return int(math.floor(math.log2(i + 1)))
+
+
+def binary_tree_all_reduce(n: int, elems: Optional[int] = None,
+                           hosts=None) -> Schedule:
+    """Heap-ordered binary tree (plan/graph.py gen_binary_tree): reduce
+    up level by level, broadcast back down; the full payload every round."""
+    e = elems if elems is not None else 16 * n
+    depth = max((_heap_depth(i) for i in range(n)), default=0)
+    up: List[List[Transfer]] = [[] for _ in range(depth)]
+    down: List[List[Transfer]] = [[] for _ in range(depth)]
+    for i in range(1, n):
+        parent = (i - 1) // 2
+        lvl = _heap_depth(i)
+        up[depth - lvl].append(Transfer(
+            src=i, dst=parent, chunk="0", slot=f"in{i}", op=REDUCE, elems=e))
+        down[lvl - 1].append(Transfer(
+            src=parent, dst=i, chunk="0", slot=f"bc{i}", op=COPY, elems=e))
+    rounds = tuple(tuple(r) for r in up + down if r)
+    return Schedule(
+        name=f"tree:n{n}", world=n, collective=ALL_REDUCE,
+        lax_equivalent="psum", elems=e, chunk_elems={"0": e}, owners={},
+        rounds=rounds, hosts=_hosts_tuple(hosts),
+        notes="one chunk; per-child recv slots")
+
+
+def tree_star_all_reduce(hosts: Sequence[Sequence[int]],
+                         elems: Optional[int] = None) -> Schedule:
+    """gen_binary_tree_star as rounds: members reduce into their local
+    master (one round, per-member slots), masters reduce up the heap tree
+    over hosts, broadcast mirrors both."""
+    groups = [tuple(g) for g in hosts if g]
+    n = sum(len(g) for g in groups)
+    e = elems if elems is not None else 16 * max(n, 1)
+    masters = [g[0] for g in groups]
+    h = len(groups)
+    depth = max((_heap_depth(i) for i in range(h)), default=0)
+    rounds: List[List[Transfer]] = []
+    gather = [Transfer(src=x, dst=g[0], chunk="0", slot=f"in{x}",
+                       op=REDUCE, elems=e)
+              for g in groups for x in g[1:]]
+    if gather:
+        rounds.append(gather)
+    up: List[List[Transfer]] = [[] for _ in range(depth)]
+    down: List[List[Transfer]] = [[] for _ in range(depth)]
+    for i in range(1, h):
+        parent = (i - 1) // 2
+        lvl = _heap_depth(i)
+        up[depth - lvl].append(Transfer(
+            src=masters[i], dst=masters[parent], chunk="0",
+            slot=f"in{masters[i]}", op=REDUCE, elems=e))
+        down[lvl - 1].append(Transfer(
+            src=masters[parent], dst=masters[i], chunk="0",
+            slot=f"bc{masters[i]}", op=COPY, elems=e))
+    rounds.extend(r for r in up if r)
+    rounds.extend(r for r in down if r)
+    scatter = [Transfer(src=g[0], dst=x, chunk="0", slot=f"bc{x}",
+                        op=COPY, elems=e)
+               for g in groups for x in g[1:]]
+    if scatter:
+        rounds.append(scatter)
+    return Schedule(
+        name=f"tree-star:h{h}m{max(len(g) for g in groups)}", world=n,
+        collective=ALL_REDUCE, lax_equivalent="psum", elems=e,
+        chunk_elems={"0": e}, owners={}, rounds=tuple(map(tuple, rounds)),
+        hosts=tuple(groups),
+        notes="star within host, heap tree across masters")
+
+
+def hierarchical_all_reduce(hosts: Sequence[Sequence[int]],
+                            elems: Optional[int] = None) -> Schedule:
+    """cost.py's hierarchical idealization, made executable: intra-host
+    ring RS at row granularity (2(m-1) ici rounds of ceil(e/m)), then the
+    rotated multi-root cross-host leg — h recursive-halving/doubling
+    all-reduce instances, instance k in a frame rotated by k, so the
+    rotations' link collisions exactly compensate the halving payloads and
+    every dcn round moves ceil(ceil(e/m)/h) per link over rounds_tree(h)
+    rounds — then intra-host ring AG.  Requires uniform group sizes."""
+    groups = [tuple(g) for g in hosts if g]
+    h = len(groups)
+    m = len(groups[0])
+    if any(len(g) != m for g in groups):
+        raise ValueError(
+            "hierarchical descriptor needs uniform host groups; got "
+            f"{[len(g) for g in groups]}")
+    n = h * m
+    hp = 1 << int(math.floor(math.log2(h)))  # participating power of two
+    pieces = hp
+    insts = hp if hp != h else h
+    e = elems if elems is not None else 4 * m * max(insts * pieces, 1)
+    row = math.ceil(e / m)
+    sub = math.ceil(row / insts)
+    pe = math.ceil(sub / pieces)
+
+    def cid(j: int, k: int, sig: int) -> str:
+        return f"{j}.{k}.{sig}"
+
+    chunk_elems = {cid(j, k, sig): pe
+                   for j in range(m) for k in range(insts)
+                   for sig in range(pieces)}
+    all_cols = [(k, sig) for k in range(insts) for sig in range(pieces)]
+    rounds: List[List[Transfer]] = []
+
+    # intra-host ring reduce-scatter over rows (ici), ring_kernels routing
+    for s in range(m - 1):
+        rnd = []
+        for g in groups:
+            for d in range(m):
+                j = _ring_chunk(d, s, m)
+                rnd.extend(Transfer(
+                    src=g[d], dst=g[(d + 1) % m], chunk=cid(j, k, sig),
+                    slot=f"rs{s}", op=REDUCE, elems=pe)
+                    for k, sig in all_cols)
+        rounds.append(rnd)
+
+    # non-power-of-two: surplus hosts fold their rows into a partner
+    if hp != h:
+        rnd = []
+        for g in range(hp, h):
+            for j in range(m):
+                rnd.extend(Transfer(
+                    src=groups[g][j % m], dst=groups[g - hp][j % m],
+                    chunk=cid(j, k, sig), slot="fold", op=REDUCE, elems=pe)
+                    for k, sig in all_cols)
+        rounds.append(rnd)
+
+    # cross-host rotated recursive halving (reduce): exchange xor-bit t at
+    # round t, SMALLEST distance first — with the per-instance rotation,
+    # 2^(t+1) instances then share each link while each sends
+    # pieces/2^(t+1), so every dcn round moves exactly sub elements/link
+    L = int(math.log2(hp)) if hp > 1 else 0
+    for t in range(L):
+        rnd = []
+        for k in range(insts):
+            for y in range(hp):
+                part = y ^ (1 << t)
+                src_h = (y + k) % hp
+                dst_h = (part + k) % hp
+                send = [sig for sig in range(pieces)
+                        if all((sig >> b) & 1 == (y >> b) & 1
+                               for b in range(t))
+                        and (sig >> t) & 1 == (part >> t) & 1]
+                for j in range(m):
+                    rnd.extend(Transfer(
+                        src=groups[src_h][j], dst=groups[dst_h][j],
+                        chunk=cid(j, k, sig), slot=f"h{t}.k{k}",
+                        op=REDUCE, elems=pe) for sig in send)
+        rounds.append(rnd)
+    # ... and doubling (broadcast back), mirroring in reverse bit order
+    for t in reversed(range(L)):
+        rnd = []
+        for k in range(insts):
+            for y in range(hp):
+                part = y ^ (1 << t)
+                src_h = (y + k) % hp
+                dst_h = (part + k) % hp
+                send = [sig for sig in range(pieces)
+                        if all((sig >> b) & 1 == (y >> b) & 1
+                               for b in range(t + 1))]
+                for j in range(m):
+                    rnd.extend(Transfer(
+                        src=groups[src_h][j], dst=groups[dst_h][j],
+                        chunk=cid(j, k, sig), slot=f"g{t}.k{k}",
+                        op=COPY, elems=pe) for sig in send)
+        rounds.append(rnd)
+
+    if hp != h:
+        rnd = []
+        for g in range(hp, h):
+            for j in range(m):
+                rnd.extend(Transfer(
+                    src=groups[g - hp][j % m], dst=groups[g][j % m],
+                    chunk=cid(j, k, sig), slot="unfold", op=COPY, elems=pe)
+                    for k, sig in all_cols)
+        rounds.append(rnd)
+
+    # intra-host ring all-gather over rows (ici)
+    for s in range(m - 1):
+        rnd = []
+        for g in groups:
+            for d in range(m):
+                j = (d - s) % m
+                rnd.extend(Transfer(
+                    src=g[d], dst=g[(d + 1) % m], chunk=cid(j, k, sig),
+                    slot=f"ag{j}", op=COPY, elems=pe)
+                    for k, sig in all_cols)
+        rounds.append(rnd)
+
+    return Schedule(
+        name=f"hierarchical:h{h}m{m}", world=n, collective=ALL_REDUCE,
+        lax_equivalent="psum", elems=e, chunk_elems=chunk_elems, owners={},
+        rounds=tuple(map(tuple, rounds)), hosts=tuple(groups),
+        notes="rotated multi-root dcn leg (cost.py hierarchical row)")
+
+
+def ag_matmul_schedule(n: int, elems: Optional[int] = None) -> Schedule:
+    """The fused all-gather-matmul gather leg (ops/ring_kernels.py
+    make_ag_matmul_kernel): weight shards rotate around the ring, hop s
+    forwards shard (d-s) mod n into the comm slot that holds W_c; n-1
+    rounds whose first hop is the only exposed wire (cost.py)."""
+    s = ring_all_gather(n, elems, name=f"ag-matmul:n{n}")
+    return dataclasses.replace(
+        s, lax_equivalent="all_gather(tiled=True) fused with matmul",
+        notes="steady-state hops hide behind the MXU; round 0 is the "
+              "exposed wire cost.py prices")
+
+
+def matmul_rs_schedule(n: int, elems: Optional[int] = None) -> Schedule:
+    """The fused matmul-reduce-scatter scatter leg: the ring RS routing
+    over fp32 partial products, per-hop recv slots + 2 staging buffers
+    (credits=2 on each link)."""
+    s = ring_reduce_scatter(n, elems, name=f"matmul-rs:n{n}")
+    return dataclasses.replace(
+        s, credits=2,
+        lax_equivalent="psum_scatter(scatter_dimension=0) fused with matmul",
+        notes="fp32 partials; 2-slot staging pipeline (PR-9 handshake); "
+              "steady-state hops hide behind the MXU; the last hop is the "
+              "exposed wire cost.py prices")
+
+
+def builtin_schedules() -> List[Schedule]:
+    """Every shipped schedule family at representative sizes — the corpus
+    `python -m kungfu_tpu.analysis --schedules` verifies in CI."""
+    out: List[Schedule] = []
+    for n in (2, 3, 4, 8):
+        out.append(ring_reduce_scatter(n))
+        out.append(ring_all_gather(n))
+        out.append(ring_all_reduce(n))
+        out.append(binary_tree_all_reduce(n))
+        out.append(ag_matmul_schedule(n))
+        out.append(matmul_rs_schedule(n))
+    out.append(ring_all_reduce(4, credits=2, name="pallas-ring:n4"))
+    for hosts in ([[0], [1]], [[0, 1], [2, 3]], [[0, 1, 2], [3, 4, 5]],
+                  [[0, 1], [2, 3], [4, 5]],
+                  [[0, 1], [2, 3], [4, 5], [6, 7]],
+                  [[0, 1, 2, 3], [4, 5, 6, 7]]):
+        out.append(tree_star_all_reduce(hosts))
+        out.append(hierarchical_all_reduce(hosts))
+    return out
+
+
+def _hosts_tuple(hosts) -> Optional[Tuple[Tuple[int, ...], ...]]:
+    if hosts is None:
+        return None
+    return tuple(tuple(g) for g in hosts if g)
+
+
+def schedule_for_plan(plan, hosts: Sequence[Sequence[int]],
+                      elems: Optional[int] = None) -> Optional[Schedule]:
+    """Chunk-level descriptor for an enumerated planner candidate, or None
+    when the algorithm has no chunk-level schedule (then the graph-level
+    oracle in planner/validate.py is the only check)."""
+    n = max(int(plan.world), 1)
+    groups = [tuple(g) for g in hosts if g] or [tuple(range(n))]
+    algo = plan.algorithm
+    if n < 2:
+        return None
+    if algo in ("ring", "pallas_ring", "pallas_ring_fused"):
+        credits = 2 if algo.startswith("pallas") else None
+        return ring_all_reduce(n, elems, hosts=groups,
+                               name=f"{algo}:n{n}", credits=credits)
+    if algo == "binary_tree":
+        return binary_tree_all_reduce(n, elems, hosts=groups)
+    if algo in ("tree_star", "hierarchical"):
+        m = len(groups[0])
+        uniform = all(len(g) == m for g in groups)
+        if algo == "hierarchical" and uniform and len(groups) > 1:
+            return hierarchical_all_reduce(groups, elems)
+        return tree_star_all_reduce(groups, elems)
+    if algo == "ag_matmul":
+        return ag_matmul_schedule(n, elems)
+    if algo == "matmul_rs":
+        return matmul_rs_schedule(n, elems)
+    return None

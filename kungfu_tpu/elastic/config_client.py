@@ -262,7 +262,9 @@ def propose_new_size(peer, new_size: int) -> bool:
         if cluster.size() == new_size:
             return False  # already proposed (or applied): no spurious bump
         resized = cluster.resize(new_size)
-        ok = client.put_cluster(resized)
+        # conditional on the version just read: a healer shrinking the
+        # cluster concurrently must win, not be silently overwritten
+        ok = client.put_cluster(resized, version=version)
     except OSError as e:  # outage past the retry budget: drop the proposal
         log.warning("propose_new_size: config server unreachable: %s", e)
         return False

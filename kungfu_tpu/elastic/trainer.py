@@ -544,7 +544,7 @@ def run_elastic(
     # survivors must issue identical collective sequences on the new mesh)
     skip_check_at = step
 
-    t_start = time.time()
+    t_start = time.monotonic()
     metrics: Dict[str, Any] = {"loss": np.float32(np.nan)}
 
     # the buddy tier: the step whose collective died poisons its output
@@ -1108,7 +1108,7 @@ def run_elastic(
         ckpt.close()
 
     loss = float(np.asarray(metrics["loss"]))
-    dt = time.time() - t_start
+    dt = time.monotonic() - t_start  # monotonic: NTP steps must not skew run duration
     totals = sorted(e.get("total_s", sum(e["phases"].values()))
                     for e in resize_events)
 

@@ -51,7 +51,120 @@ log = get_logger("kungfu.journal")
 JOURNAL_FILE_ENV = "KFT_JOURNAL_FILE"
 JOURNAL_DIR_ENV = "KFT_JOURNAL_DIR"
 JOURNAL_MAX_MB_ENV = "KFT_JOURNAL_MAX_MB"  # per-file cap; 0/unset = unbounded
+JOURNAL_STRICT_ENV = "KFT_JOURNAL_STRICT"  # 1 = unknown kind / missing field raises
 ROTATE_KEEP = 2  # rotated segments kept per journal (.1 newer, .2 older)
+
+#: The registry every journal emit is checked against: event kind -> the
+#: fields a consumer (drill assertion, docs/observability.md table,
+#: monitor CLI) may rely on.  Emit call sites, this table and the docs
+#: event table are cross-checked by kf-verify's hostlint (`python -m
+#: kungfu_tpu.analysis --hostlint`), so the three cannot drift; at
+#: runtime, validation only *raises* under KFT_JOURNAL_STRICT=1 or
+#: KUNGFU_ANALYZE=1 (journal_event's never-raise contract holds in
+#: production — an unregistered kind is journaled anyway and logged).
+EVENT_KINDS: Dict[str, tuple] = {
+    # training lifecycle (elastic/trainer.py, distributed.py)
+    "heal": ("mttr_s",),
+    "resize": ("old_size", "new_size", "version"),
+    "resume": ("step", "ckpt_step"),
+    "preemption": ("step",),
+    "peer_failure_suspected": ("reason", "step"),
+    "recovery_exhausted": ("reason",),
+    "dirty_teardown": ("duration_s",),
+    "checkpoint_resume_skipped": ("directory",),
+    # checkpoint integrity (checkpoint.py, resilience/)
+    "checkpoint_demoted": ("step", "reason"),
+    "checkpoint_restored": ("step",),
+    "checkpoint_save_failed": ("step", "error"),
+    "recovery_demotion": ("candidate", "reason"),
+    "buddy_colocated": ("rank", "buddy"),
+    "buddy_ship_failed": ("buddy", "step"),
+    # launcher / healer (run/launcher.py)
+    "worker_failure": ("peer", "rc"),
+    "worker_restart": ("peer",),
+    "worker_slow": ("peer",),
+    "stall_kill": ("peer",),
+    "stall_abort": ("op", "waited_s"),
+    "heal_shrink": ("old_size", "new_size"),
+    "host_heal_shrink": ("host", "old_size", "new_size"),
+    "host_suspected": ("host",),
+    "host_suspect_cleared": ("host",),
+    "partition_suspected": ("hosts", "suspects"),
+    "partition_cleared": ("hosts",),
+    "stale_flows_killed": ("host",),
+    "reconvene": ("cluster_version", "size"),
+    # adaptation (session.py, policy.py, monitor/interference.py)
+    "strategy_switch": ("old", "new"),
+    "compression_switch": ("old", "new"),
+    "interference_vote": ("old", "new"),
+    "policy_error": ("policy", "error"),
+    "straggler_response": ("grade", "ranks"),
+    # planner / tuner (planner/core.py, tuner/core.py)
+    "plan_selected": ("plan", "algorithm", "source"),
+    "plan_rejected": ("plan", "reason"),
+    "replan": ("reason",),
+    "tuner_selected": ("config", "source"),
+    "tuner_rejected": ("config", "reason"),
+    "tuner_measure_failed": ("config", "error"),
+    # monitor detectors (monitor/straggler.py, monitor/slo.py)
+    "straggler_suspected": ("rank",),
+    "straggler_cleared": ("rank",),
+    "input_starvation": ("rank",),
+    "link_hotspot": ("link",),
+    "anomaly_regression": ("metric", "ratio"),
+    "anomaly_cleared": ("metric",),
+    "slo_breach": ("rule", "metric"),
+    "slo_cleared": ("rule", "metric"),
+    # serving (serving/*)
+    "rank_rejoined": ("rank", "recovery_rung"),
+    "worker_unhealthy": ("peer",),
+    "request_requeued": ("req_id",),
+    "requeued_request_completed": ("req_id", "requeues"),
+    "scale_up": ("old_size", "new_size"),
+    "scale_down": ("old_size", "new_size"),
+    "kv_shipped": ("req_id", "tokens"),
+    "prefix_evicted": ("bytes",),
+    "prefix_invalidated": ("reason",),
+    "spec_disabled": ("accept_ema",),
+    "slot_preempted": ("req_id", "slot"),
+    "preempted_readmitted": ("req_id", "slot"),
+    "tenant_rate_limited": ("tenant",),
+    "overload_shed": ("req_id", "rung"),
+    "overload_clamp": ("req_id", "tenant"),
+    "overload_deadline_extended": ("req_id", "tenant"),
+    "overload_rung_changed": ("from_rung", "to_rung"),
+    # chaos injection (chaos/inject.py)
+    "chaos_crash": ("code",),
+    "chaos_crash_serve": ("code",),
+    "chaos_crash_in_save": ("code",),
+    "chaos_hang": ("secs",),
+    "chaos_slow": ("ms",),
+    "chaos_slow_serve": ("phase",),
+    "chaos_corrupt_ckpt": ("ckpt_step",),
+    # benchmark harness (benchmarks/runner.py)
+    "bench_probe_failed": ("section",),
+    "bench_probe_recovered": ("section",),
+    "bench_requeued": ("section",),
+    "bench_section_failed": ("section",),
+}
+
+
+def _strict() -> bool:
+    return (os.environ.get(JOURNAL_STRICT_ENV, "") == "1"
+            or os.environ.get("KUNGFU_ANALYZE", "") == "1")
+
+
+def validate_event(event: str, fields: Dict[str, Any]) -> Optional[str]:
+    """Registry check for one emit; returns a problem string or None."""
+    spec = EVENT_KINDS.get(event)
+    if spec is None:
+        return (f"journal kind {event!r} is not registered in "
+                "monitor.journal.EVENT_KINDS")
+    missing = [f for f in spec if f not in fields]
+    if missing:
+        return (f"journal kind {event!r} missing required field(s) "
+                f"{missing} (registry: {list(spec)})")
+    return None
 
 
 def _max_bytes_from_env() -> int:
@@ -187,7 +300,15 @@ def global_journal() -> Optional[Journal]:
 
 
 def journal_event(event: str, **fields: Any) -> None:
-    """Emit one lifecycle event; never raises, no-op when unconfigured."""
+    """Emit one lifecycle event; never raises in production (the record is
+    journaled even when it fails the registry check), but under
+    KFT_JOURNAL_STRICT=1 / KUNGFU_ANALYZE=1 a registry violation raises —
+    the mode tests and the analysis CLI run in."""
+    problem = validate_event(event, fields)
+    if problem is not None:
+        if _strict():
+            raise ValueError(problem)
+        log.debug("%s", problem)
     j = global_journal()
     if j is None:
         return

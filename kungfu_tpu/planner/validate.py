@@ -8,6 +8,14 @@ No plan the search emits may be installed until it passes two checks:
                  acyclic / rank-covering, and each pair internally
                  consistent.  Pure graph algebra — runs at any world size
                  with no devices.
+  schedule level the chunk-level schedule descriptor of the plan's
+                 algorithm (analysis.schedule_for_plan) run through the
+                 kf-verify oracle: symbolic dataflow simulation (every
+                 rank ends owed exactly its contributions), slot-race
+                 freedom, and wait-for-graph deadlock freedom under the
+                 declared credit budget.  Catches bugs the graph algebra
+                 cannot see — a correct ring permutation scheduled
+                 through one shared recv slot still deadlocks.
   program level  the *actual compiled program* the plan selects
                  (Session.program_for) traced and run through the full
                  kf-lint rule engine (`analysis.check`) — axis validity,
@@ -45,9 +53,30 @@ def plan_findings(
         )]
     findings = list(analysis.check_collective_plan(
         pairs, plan.world, what=plan.describe()))
+    if not analysis.errors(findings):
+        findings.extend(schedule_findings(plan, hosts))
     if session is not None and not analysis.errors(findings):
         findings.extend(program_findings(plan, session))
     return findings
+
+
+def schedule_findings(
+    plan: Plan,
+    hosts: Sequence[Sequence[int]],
+) -> List[analysis.Finding]:
+    """Compile the plan's chunk-level schedule descriptor and run the
+    kf-verify oracle on it (dataflow / slot races / deadlock).  Plans
+    whose algorithm has no descriptor (or world < 2) verify vacuously."""
+    try:
+        sched = analysis.schedule_for_plan(plan, hosts)
+    except ValueError as e:
+        return [analysis.Finding(
+            rule=analysis.RULE_SCHED_DATAFLOW, severity=analysis.ERROR,
+            message=f"{plan.describe()}: schedule descriptor refused: {e}",
+        )]
+    if sched is None:
+        return []
+    return list(analysis.verify_schedule(sched))
 
 
 def program_findings(plan: Plan, session) -> List[analysis.Finding]:

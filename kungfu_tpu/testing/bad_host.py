@@ -1,0 +1,72 @@
+"""Seeded-bad host code — the hostlint negative corpus.
+
+One deliberate violation per hostlint rule, in otherwise-plausible
+control-plane shapes.  `python -m kungfu_tpu.analysis --hostlint
+kungfu_tpu/testing/bad_host.py` must exit 1 with exactly these findings;
+the default tree scan SKIPS this file (hostlint.SKIP_FILES).  Nothing
+imports this module at runtime.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+
+def bad_bare_put(client, cluster, new_size):
+    """bare-put: an unconditional overwrite of the cluster document —
+    the healer's concurrent CAS shrink would be silently undone."""
+    resized = cluster.resize(new_size)
+    return client.put_cluster(resized)  # no version= -> last-writer-wins
+
+
+def bad_unregistered_kind(journal_event):
+    """journal-kind: a kind nobody registered in EVENT_KINDS — grep for
+    it in a postmortem and the registry says it cannot exist."""
+    journal_event("worker_exploded", peer="w3")
+
+
+def bad_missing_fields(journal_event):
+    """journal-kind: a registered kind missing its required fields — the
+    MTTR dashboard reads `mttr_s` from every heal event."""
+    journal_event("heal", reason="collective_failure")
+
+
+def bad_wall_clock_duration():
+    """wall-clock-duration: the PR-4 bug — an NTP step mid-heal once
+    produced a negative MTTR in the journal."""
+    t0 = time.time()
+    _work = sum(range(1000))
+    return time.time() - t0
+
+
+class BadThread:
+    """thread-lifecycle: neither daemon=True nor a join on any path —
+    a crash leaves the process pinned by this thread."""
+
+    def start(self):
+        t = threading.Thread(target=self._run)
+        t.start()
+        return t
+
+    def _run(self):
+        while True:
+            time.sleep(60)
+
+
+class BadLockOrder:
+    """lock-order: two paths acquiring the same pair of locks in
+    opposite orders — the classic ABBA deadlock."""
+
+    def __init__(self):
+        self._state_lock = threading.Lock()
+        self._journal_lock = threading.Lock()
+
+    def path_a(self):
+        with self._state_lock:
+            with self._journal_lock:
+                return "a"
+
+    def path_b(self):
+        with self._journal_lock:
+            with self._state_lock:
+                return "b"

@@ -1,5 +1,5 @@
 #!/usr/bin/env bash
-# Repo gate: lint (ruff), kf-lint static analysis, chaos smoke, tier-1 tests.
+# Repo gate: lint (ruff), kf-verify static analysis, chaos smoke, tier-1 tests.
 #
 #   scripts/check.sh            # run everything
 #   scripts/check.sh --fast     # skip the chaos smoke + tier-1 pytest run
@@ -12,22 +12,36 @@ fast=0
 [ "${1:-}" = "--fast" ] && fast=1
 
 echo "== ruff =="
+# unconditional gate: a missing linter must fail loudly, not silently
+# wave the tree through (CI installs ruff; see .github/workflows/ci.yaml)
 if command -v ruff >/dev/null 2>&1; then
     ruff check kungfu_tpu tests examples scripts bench.py
 elif python -c "import ruff" >/dev/null 2>&1; then
     python -m ruff check kungfu_tpu tests examples scripts bench.py
 else
-    # the container bakes its own toolchain; never pip install here
-    echo "ruff not installed — skipping (config lives in pyproject.toml)"
+    echo "ERROR: ruff is not installed — the lint gate cannot run" >&2
+    echo "       (pip install ruff; config lives in pyproject.toml)" >&2
+    exit 1
 fi
 
-echo "== kf-lint: shipped corpus (must be clean) =="
+echo "== kf-verify: schedules + hostlint + env audit (must be clean) =="
+JAX_PLATFORMS=cpu python -m kungfu_tpu.analysis --schedules --hostlint --env
+
+echo "== kf-verify: jaxpr corpus (must be clean) =="
 JAX_PLATFORMS=cpu python -m kungfu_tpu.analysis
 
-echo "== kf-lint: seeded-bad corpus (must fail) =="
+echo "== kf-verify: seeded-bad programs + schedules (must fail) =="
 if JAX_PLATFORMS=cpu python -m kungfu_tpu.analysis \
         --module kungfu_tpu.testing.bad_programs >/dev/null 2>&1; then
     echo "ERROR: seeded-bad programs analyzed clean — the rules lost teeth" >&2
+    exit 1
+fi
+echo "ok (exit non-zero as expected)"
+
+echo "== kf-verify: seeded-bad host code (must fail) =="
+if JAX_PLATFORMS=cpu python -m kungfu_tpu.analysis \
+        --hostlint kungfu_tpu/testing/bad_host.py >/dev/null 2>&1; then
+    echo "ERROR: seeded-bad host code linted clean — hostlint lost teeth" >&2
     exit 1
 fi
 echo "ok (exit non-zero as expected)"

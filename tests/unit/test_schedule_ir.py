@@ -1,0 +1,205 @@
+"""kf-verify schedule oracle: every shipped schedule descriptor verifies
+clean at n in {2,3,4,8}, per-round byte counts agree with the planner
+cost model's decompositions, every seeded-bad schedule trips EXACTLY its
+expected rule, the IR survives a JSON round trip, and the planner's
+validity gate routes through the oracle.
+"""
+import json
+import math
+
+import pytest
+
+from kungfu_tpu import analysis
+from kungfu_tpu.analysis import deadlock as dl
+from kungfu_tpu.analysis import schedule as sched
+from kungfu_tpu.planner.model import rounds_tree
+from kungfu_tpu.testing import bad_programs
+
+pytestmark = pytest.mark.analysis
+
+SIZES = (2, 3, 4, 8)
+
+
+# -- the shipped corpus verifies clean ------------------------------------------------
+
+
+class TestCleanCorpus:
+    @pytest.mark.parametrize("n", SIZES)
+    @pytest.mark.parametrize("build", [
+        sched.ring_reduce_scatter, sched.ring_all_gather,
+        sched.ring_all_reduce, sched.binary_tree_all_reduce,
+        sched.ag_matmul_schedule, sched.matmul_rs_schedule,
+    ])
+    def test_family_clean(self, build, n):
+        findings = sched.verify_schedule(build(n))
+        assert not analysis.errors(findings), [f.message for f in findings]
+
+    @pytest.mark.parametrize("hosts", [
+        [[0, 1]], [[0, 1], [2, 3]], [[0, 1, 2, 3], [4, 5, 6, 7]],
+        [[0, 1], [2, 3], [4, 5], [6, 7]],
+        [[0, 1], [2, 3], [4, 5]],  # non-power-of-2 host count
+    ])
+    def test_tree_star_clean(self, hosts):
+        findings = sched.verify_schedule(sched.tree_star_all_reduce(hosts))
+        assert not analysis.errors(findings), [f.message for f in findings]
+
+    @pytest.mark.parametrize("hosts", [
+        [[0, 1], [2, 3]], [[0, 1, 2, 3], [4, 5, 6, 7]],
+        [[0, 1], [2, 3], [4, 5], [6, 7]],
+        [[0, 1], [2, 3], [4, 5]],  # fold-in prologue path
+    ])
+    def test_hierarchical_clean(self, hosts):
+        findings = sched.verify_schedule(
+            sched.hierarchical_all_reduce(hosts))
+        assert not analysis.errors(findings), [f.message for f in findings]
+
+    def test_builtin_corpus_all_clean(self):
+        corpus = sched.builtin_schedules()
+        assert len(corpus) >= 25
+        for s in corpus:
+            findings = sched.verify_schedule(s)
+            assert not analysis.errors(findings), (
+                s.name, [f.message for f in findings])
+
+    def test_pallas_credit_budget_clean(self):
+        # the PR-9 2-slot handshake, machine-checked: credits=2 is safe...
+        s = sched.ring_all_reduce(4, credits=2)
+        assert not analysis.errors(sched.verify_schedule(s))
+        # ...credits=1 on the same routing deadlocks
+        import dataclasses
+        s1 = dataclasses.replace(s, credits=1)
+        findings = dl.verify_deadlock_free(s1)
+        assert [f.rule for f in findings] == [analysis.RULE_SCHED_DEADLOCK]
+
+
+# -- cost agreement with planner/cost.py ----------------------------------------------
+
+
+class TestCostAgreement:
+    @pytest.mark.parametrize("n", SIZES)
+    def test_ring_decomposition(self, n):
+        # cost.py ring row: 2(n-1) rounds of ceil(e/n) on the busiest link
+        e = 4096
+        cost = sched.schedule_cost(sched.ring_all_reduce(n, e))
+        assert len(cost) == 2 * (n - 1)
+        assert all(r == {"ici": math.ceil(e / n)} for r in cost)
+
+    @pytest.mark.parametrize("hosts", [
+        [[0, 1], [2, 3]], [[0, 1, 2, 3], [4, 5, 6, 7]],
+        [[0, 1], [2, 3], [4, 5], [6, 7]],
+    ])
+    def test_hierarchical_decomposition(self, hosts):
+        # cost.py hierarchical row: rounds_tree(h) dcn rounds, each moving
+        # ceil(shard/h) per busiest link, with shard = ceil(e/m)
+        h, m = len(hosts), len(hosts[0])
+        e = 8192
+        s = sched.hierarchical_all_reduce(hosts, e)
+        by_medium = sched.rounds_by_medium(s)
+        shard = math.ceil(e / m)
+        assert len(by_medium["dcn"]) == rounds_tree(h)
+        assert all(x == math.ceil(shard / h) for x in by_medium["dcn"])
+        if m > 1:
+            # intra legs: ring RS + final AG at shard granularity
+            assert len(by_medium["ici"]) == 2 * (m - 1)
+            assert all(x == shard for x in by_medium["ici"])
+
+    @pytest.mark.parametrize("n", SIZES)
+    def test_fused_exposed_round(self, n):
+        # cost.py prices the fused overlap as ONE exposed round of
+        # wire(ceil(e/n)); the descriptor carries all n-1 routing rounds
+        # and marks the exposure in its notes
+        e = 4096
+        for build in (sched.ag_matmul_schedule, sched.matmul_rs_schedule):
+            s = build(n, e)
+            cost = sched.schedule_cost(s)
+            assert len(cost) == n - 1
+            assert all(r == {"ici": math.ceil(e / n)} for r in cost)
+            assert "exposed" in s.notes
+
+    @pytest.mark.parametrize("hosts", [
+        [[0, 1], [2, 3]], [[0, 1, 2], [3, 4, 5]],
+    ])
+    def test_tree_star_dcn_rounds(self, hosts):
+        # inter-host leg: rounds_tree(h) dcn rounds at shard granularity
+        h, m = len(hosts), len(hosts[0])
+        e = 4096
+        s = sched.tree_star_all_reduce(hosts, e)
+        by_medium = sched.rounds_by_medium(s)
+        assert len(by_medium["dcn"]) == rounds_tree(h)
+
+
+# -- seeded-bad schedules fire exactly their rule -------------------------------------
+
+
+class TestSeededBadSchedules:
+    @pytest.mark.parametrize(
+        "bad", bad_programs.BAD_SCHEDULES, ids=lambda s: s.name)
+    def test_exactly_expected_rule(self, bad):
+        expected = bad_programs.EXPECTED_SCHEDULE_RULE[bad.name]
+        findings = sched.verify_schedule(bad)
+        rules = {f.rule for f in analysis.errors(findings)}
+        assert rules == {expected}, (bad.name, [f.message for f in findings])
+
+    def test_rule_cover(self):
+        # the bad corpus must exercise every schedule rule
+        assert (set(bad_programs.EXPECTED_SCHEDULE_RULE.values())
+                == set(analysis.SCHEDULE_RULES))
+
+    def test_findings_name_the_offending_site(self):
+        # acceptance bar: findings must name the offending round/slot
+        cycle = [f for f in sched.verify_schedule(
+            bad_programs.BAD_SCHEDULES[1])
+            if f.rule == analysis.RULE_SCHED_DEADLOCK]
+        assert cycle and "round" in cycle[0].message \
+            and "s0" in cycle[0].message
+
+
+# -- IR round trip --------------------------------------------------------------------
+
+
+class TestJsonRoundTrip:
+    @pytest.mark.parametrize("n", SIZES)
+    def test_ring_round_trips(self, n):
+        s = sched.ring_all_reduce(n, 1024, credits=2)
+        t = sched.Schedule.from_json(s.to_json())
+        assert t == s
+        assert not analysis.errors(sched.verify_schedule(t))
+
+    def test_hierarchical_round_trips(self):
+        s = sched.hierarchical_all_reduce([[0, 1], [2, 3]], 2048)
+        blob = s.to_json()
+        json.loads(blob)  # valid JSON, not just repr
+        assert sched.Schedule.from_json(blob) == s
+
+
+# -- planner integration --------------------------------------------------------------
+
+
+class TestPlannerGate:
+    def _plan(self, algorithm, world):
+        from kungfu_tpu.planner.candidates import ALGORITHMS, Plan
+
+        strategy = ALGORITHMS.get(algorithm)
+        return Plan(algorithm=algorithm,
+                    strategy_name=strategy.name if strategy else "RING",
+                    wire=(("flat", "none"),), bucket="1m", world=world)
+
+    @pytest.mark.parametrize("algo", [
+        "ring", "binary_tree", "tree_star", "pallas_ring", "ag_matmul",
+    ])
+    def test_shipped_algorithms_pass_gate(self, algo):
+        from kungfu_tpu.planner.validate import schedule_findings
+
+        plan = self._plan(algo, 4)
+        assert not analysis.errors(
+            schedule_findings(plan, [[0, 1, 2, 3]]))
+
+    def test_schedule_for_plan_hierarchical(self):
+        plan = self._plan("hierarchical", 8)
+        s = sched.schedule_for_plan(plan, [[0, 1, 2, 3], [4, 5, 6, 7]])
+        assert s is not None and s.hosts is not None
+        assert "dcn" in sched.rounds_by_medium(s)
+
+    def test_unknown_algorithm_is_vacuous(self):
+        assert sched.schedule_for_plan(
+            self._plan("compressed_flat", 4), [[0, 1, 2, 3]]) is None
