@@ -77,15 +77,36 @@ def _kill_tree(p: subprocess.Popen) -> None:
         log.warning("child %d unkillable (abandoned)", p.pid)
 
 
-def probe_backend_ex(timeout_s: float = 90.0,
+PROBE_TIMEOUT_ENV = "KFT_BENCH_PROBE_TIMEOUT_S"
+DEFAULT_PROBE_TIMEOUT_S = 90.0
+
+
+def probe_timeout_s(default: float = DEFAULT_PROBE_TIMEOUT_S) -> float:
+    """The probe's subprocess deadline: KFT_BENCH_PROBE_TIMEOUT_S, else
+    `default`.  A slow remote tunnel legitimately needs minutes for its
+    first dispatch; the knob keeps that an operator decision instead of a
+    code edit (the BENCH r03-r05 wedges ran with the default blind)."""
+    try:
+        v = os.environ.get(PROBE_TIMEOUT_ENV, "")
+        return max(1.0, float(v)) if v else default
+    except ValueError:
+        return default
+
+
+def probe_backend_ex(timeout_s: Optional[float] = None,
                      env: Optional[Dict[str, str]] = None) -> Optional[Dict[str, object]]:
     """None when a trivial dispatch completes on an acceptable platform
-    within `timeout_s`; else a diagnosis dict: `reason` (the headline),
-    `exit` (returncode or "timeout"), and the probe's captured `stderr`
-    tail — the detail the BENCH journal needs to say WHY
-    `measured_this_run` went false instead of just that it did
-    (ROADMAP item 6: two committed rounds shipped with a wedged probe and
-    no recorded cause)."""
+    within `timeout_s` (None = KFT_BENCH_PROBE_TIMEOUT_S, default 90 s);
+    else a diagnosis dict: `reason` (the headline), `cause` — "timeout"
+    (deadline expired, whole process group SIGKILLed) vs "crash" vs
+    "fallback" vs "no_sentinel", the distinction that makes a tunnel wedge
+    diagnosable from the json alone — `exit` (returncode or "timeout"),
+    and the probe's captured `stderr` tail: the detail the BENCH journal
+    needs to say WHY `measured_this_run` went false instead of just that
+    it did (ROADMAP item 6: two committed rounds shipped with a wedged
+    probe and no recorded cause)."""
+    if timeout_s is None:
+        timeout_s = probe_timeout_s()
     full_env = dict(os.environ)
     if env:
         full_env.update(env)
@@ -98,26 +119,29 @@ def probe_backend_ex(timeout_s: float = 90.0,
     while time.monotonic() < deadline and p.poll() is None:
         time.sleep(0.2)
     if p.poll() is None:
+        # start_new_session above made the probe its own process group:
+        # _kill_tree's killpg takes the whole tree down, grandchildren
+        # (libtpu helpers) included, so the NEXT probe starts clean
         _kill_tree(p)
         return {"reason": f"probe timed out after {timeout_s:.0f}s "
                           "(backend wedged)",
-                "exit": "timeout", "stderr": ""}
+                "cause": "timeout", "exit": "timeout", "stderr": ""}
     out = p.stdout.read() if p.stdout is not None else ""
     err = (p.stderr.read() if p.stderr is not None else "").strip()[-800:]
     if p.returncode != 0:
         return {"reason": f"probe exited {p.returncode}",
-                "exit": p.returncode, "stderr": err}
+                "cause": "crash", "exit": p.returncode, "stderr": err}
     if "PROBE_OK" in out:
         return None
     if "PROBE_FALLBACK" in out:
         return {"reason": ("backend fell back to an unrequested platform "
                            f"({out.strip().split()[-1]})"),
-                "exit": p.returncode, "stderr": err}
+                "cause": "fallback", "exit": p.returncode, "stderr": err}
     return {"reason": "probe printed no sentinel",
-            "exit": p.returncode, "stderr": err}
+            "cause": "no_sentinel", "exit": p.returncode, "stderr": err}
 
 
-def probe_backend(timeout_s: float = 90.0,
+def probe_backend(timeout_s: Optional[float] = None,
                   env: Optional[Dict[str, str]] = None) -> Optional[str]:
     """None when the backend answers; else the reason string (the
     compatibility wrapper over `probe_backend_ex`)."""
@@ -197,7 +221,8 @@ def _normalize_probe(result) -> Optional[Dict[str, object]]:
     return result if isinstance(result, dict) else {"reason": str(result)}
 
 
-def run_sections(sections: Sequence[Section], probe_timeout_s: float = 90.0,
+def run_sections(sections: Sequence[Section],
+                 probe_timeout_s: Optional[float] = None,
                  retries: int = 2, interval_s: float = 5.0,
                  probe: Callable[..., object] = probe_backend_ex,
                  sleep: Callable[[float], None] = time.sleep) -> Dict[str, dict]:
@@ -235,6 +260,7 @@ def run_sections(sections: Sequence[Section], probe_timeout_s: float = 90.0,
                 journal_event("bench_probe_recovered", section=s.name,
                               attempt=attempts[s.name],
                               error=diag.get("reason"),
+                              cause=diag.get("cause"),
                               exit=diag.get("exit"),
                               stderr=diag.get("stderr"))
                 log.warning("section %s: probe recovered on a fresh env "
@@ -244,9 +270,11 @@ def run_sections(sections: Sequence[Section], probe_timeout_s: float = 90.0,
             fail = f"probe: {diag.get('reason')}"
             journal_event("bench_probe_failed", section=s.name,
                           attempt=attempts[s.name], error=diag.get("reason"),
+                          cause=diag.get("cause"),
                           exit=diag.get("exit"), stderr=diag.get("stderr"),
                           retried=True,
-                          retry_error=retry_diag.get("reason"))
+                          retry_error=retry_diag.get("reason"),
+                          retry_cause=retry_diag.get("cause"))
             log.warning("section %s: %s", s.name, fail)
         else:
             try:
@@ -286,7 +314,9 @@ def main(argv=None) -> int:
                     help="file with one shell command per line (#/blank "
                          "skipped); each must print a JSON record line")
     ap.add_argument("--out", default="", help="write {section: record} here")
-    ap.add_argument("--probe-timeout", type=float, default=90.0)
+    ap.add_argument("--probe-timeout", type=float, default=None,
+                    help="probe subprocess deadline in seconds (default: "
+                         "KFT_BENCH_PROBE_TIMEOUT_S, else 90)")
     ap.add_argument("--job-timeout", type=float, default=1800.0)
     ap.add_argument("--retries", type=int, default=3)
     ap.add_argument("--interval", type=float, default=120.0,

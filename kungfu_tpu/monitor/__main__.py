@@ -24,6 +24,14 @@ Two modes:
                   (slo_cleared), /history carries the p99 series that drove
                   it, and the launcher exits with the SLO exit code.
 
+  --compile-drill program-observatory drill (the scripts/check.sh stage):
+                  seeded shape churn (testing.shape_churn) must journal
+                  program_compiled + recompile_storm and trip the shipped
+                  rate:recompile_storm SLO rule under -slo-exit-code, while
+                  a clean in-process serving engine must end mixed traffic
+                  with exactly its declared signature budget and a compile
+                  count that is constant after warmup (monitor/programs.py).
+
   --smoke         end-to-end telemetry smoke (the scripts/check.sh stage):
                   launches a 2-process CPU job under `kungfu-run -telemetry`
                   (with an optional chaos plan), polls the fleet endpoint
@@ -421,6 +429,176 @@ def run_slo_drill(np_: int = 2, timeout_s: float = 240.0) -> int:
     return 0
 
 
+# -- compile drill ---------------------------------------------------------------------
+
+
+def run_compile_drill(timeout_s: float = 240.0) -> int:
+    """Program-observatory drill, two halves (docs/observability.md):
+
+    STORM — a 1-rank fleet runs testing.shape_churn (a tracked jit fed a
+    new shape every few calls) under `-telemetry -slo-exit-code` with the
+    SHIPPED rules: the registry must journal program_compiled per
+    signature and recompile_storm when the churn crosses the window
+    threshold, the fleet /programs endpoint must show the program, and
+    the rate:recompile_storm rule must drive the launcher to
+    SLO_EXIT_CODE even though the worker itself exits 0.
+
+    CLEAN — an in-process ServingEngine under mixed prefill/decode
+    traffic must end with exactly the promised signatures (decode 1,
+    prefill <= bucket count), an empty budget report, zero storms, and a
+    compile count that stays CONSTANT when the same traffic repeats —
+    the PR-14 radix-cache regression, now asserted by the registry
+    instead of a proxy.
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from .slo import SLO_EXIT_CODE
+
+    failures: List[str] = []
+
+    # --- storm half (subprocess fleet) ---
+    telem = tempfile.mkdtemp(prefix="kft-compile-drill-")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    for k in ("XLA_FLAGS", "KFT_SLO_FILE", "KFT_SIG_BUDGET", "KFT_PROGRAMS",
+              "KFT_FAULT_PLAN"):
+        env.pop(k, None)
+    env["KFT_JOURNAL_DIR"] = telem
+    env["KFT_TRACE_DUMP_DIR"] = telem
+    env["KFT_TS_INTERVAL_S"] = "0.5"
+    shapes = 8
+    cmd = [
+        sys.executable, "-m", "kungfu_tpu.run", "-w", "-telemetry",
+        "-slo-exit-code", "-np", "1", "-platform", "cpu", "-port", "0",
+        "-timeout", str(int(timeout_s)),
+        "--", sys.executable, "-m", "kungfu_tpu.testing.shape_churn",
+        "--shapes", str(shapes),
+    ]
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True, bufsize=1)
+    lines: List[str] = []
+    url_box: Dict[str, str] = {}
+
+    def pump():
+        assert proc.stdout is not None
+        for line in proc.stdout:
+            lines.append(line)
+            if line.startswith("TELEMETRY_URL:"):
+                url_box["url"] = line.split(":", 1)[1].strip()
+
+    threading.Thread(target=pump, daemon=True).start()
+
+    saw_programs = False
+    deadline = time.monotonic() + timeout_s + 30
+    while proc.poll() is None and time.monotonic() < deadline:
+        url = url_box.get("url")
+        if url and not saw_programs:
+            try:
+                rep = json.loads(_http_get(f"{url}/programs", timeout=5))
+            except (OSError, ValueError):
+                rep = None
+            ranks = (rep or {}).get("ranks") or {}
+            if any("churn.step" in (r.get("programs") or {})
+                   for r in ranks.values()):
+                saw_programs = True
+        time.sleep(0.3)
+    try:
+        rc = proc.wait(timeout=60)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        rc = -9
+
+    if not saw_programs:
+        failures.append("fleet /programs never showed the churn.step registry")
+    if rc != SLO_EXIT_CODE:
+        failures.append(f"launcher exited {rc}, want SLO exit code "
+                        f"{SLO_EXIT_CODE}: the shipped recompile_storm rule "
+                        "should have tripped -slo-exit-code")
+    from .journal import merge_journals
+
+    events = merge_journals(
+        sorted(glob.glob(os.path.join(telem, "journal-*.jsonl"))))
+    compiled = [e for e in events if e.get("event") == "program_compiled"
+                and e.get("program") == "churn.step"]
+    storms = [e for e in events if e.get("event") == "recompile_storm"
+              and e.get("program") == "churn.step"]
+    breaches = [e for e in events if e.get("event") == "slo_breach"
+                and e.get("rule") == "recompile_storm"]
+    if len(compiled) < shapes:
+        failures.append(f"journal has {len(compiled)} program_compiled "
+                        f"events for churn.step, want >= {shapes}")
+    if not storms:
+        failures.append("no recompile_storm journal event despite seeded "
+                        "shape churn")
+    if not breaches:
+        failures.append("no slo_breach journal event for the shipped "
+                        "recompile_storm rule")
+    if failures:
+        print("COMPILE DRILL FAILED (storm half): " + "; ".join(failures),
+              file=sys.stderr)
+        print("--- launcher output tail ---\n" + "".join(lines[-60:]),
+              file=sys.stderr)
+        return 1
+    print(f"compile drill: storm half OK — {len(compiled)} compiles, "
+          f"{len(storms)} storm(s) journaled, shipped rule tripped exit "
+          f"{rc} (artifacts in {telem})")
+
+    # --- clean half (in-process serving engine) ---
+    import jax
+    import jax.numpy as jnp
+    import flax.linen as nn
+
+    from ..models.transformer import TransformerConfig, TransformerLM
+    from ..serving import Request, ServingEngine
+    from . import programs as P
+
+    P._reset_for_tests()
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_layers=2, n_heads=4,
+                            d_ff=64, max_len=48, rope=True, n_kv_heads=2,
+                            attention="full", dtype=jnp.float32)
+    model = TransformerLM(cfg)
+    params = nn.meta.unbox(
+        model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))["params"])
+    eng = ServingEngine(cfg, params, slots=2, prefill_buckets=(8, 16))
+
+    def wave():
+        # mixed traffic: prompts straddling both prefill buckets
+        for n in (2, 5, 7, 9, 12, 14, 3, 10):
+            eng.submit(Request(prompt=tuple(range(1, n + 1)), max_new_tokens=4))
+        eng.run_until_idle()
+
+    wave()
+    reg = P.global_registry()
+    warm = reg.compiles_total()
+    wave()  # same traffic again: the radix cache + buckets must re-use every program
+    over = reg.check_budgets()
+    rep = reg.report()
+    storms_total = sum(p.get("storms", 0)
+                       for p in (rep.get("programs") or {}).values())
+    if over:
+        failures.append(f"signature budget exceeded on a clean fleet: {over}")
+    if reg.signatures("serve.decode") != 1:
+        failures.append(f"decode has {reg.signatures('serve.decode')} "
+                        "signatures, promised exactly 1")
+    if not (1 <= reg.signatures("serve.prefill") <= 2):
+        failures.append(f"prefill has {reg.signatures('serve.prefill')} "
+                        "signatures, want 1..2 (one per exercised bucket)")
+    if storms_total:
+        failures.append(f"{storms_total} recompile_storm(s) on clean traffic")
+    if reg.compiles_total() != warm:
+        failures.append(f"compile count moved after warmup: {warm} -> "
+                        f"{reg.compiles_total()} (a program re-traced on "
+                        "repeat traffic)")
+    if failures:
+        print("COMPILE DRILL FAILED (clean half): " + "; ".join(failures),
+              file=sys.stderr)
+        return 1
+    print(f"COMPILE DRILL OK: storm journaled + SLO exit {rc}; clean "
+          f"serving held its budget ({warm} compiles: decode 1, prefill "
+          f"{reg.signatures('serve.prefill')}, verify "
+          f"{reg.signatures('serve.verify')}; constant after warmup)")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="kungfu_tpu.monitor")
     ap.add_argument("--merge", metavar="DIR", default="",
@@ -434,6 +612,11 @@ def main(argv=None) -> int:
                     help="run the 2-rank SLO drill: chaos slow@ must drive "
                          "a sustained slo_breach that clears after the "
                          "window, with a nonzero -slo-exit-code exit")
+    ap.add_argument("--compile-drill", action="store_true",
+                    help="run the program-observatory drill: seeded shape "
+                         "churn must journal recompile_storm and trip the "
+                         "shipped SLO rule; a clean serving engine must "
+                         "hold its declared signature budget")
     ap.add_argument("--np", type=int, default=2)
     # the slow window holds BOTH ranks alive for seconds of real training
     # (fake steps run sub-ms on CPU) so the mid-run fleet scrape provably
@@ -452,7 +635,10 @@ def main(argv=None) -> int:
         return run_smoke(args.np, args.plan, args.total_samples, args.timeout)
     if args.slo_drill:
         return run_slo_drill(args.np, args.timeout)
-    ap.error("pick a mode: --merge DIR, --smoke or --slo-drill")
+    if args.compile_drill:
+        return run_compile_drill(args.timeout)
+    ap.error("pick a mode: --merge DIR, --smoke, --slo-drill or "
+             "--compile-drill")
     return 2
 
 

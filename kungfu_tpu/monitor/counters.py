@@ -139,6 +139,8 @@ METRIC_HELP: Dict[str, str] = {
     "kungfu_events_total": "Lifecycle event counts by event kind.",
     "kungfu_gauge": "Last observed value of a named gauge.",
     "step_latency_ms": "Per-step wall latency histogram (ms).",
+    "compile_ms":
+        "XLA compile-time histogram (ms; op= labels tracked programs).",
     "collective_latency_ms": "Per-collective wall latency histogram (ms).",
     "collective_overlap":
         "Bucketed gradient-sync dispatch-to-ready latency histogram (ms).",

@@ -33,6 +33,11 @@ single endpoint over the whole job:
              `?series=<prefix>` to filter.
   /slo       the SLO rule engine's evaluated state (monitor.slo): per-rule
              breached/no_data, active breaches, lifetime breach_total.
+  /programs  every rank's compiled-program registry (monitor.programs):
+             signatures, budgets, storms per rank.
+  /profile   on-demand fleet profiling: `?secs=N` fans the workers'
+             jax.profiler capture out in parallel under its own deadline
+             (a capture blocks for N seconds by design).
 
 Scrapes fan out in PARALLEL with a per-target timeout, so one wedged worker
 costs one timeout — not a timeout per wedged rank serialized — and can never
@@ -311,6 +316,16 @@ class FleetAggregator:
                     elif path == "/slo":
                         body = json.dumps(outer.slo_report()).encode()
                         ctype = "application/json"
+                    elif path == "/programs":
+                        body = json.dumps(outer.programs_report()).encode()
+                        ctype = "application/json"
+                    elif path == "/profile":
+                        try:
+                            secs = float((query.get("secs") or ["2"])[0])
+                        except ValueError:
+                            secs = 2.0
+                        body = json.dumps(outer.profile_fleet(secs)).encode()
+                        ctype = "application/json"
                     else:
                         self.send_response(404)
                         self.end_headers()
@@ -525,6 +540,56 @@ class FleetAggregator:
         snap["interval_s"] = self._sampler.interval_s or sample_interval_s()
         snap["ticks"] = self._sampler.ticks
         return snap
+
+    # -- program observatory ----------------------------------------------------------
+
+    def programs_report(self) -> Dict[str, Any]:
+        """Every rank's compiled-program registry (/programs) merged into
+        one per-rank view — which rank blew its signature budget, which is
+        storming."""
+        bodies, errors = self.scrape("/programs")
+        ranks: Dict[str, Any] = {}
+        for rank, text in bodies.items():
+            try:
+                ranks[str(rank)] = json.loads(text)
+            except ValueError:
+                errors[rank] = "invalid programs JSON"
+        return {"ranks": ranks,
+                "errors": {str(r): e for r, e in errors.items()}}
+
+    def _fetch_slow(self, url: str, timeout_s: float) -> str:
+        with urllib.request.urlopen(url, timeout=timeout_s) as r:
+            return r.read().decode()
+
+    def profile_fleet(self, secs: float) -> Dict[str, Any]:
+        """Fan /profile?secs=N out to every rank concurrently and collect
+        each capture's result JSON.  Uses its own deadline — a capture
+        legitimately blocks for `secs`, which the ordinary scrape timeout
+        would cut off mid-profile."""
+        try:
+            secs = min(max(float(secs), 0.05), 120.0)
+        except (TypeError, ValueError):
+            secs = 2.0
+        # trace SERIALIZATION dominates short captures (jax.profiler's
+        # stop_trace writes the whole protobuf dump, ~10-20 s even for a
+        # 0.3 s window), so the deadline budgets a flat dump allowance on
+        # top of the capture itself
+        per_target = secs + self.timeout_s + 30.0
+        futs = [(rank, self._pool.submit(
+                    self._fetch_slow, f"{base}/profile?secs={secs:g}",
+                    per_target))
+                for rank, base in self.targets_fn()]
+        out: Dict[str, Any] = {"secs": secs, "ranks": {}, "errors": {}}
+        deadline = time.monotonic() + per_target + 0.5
+        for rank, fut in futs:
+            try:
+                out["ranks"][str(rank)] = json.loads(fut.result(
+                    timeout=max(0.05, deadline - time.monotonic())))
+            except Exception as e:  # noqa: BLE001 - per-rank capture failures isolate
+                self._scrape_errors += 1
+                out["errors"][str(rank)] = str(e) or type(e).__name__
+                fut.cancel()
+        return out
 
     def slo_report(self) -> Dict[str, Any]:
         """One SLO evaluation + report — `/slo`.  Evaluation is per-sample

@@ -141,6 +141,11 @@ EVENT_KINDS: Dict[str, tuple] = {
     "chaos_slow": ("ms",),
     "chaos_slow_serve": ("phase",),
     "chaos_corrupt_ckpt": ("ckpt_step",),
+    # program observatory (monitor/programs.py)
+    "program_compiled": ("program", "digest", "compile_ms"),
+    "recompile_storm": ("program", "recompiles", "window_s"),
+    "sig_budget_exceeded": ("program", "budget", "signatures"),
+    "hbm_footprint": ("program", "predicted_bytes", "measured_bytes", "rel_err"),
     # benchmark harness (benchmarks/runner.py)
     "bench_probe_failed": ("section",),
     "bench_probe_recovered": ("section",),

@@ -11,6 +11,11 @@ buffer (utils.trace) as Chrome-trace JSON, the per-rank feed the
 launcher-side fleet aggregator (monitor.fleet) merges into one timeline —
 and /history: this worker's self-sampled time-series store
 (monitor.timeseries; `?series=<prefix>` filters by name prefix).
+
+The program observatory (monitor.programs) adds /programs — the compiled-
+program registry report (signatures, budgets, storms) — and
+/profile?secs=N: an on-demand jax.profiler capture dumped atomically to
+KFT_TRACE_DUMP_DIR (no-op JSON when the profiler can't run).
 """
 from __future__ import annotations
 
@@ -80,6 +85,22 @@ class MonitorServer:
                     snap["interval_s"] = TS.sample_interval_s()
                     body = json.dumps(snap).encode()
                     ctype = "application/json"
+                elif path == "/programs":
+                    from . import programs as P
+
+                    body = json.dumps(P.global_registry().report()).encode()
+                    ctype = "application/json"
+                elif path == "/profile":
+                    # blocks this handler thread for `secs` — fine under
+                    # ThreadingHTTPServer, the other endpoints keep serving
+                    from . import programs as P
+
+                    try:
+                        secs = float((query.get("secs") or ["2"])[0])
+                    except ValueError:
+                        secs = 2.0
+                    body = json.dumps(P.capture_profile(secs)).encode()
+                    ctype = "application/json"
                 else:
                     self.send_response(404)
                     self.end_headers()
@@ -127,7 +148,9 @@ def maybe_start_monitor(worker_port: int, host: str = "0.0.0.0") -> Optional[Mon
     re-binds this endpoint."""
     if not enabled():
         return None
+    from .programs import maybe_install
     from .timeseries import maybe_start_worker_sampler
 
+    maybe_install()  # compile listener + memory census (KFT_PROGRAMS gate)
     maybe_start_worker_sampler()
     return MonitorServer(host=host, port=monitor_port(worker_port)).start()

@@ -156,6 +156,12 @@ DEFAULT_RULES: List[SLORule] = [
             description="allreduce scaling efficiency vs ideal stays above "
                         "the floor — a scaling regression fails the bench, "
                         "not just single-chip speed"),
+    SLORule("recompile_storm", "rate:recompile_storm", "<=", 0.0,
+            sustain_s=0.0, severity="page",
+            description="no recompile storms: a tracked program burning "
+                        "through new XLA signatures re-pays full compiles "
+                        "on its hot path (monitor/programs.py; the rule "
+                        "stays no_data on fleets that never storm)"),
 ]
 
 

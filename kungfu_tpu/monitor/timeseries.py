@@ -326,6 +326,14 @@ class CountersSampler:
 
     def sample_once(self, now: Optional[float] = None) -> None:
         t = self.clock() if now is None else float(now)
+        # tick callbacks run BEFORE the snapshot so gauges they publish
+        # (the program observatory's live-array/HBM census) land in this
+        # very sample, not one interval late
+        for cb in list(_tick_callbacks):
+            try:
+                cb()
+            except Exception as e:  # noqa: BLE001 - a census failure must not stop sampling
+                log.debug("tick callback failed: %s", e)
         snap = self.counters.snapshot_json()
         epoch = snap.get("epoch", 0)
         if self._epoch is not None and epoch != self._epoch:
@@ -374,7 +382,18 @@ class CountersSampler:
 
 _worker_store: Optional[TimeSeriesStore] = None
 _worker_thread: Optional[threading.Thread] = None
+_worker_stop = threading.Event()
 _worker_lock = threading.Lock()
+#: callbacks every CountersSampler runs at the top of each tick — the
+#: hook the program observatory's memory census rides (no extra thread)
+_tick_callbacks: List[Callable[[], None]] = []
+
+
+def register_tick_callback(fn: Callable[[], None]) -> None:
+    """Idempotently add a per-tick callback (see sample_once)."""
+    with _worker_lock:
+        if fn not in _tick_callbacks:
+            _tick_callbacks.append(fn)
 
 
 def worker_store() -> TimeSeriesStore:
@@ -427,10 +446,10 @@ def maybe_start_worker_sampler() -> Optional[TimeSeriesStore]:
         from .counters import global_counters
 
         sampler = CountersSampler(global_counters(), store)
+        stop = _worker_stop
 
         def loop() -> None:  # pragma: no cover - timing loop; ticks are tested
-            while True:
-                time.sleep(interval)
+            while not stop.wait(interval):
                 try:
                     sampler.sample_once()
                 except Exception as e:  # noqa: BLE001 - sampling never kills training
@@ -439,18 +458,35 @@ def maybe_start_worker_sampler() -> Optional[TimeSeriesStore]:
         _worker_thread = threading.Thread(target=loop, daemon=True,
                                           name="kft-ts-sampler")
         _worker_thread.start()
-        if os.environ.get("KFT_TRACE_DUMP_DIR"):
-            import atexit
+        import atexit
 
+        # join the sampler BEFORE interpreter finalization: a tick callback
+        # may be inside the XLA client (the program observatory's live-array
+        # census), and a daemon thread still in C++ when Py_Finalize tears
+        # the backend down aborts the process ("terminate called without an
+        # active exception")
+        atexit.register(_stop_worker_sampler)
+        if os.environ.get("KFT_TRACE_DUMP_DIR"):
             atexit.register(dump_worker_store)
     return store
 
 
+def _stop_worker_sampler() -> None:
+    """Signal the sampler loop and join it (atexit; idempotent)."""
+    t = _worker_thread
+    _worker_stop.set()
+    if t is not None and t.is_alive():
+        t.join(timeout=sample_interval_s() + 5.0)
+
+
 def _reset_for_tests() -> None:
-    global _worker_store, _worker_thread
+    global _worker_store, _worker_thread, _worker_stop
     with _worker_lock:
+        _worker_stop.set()  # the old daemon drains at its next wait()
+        _worker_stop = threading.Event()
         _worker_store = None
         _worker_thread = None  # the old daemon keeps its old store; harmless
+        del _tick_callbacks[:]
 
 
 # -- fleet-side sampler ----------------------------------------------------------------

@@ -239,9 +239,17 @@ class ServingEngine:
             cache2 = jax.tree_util.tree_map_with_path(roll, st["cache"])
             return g, n_acc, cache2
 
-        self._prefill = _prefill
-        self._decode = _decode
-        self._verify = _verify_accept
+        # the program observatory holds the engine to its own compile
+        # promises: one prefill program per bucket, ONE decode signature,
+        # ONE speculative-verify signature.  A blown budget journals
+        # sig_budget_exceeded instead of raising — the registry is a
+        # witness, not a gate.  Re-wrapping per engine resets each promise.
+        from ..monitor.programs import track
+
+        self._prefill = track("serve.prefill", _prefill,
+                              budget=len(self.buckets))
+        self._decode = track("serve.decode", _decode, budget=1)
+        self._verify = track("serve.verify", _verify_accept, budget=1)
 
     # -- submission ----------------------------------------------------------------
 

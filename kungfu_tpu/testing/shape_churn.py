@@ -1,0 +1,67 @@
+"""Seeded shape-churn worker — the compile drill's storm half.
+
+Jits one tiny tracked function and feeds it a NEW input shape every few
+calls, the canonical recompile bug (unpadded dynamic batch, a bucket
+boundary that moves every request, a python int leaking into a shape).
+Under `kungfu-run -telemetry` the program observatory's storm detector
+(monitor/programs.py) must journal `recompile_storm`, the fleet sampler
+must surface `rate:recompile_storm`, and the shipped SLO rule must trip
+`-slo-exit-code` — that end-to-end path is what
+`python -m kungfu_tpu.monitor --compile-drill` asserts.
+
+The worker itself exits 0: the drill's failure signal is the SLO exit
+code, not the workload's.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser("shape-churn")
+    ap.add_argument("--shapes", type=int, default=8,
+                    help="distinct input shapes to burn through")
+    ap.add_argument("--calls-per-shape", type=int, default=3)
+    ap.add_argument("--sleep-s", type=float, default=0.15,
+                    help="pause between shapes so the churn spans several "
+                         "sampler ticks")
+    ap.add_argument("--linger-s", type=float, default=3.0,
+                    help="stay scrapeable after the churn so the fleet "
+                         "sampler sees the storm counters")
+    args = ap.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..monitor.programs import global_registry, track
+    from ..peer import default_peer, finalize_default_peer
+
+    default_peer()  # monitor endpoint + sampler + journal context from env
+
+    def step(x):
+        return jnp.sum(x * 2.0 + 1.0)
+
+    # generous budget: the drill is about the STORM detector, not the
+    # budget assertion — churning shapes is the declared (bad) behaviour
+    churn = track("churn.step", jax.jit(step), budget=args.shapes)
+
+    total = 0.0
+    for i in range(args.shapes):
+        x = jnp.ones((4, 8 + i), jnp.float32)
+        for _ in range(args.calls_per_shape):
+            total += float(churn(x))
+        time.sleep(args.sleep_s)
+
+    reg = global_registry()
+    print(f"RESULT: shape-churn shapes={args.shapes} "
+          f"signatures={reg.signatures('churn.step')} "
+          f"compiles={reg.compiles_total()} total={total:.1f}", flush=True)
+    time.sleep(args.linger_s)
+    finalize_default_peer()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -238,7 +238,17 @@ class DataParallelTrainer:
             out_specs=(state_spec, state_spec, state_spec, P()),
             check_vma=False,  # monitor/gossip states mix varying+invariant leaves
         )
-        return jax.jit(fn, donate_argnums=(0, 1, 2) if donate else ())
+        # observatory: the elastic train step promises ONE compiled
+        # signature per incarnation — every rebuild re-declares the budget,
+        # so a resize's legitimate recompile starts a fresh count while a
+        # mid-incarnation shape change journals sig_budget_exceeded
+        from .monitor.programs import track
+
+        return track(
+            "train_step",
+            jax.jit(fn, donate_argnums=(0, 1, 2) if donate else ()),
+            budget=1,
+        )
 
     def _build_multi_step(self, n: int) -> Callable:
         """One compiled program running `n` steps (lax.scan) on a fixed batch.

@@ -258,6 +258,17 @@ def measure_step(shape: ShapeKey, cfg: StepConfig, steps: int = 4,
 
     state, m = trainer.train_steps(state, batch, n=steps)
     sync_result(m["loss"])  # compile + warm
+    # warm state is the honest census moment (params + opt state + batch +
+    # activations' workspace all live): journal the footprint model's
+    # prediction against the measured bytes so the gate's error stays
+    # visible (hbm_footprint, monitor/programs.py)
+    from ..monitor.programs import journal_footprint, programs_enabled
+
+    if programs_enabled():
+        from .footprint import step_hbm_bytes
+
+        journal_footprint(f"train_step[{shape.digest()}]",
+                          step_hbm_bytes(cfg, shape)["total"])
     times = []
     for _ in range(max(reps, 1)):
         t0 = time.perf_counter()

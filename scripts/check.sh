@@ -182,6 +182,17 @@ echo "== SLO drill: chaos slow@ drives a sustained breach that clears (CPU) =="
 # (docs/observability.md)
 JAX_PLATFORMS=cpu python -m kungfu_tpu.monitor --slo-drill --timeout 240
 
+echo "== compile drill: recompile storm trips the shipped SLO rule; clean serving holds its budget (CPU) =="
+# program observatory end to end: a 1-rank fleet running seeded shape
+# churn must journal program_compiled per signature + recompile_storm,
+# surface the registry on the fleet /programs endpoint, and trip the
+# SHIPPED rate:recompile_storm rule under -slo-exit-code; then a clean
+# in-process serving engine under mixed prefill/decode traffic must end
+# with exactly its declared signatures (decode 1) and a compile count
+# that stays constant when the traffic repeats
+# (docs/observability.md "Program observatory")
+JAX_PLATFORMS=cpu python -m kungfu_tpu.monitor --compile-drill --timeout 240
+
 echo "== telemetry smoke: fleet aggregation + merged timeline (CPU) =="
 # 2-process run under -telemetry: fleet /metrics must merge both ranks
 # with consistent counter sums, /timeline must parse as valid Chrome trace

@@ -1,0 +1,311 @@
+"""Program observatory (kungfu_tpu.monitor.programs,
+docs/observability.md "Program observatory").
+
+Covers: signature digests as a jit-cache-key proxy, the registry's
+storm detector (fire / latch / re-arm on an injected clock), signature
+budgets incl. the KFT_SIG_BUDGET override and redeclare-resets
+semantics, track() over a real jit fn (compile count constant after
+warmup — the PR-14 regression, now a registry invariant), the
+KFT_PROGRAMS=0 no-hook fast path, the live-array census, footprint
+honesty journaling, and the on-demand profile capture's atomic dump +
+no-op fallback.
+"""
+import json
+import os
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kungfu_tpu.monitor import programs as P
+from kungfu_tpu.monitor.programs import (
+    ProgramRegistry,
+    capture_profile,
+    journal_footprint,
+    measure_live_bytes,
+    signature_digest,
+    track,
+)
+
+pytestmark = pytest.mark.programs
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry(monkeypatch):
+    monkeypatch.delenv("KFT_PROGRAMS", raising=False)  # observatory on
+    monkeypatch.delenv("KFT_SIG_BUDGET", raising=False)
+    P._reset_for_tests()
+    yield
+    P._reset_for_tests()
+
+
+# -- digests ---------------------------------------------------------------------------
+
+
+class TestSignatureDigest:
+    def test_same_avals_same_digest(self):
+        a = jnp.zeros((4, 8), jnp.float32)
+        b = jnp.ones((4, 8), jnp.float32)  # values differ, avals don't
+        assert signature_digest((a,), {}) == signature_digest((b,), {})
+
+    def test_shape_dtype_and_structure_all_distinguish(self):
+        a = jnp.zeros((4, 8), jnp.float32)
+        seen = {
+            signature_digest((a,), {}),
+            signature_digest((jnp.zeros((4, 9), jnp.float32),), {}),
+            signature_digest((a.astype(jnp.bfloat16),), {}),
+            signature_digest(((a, a),), {}),          # structural change
+            signature_digest((a,), {"k": a}),
+        }
+        assert len(seen) == 5
+
+    def test_python_leaves_digest_by_type(self):
+        assert signature_digest((1,), {}) == signature_digest((2,), {})
+        assert signature_digest((1,), {}) != signature_digest((1.0,), {})
+
+
+# -- registry / storm detector / budgets -----------------------------------------------
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class TestStormDetector:
+    def _reg(self, **kw):
+        clk = _Clock()
+        return ProgramRegistry(storm_window_s=30.0, storm_min=4, clock=clk,
+                               **kw), clk
+
+    def test_first_signature_is_not_a_recompile(self):
+        reg, _ = self._reg()
+        for name in ("a", "b", "c", "d", "e"):
+            reg.note_compiled(name, "d0", 1.0)
+        assert reg.storms_total == 0
+
+    def test_burst_fires_once_then_latches(self):
+        reg, clk = self._reg()
+        for i in range(8):  # 7 recompiles in 0.7s — one storm, not four
+            clk.t = i * 0.1
+            reg.note_compiled("hot", f"d{i}", 1.0)
+        assert reg.storms_total == 1
+        assert reg.report()["programs"]["hot"]["storms"] == 1
+
+    def test_slow_churn_under_window_never_fires(self):
+        reg, clk = self._reg()
+        for i in range(8):  # one new digest per window: steady, not a storm
+            clk.t = i * 31.0
+            reg.note_compiled("warm", f"d{i}", 1.0)
+        assert reg.storms_total == 0
+
+    def test_rearms_after_burst_drains(self):
+        reg, clk = self._reg()
+        for i in range(6):
+            clk.t = i * 0.1
+            reg.note_compiled("hot", f"a{i}", 1.0)
+        assert reg.storms_total == 1
+        clk.t = 100.0  # window empties, then one quiet recompile re-arms
+        reg.note_compiled("hot", "quiet", 1.0)
+        for i in range(4):
+            clk.t = 100.5 + i * 0.1
+            reg.note_compiled("hot", f"b{i}", 1.0)
+        assert reg.storms_total == 2
+
+    def test_storm_journaled(self, tmp_path, monkeypatch):
+        from kungfu_tpu.monitor import journal as J
+
+        monkeypatch.setenv(J.JOURNAL_FILE_ENV, str(tmp_path / "j.jsonl"))
+        J._reset_for_tests()
+        try:
+            reg, clk = self._reg()
+            for i in range(5):
+                clk.t = i * 0.1
+                reg.note_compiled("hot", f"d{i}", 2.5)
+            events = J.read_journal(str(tmp_path / "j.jsonl"))
+        finally:
+            J._reset_for_tests()
+        kinds = [e["event"] for e in events]
+        assert kinds.count("program_compiled") == 5
+        storm = next(e for e in events if e["event"] == "recompile_storm")
+        assert storm["program"] == "hot" and storm["recompiles"] >= 4
+        assert storm["window_s"] == 30.0
+
+
+class TestBudgets:
+    def test_overrun_reported_not_raised(self):
+        reg = ProgramRegistry(clock=_Clock())
+        reg.declare_budget("decode", 1)
+        reg.note_compiled("decode", "d0", 1.0)
+        assert reg.check_budgets() == []
+        reg.note_compiled("decode", "d1", 1.0)
+        (msg,) = reg.check_budgets()
+        assert "decode" in msg and "budget 1" in msg
+        assert reg.budget_violations == 1
+
+    def test_redeclare_resets_the_promise(self):
+        reg = ProgramRegistry(clock=_Clock())
+        reg.declare_budget("step", 1)
+        reg.note_compiled("step", "d0", 1.0)
+        reg.note_compiled("step", "d1", 1.0)
+        assert reg.check_budgets()
+        reg.declare_budget("step", 1)  # elastic rebuild: fresh promise
+        assert reg.check_budgets() == []
+        assert reg.signatures("step") == 0
+
+    def test_env_overrides_declared_budget(self, monkeypatch):
+        monkeypatch.setenv(P.SIG_BUDGET_ENV, "step=5, bad==x, junk")
+        reg = ProgramRegistry(clock=_Clock())
+        reg.declare_budget("step", 1)
+        for i in range(3):
+            reg.note_compiled("step", f"d{i}", 1.0)
+        assert reg.check_budgets() == []  # env said 5, not 1
+
+    def test_budget_overrun_journaled(self, tmp_path, monkeypatch):
+        from kungfu_tpu.monitor import journal as J
+
+        monkeypatch.setenv(J.JOURNAL_FILE_ENV, str(tmp_path / "j.jsonl"))
+        J._reset_for_tests()
+        try:
+            reg = ProgramRegistry(clock=_Clock())
+            reg.declare_budget("decode", 1)
+            reg.note_compiled("decode", "d0", 1.0)
+            reg.note_compiled("decode", "d1", 1.0)
+            events = J.read_journal(str(tmp_path / "j.jsonl"))
+        finally:
+            J._reset_for_tests()
+        over = next(e for e in events if e["event"] == "sig_budget_exceeded")
+        assert over["program"] == "decode"
+        assert over["budget"] == 1 and over["signatures"] == 2
+
+
+# -- track() ---------------------------------------------------------------------------
+
+
+class TestTrack:
+    def test_disabled_returns_fn_unchanged(self, monkeypatch):
+        monkeypatch.setenv(P.PROGRAMS_ENV, "0")
+        fn = jax.jit(lambda x: x + 1)
+        assert track("t", fn) is fn
+
+    def test_compile_count_constant_after_warmup(self):
+        reg = ProgramRegistry(clock=_Clock())
+        calls = {"n": 0}
+
+        @jax.jit
+        def step(x):
+            calls["n"] += 1  # trace counter: fires once per compilation
+            return jnp.sum(x * 2.0)
+
+        f = track("step", step, budget=2, registry=reg)
+        x8, x16 = jnp.ones((8,)), jnp.ones((16,))
+        for _ in range(3):
+            f(x8)
+            f(x16)
+        assert reg.signatures("step") == 2
+        assert reg.compiles_total() == 2
+        assert calls["n"] == 2  # the registry agrees with jit's own cache
+        assert reg.check_budgets() == []
+        rec = reg.report()["programs"]["step"]
+        assert rec["calls"] == 6
+        assert all(r["compile_ms"] > 0.0 for r in rec["digests"].values())
+
+    def test_wrapper_preserves_identity_hooks(self):
+        fn = jax.jit(lambda x: x)
+        f = track("id", fn, registry=ProgramRegistry(clock=_Clock()))
+        assert f.__wrapped__ is fn
+        assert f._kft_program == "id"
+        assert f(jnp.ones(3)).shape == (3,)
+
+
+# -- census / footprint ----------------------------------------------------------------
+
+
+class TestCensus:
+    def test_live_arrays_counted(self):
+        keep = jnp.ones((128, 4), jnp.float32)
+        jax.block_until_ready(keep)
+        out = measure_live_bytes()
+        assert out["live_arrays"] >= 1.0
+        assert out["live_array_bytes"] >= keep.nbytes
+
+    def test_census_tick_publishes_gauges(self, monkeypatch):
+        monkeypatch.setenv("KFT_CONFIG_ENABLE_MONITORING", "1")
+        from kungfu_tpu.monitor.counters import global_counters
+
+        P._census_tick()
+        gauges = global_counters().gauges()
+        assert gauges.get("live_arrays", 0.0) >= 0.0
+        assert "live_array_bytes" in gauges
+
+    def test_footprint_rel_err(self, tmp_path, monkeypatch):
+        from kungfu_tpu.monitor import journal as J
+
+        monkeypatch.setenv(J.JOURNAL_FILE_ENV, str(tmp_path / "j.jsonl"))
+        J._reset_for_tests()
+        try:
+            rec = journal_footprint("step", 1000.0, measured_bytes=1200.0)
+            events = J.read_journal(str(tmp_path / "j.jsonl"))
+        finally:
+            J._reset_for_tests()
+        assert rec["rel_err"] == pytest.approx(0.2)
+        (e,) = [x for x in events if x["event"] == "hbm_footprint"]
+        assert e["predicted_bytes"] == 1000 and e["measured_bytes"] == 1200
+
+    def test_footprint_disabled_is_empty(self, monkeypatch):
+        monkeypatch.setenv(P.PROGRAMS_ENV, "0")
+        assert journal_footprint("step", 1000.0, measured_bytes=1.0) == {}
+
+
+# -- profile capture -------------------------------------------------------------------
+
+
+class TestCaptureProfile:
+    def test_capture_dumps_atomically(self, tmp_path):
+        out = capture_profile(0.01, out_dir=str(tmp_path))  # clamped to 0.05
+        if out.get("noop"):  # interpreter-only build: the fallback contract
+            assert out["ok"] is False and "error" in out
+            return
+        assert out["ok"] is True
+        assert os.path.isdir(out["path"])
+        assert os.path.basename(out["path"]).startswith("profile-")
+        # no half-written staging dirs survive
+        assert not [d for d in os.listdir(tmp_path)
+                    if d.startswith(".profile-tmp-")]
+        assert out["secs"] == 0.05
+
+    def test_capture_degrades_to_noop(self, tmp_path, monkeypatch):
+        import jax.profiler as jp
+
+        def boom(*a, **k):
+            raise RuntimeError("profiler busy")
+
+        monkeypatch.setattr(jp, "start_trace", boom)
+        out = capture_profile(0.05, out_dir=str(tmp_path))
+        assert out["ok"] is False and out["noop"] is True
+        assert "profiler busy" in out["error"]
+        assert json.dumps(out)  # endpoint contract: always JSON-serializable
+
+
+# -- compile watch ---------------------------------------------------------------------
+
+
+class TestCompileWatch:
+    def test_listener_filters_foreign_events(self):
+        before = P.compile_watch_state()
+        P._on_duration_event("/jax/core/something_else", 1.0)
+        assert P.compile_watch_state()["compiles"] == before["compiles"]
+        P._on_duration_event(P.BACKEND_COMPILE_EVENT, 0.25)
+        after = P.compile_watch_state()
+        assert after["compiles"] == before["compiles"] + 1
+        assert after["compile_ms"] == pytest.approx(
+            before["compile_ms"] + 250.0)
+
+    def test_maybe_install_is_idempotent(self):
+        first = P.maybe_install()
+        assert P.maybe_install() == first
+        assert P.compile_watch_state()["installed"] is True

@@ -1115,6 +1115,50 @@ class TestWeightedFairQueueProperty:
         assert requeued, "the seeded mix never exercised the requeue path"
 
 
+# -- program observatory regression ----------------------------------------------------
+
+
+class TestSignatureStability:
+    def test_radix_admissions_compile_count_constant_after_warmup(
+            self, model_and_params, monkeypatch):
+        """PR-14's recompile bug as a registry invariant: prompts of 8
+        DISTINCT lengths admitted through the radix prefix cache + bucket
+        padding must reuse the same compiled programs — after the warm
+        wave, repeating the exact traffic adds ZERO new signatures, decode
+        stays at its single promised program, and the engine's declared
+        budgets hold (kungfu_tpu.monitor.programs)."""
+        from kungfu_tpu.monitor import programs as P
+
+        cfg, _, params = model_and_params
+        monkeypatch.delenv("KFT_PROGRAMS", raising=False)  # observatory on
+        monkeypatch.delenv("KFT_SIG_BUDGET", raising=False)
+        P._reset_for_tests()
+        try:
+            eng = ServingEngine(cfg, params, slots=2, prefill_buckets=(8, 16))
+            base = tuple(range(1, 17))
+
+            def wave():
+                # shared prefixes of 8 distinct lengths straddling both
+                # buckets: radix hits vary the UNCACHED remainder per admit
+                pend = [eng.submit(Request(prompt=base[:n], max_new_tokens=3))
+                        for n in (2, 4, 6, 8, 10, 12, 14, 16)]
+                eng.run_until_idle()
+                assert all(p.result.status == "ok" for p in pend)
+
+            wave()
+            reg = P.global_registry()
+            warm = reg.compiles_total()
+            assert reg.signatures("serve.decode") == 1
+            assert 1 <= reg.signatures("serve.prefill") <= 2
+            wave()
+            assert reg.compiles_total() == warm
+            assert reg.check_budgets() == []
+            rep = reg.report()["programs"]
+            assert all(p["storms"] == 0 for p in rep.values())
+        finally:
+            P._reset_for_tests()
+
+
 # -- multi-process drill ---------------------------------------------------------------
 
 

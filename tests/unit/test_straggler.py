@@ -738,6 +738,42 @@ class TestBenchRunner:
         assert order == ["a", "b", "a"]  # b took its turn before a's retry
         assert out["a"]["measured_this_run"] and out["b"]["measured_this_run"]
 
+    def test_probe_timeout_env_resolution(self, monkeypatch):
+        from kungfu_tpu.benchmarks import runner as R
+
+        monkeypatch.delenv(R.PROBE_TIMEOUT_ENV, raising=False)
+        assert R.probe_timeout_s() == R.DEFAULT_PROBE_TIMEOUT_S
+        monkeypatch.setenv(R.PROBE_TIMEOUT_ENV, "12.5")
+        assert R.probe_timeout_s() == 12.5
+        monkeypatch.setenv(R.PROBE_TIMEOUT_ENV, "0.001")
+        assert R.probe_timeout_s() == 1.0  # floor: a 1ms deadline is a typo
+        monkeypatch.setenv(R.PROBE_TIMEOUT_ENV, "ninety")
+        assert R.probe_timeout_s() == R.DEFAULT_PROBE_TIMEOUT_S
+
+    def test_probe_timeout_kills_wedged_child_with_cause(self, monkeypatch):
+        """A wedged probe must come back as cause=timeout (not crash), with
+        the whole process group SIGKILLed before the deadline's grace runs
+        out — the BENCH r03-r05 wedge, now diagnosable from the json."""
+        from kungfu_tpu.benchmarks import runner as R
+
+        monkeypatch.setattr(R, "PROBE_SRC", "import time; time.sleep(600)")
+        t0 = time.monotonic()
+        diag = R.probe_backend_ex(timeout_s=1.0)
+        assert time.monotonic() - t0 < 15.0  # killed, not waited out
+        assert diag is not None
+        assert diag["cause"] == "timeout" and diag["exit"] == "timeout"
+        assert "timed out after 1s" in diag["reason"]
+
+    def test_probe_crash_cause_distinct_from_timeout(self, monkeypatch):
+        from kungfu_tpu.benchmarks import runner as R
+
+        monkeypatch.setattr(
+            R, "PROBE_SRC",
+            "import sys; print('boom', file=sys.stderr); sys.exit(3)")
+        diag = R.probe_backend_ex(timeout_s=30.0)
+        assert diag["cause"] == "crash" and diag["exit"] == 3
+        assert "boom" in diag["stderr"]
+
     def test_argv_section_reads_out_json(self, tmp_path):
         import sys
 

@@ -330,6 +330,52 @@ def test_tail_sampler_retention_invariants():
     assert len(rep["requests"]) <= 8  # reservoir bounded
 
 
+def test_tail_sampler_env_sized_reservoir_under_pressure(monkeypatch):
+    """The env-sized path under real eviction pressure: KFT_REQUESTS_KEEP=4
+    against 50 requests must keep the reservoir at 4, yet every slowest-N
+    timeline and every failover-touched one (including the FASTEST request
+    of the run) must survive the churn and stay reachable for late
+    span arrivals."""
+    from kungfu_tpu.monitor.requests import KEEP_ENV, TAIL_ENV, RequestMonitor
+
+    monkeypatch.setenv(KEEP_ENV, "4")
+    monkeypatch.setenv(TAIL_ENV, "3")
+    mon = RequestMonitor()
+    assert mon.keep == 4 and mon.tail_slowest == 3
+    for i in range(50):
+        # latencies climb 1.0..5.9; the two failover-touched requests are
+        # the FASTEST of the run — only the flagged tier can save them
+        flagged = i in (7, 23)
+        r, w = _request_traces(tid=f"t{i}", req_id=f"t{i}",
+                               requeues=1 if flagged else 0,
+                               latency=0.2 if flagged else 1.0 + i * 0.1)
+        mon.consume_chrome(1, w)
+        mon.consume_chrome("router", r)
+    rep = mon.report()
+    assert rep["completed_total"] == 50
+    assert len(rep["requests"]) == 4  # reservoir pinned at the env size
+    assert [t["req_id"] for t in rep["requests"]] == [
+        "t49", "t48", "t47", "t46"]  # newest first
+    assert [t["req_id"] for t in rep["tail"]["slowest"]] == [
+        "t49", "t48", "t47"]  # slowest-N survived 47 evictions
+    assert {t["req_id"] for t in rep["tail"]["flagged"]} == {"t7", "t23"}
+    # retained timelines still accept late arrivals: a straggler span for
+    # an evicted-from-reservoir but tail-retained request re-attributes
+    late = T.export_chrome_trace(
+        [_span("serve:kv_graft", 0.5, 0.05, "t23", "t23-late", "t23-rt")],
+        pid=998)
+    assert mon.consume_chrome(1, late) == 1
+    victim = next(t for t in mon.report()["tail"]["flagged"]
+                  if t["req_id"] == "t23")
+    assert "t23-late" in {s["span_id"] for s in victim["spans"]}
+    # a mid-pack unflagged request is truly gone from every surface
+    rep = mon.report()
+    everywhere = ({t["req_id"] for t in rep["requests"]}
+                  | {t["req_id"] for t in rep["tail"]["slowest"]}
+                  | {t["req_id"] for t in rep["tail"]["flagged"]})
+    assert "t20" not in everywhere
+
+
 def test_breach_window_retention():
     active = {"on": False}
     mon = _monitor(keep=4, tail_slowest=1,
