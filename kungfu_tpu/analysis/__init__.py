@@ -54,6 +54,7 @@ from .findings import (  # noqa: F401
     WARNING,
     RULE_AXIS,
     RULE_BARE_PUT,
+    RULE_CONFIG_SINGLE_URL,
     RULE_DEADLOCK,
     RULE_ENV_DRIFT,
     RULE_JOURNAL_KIND,
@@ -101,6 +102,7 @@ __all__ = [
     "RULE_SCHED_DATAFLOW", "RULE_SCHED_DEADLOCK", "RULE_SCHED_SLOT",
     "RULE_BARE_PUT", "RULE_JOURNAL_KIND", "RULE_LOCK_ORDER",
     "RULE_THREAD_LIFECYCLE", "RULE_WALL_CLOCK", "RULE_ENV_DRIFT",
+    "RULE_CONFIG_SINGLE_URL",
     "AnalysisError", "Finding", "errors", "format_findings",
     "Collective", "CondSite", "Extraction", "OutputLeak", "extract",
     "RULES", "RuleContext", "run_rules",

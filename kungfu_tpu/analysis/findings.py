@@ -40,9 +40,11 @@ RULE_LOCK_ORDER = "lock-order"
 RULE_THREAD_LIFECYCLE = "thread-lifecycle"
 RULE_WALL_CLOCK = "wall-clock-duration"
 RULE_ENV_DRIFT = "env-drift"
+RULE_CONFIG_SINGLE_URL = "config-single-url"
 
 HOST_RULES = (RULE_BARE_PUT, RULE_JOURNAL_KIND, RULE_LOCK_ORDER,
-              RULE_THREAD_LIFECYCLE, RULE_WALL_CLOCK, RULE_ENV_DRIFT)
+              RULE_THREAD_LIFECYCLE, RULE_WALL_CLOCK, RULE_ENV_DRIFT,
+              RULE_CONFIG_SINGLE_URL)
 
 #: every rule any kf-verify front can emit (CLI --suppress validates here)
 EVERY_RULE = ALL_RULES + SCHEDULE_RULES + HOST_RULES

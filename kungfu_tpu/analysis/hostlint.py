@@ -24,6 +24,13 @@ so kf-verify checks them statically over every module in `kungfu_tpu/`:
                        belong on the monotonic clock (the PR-4 NTP bug —
                        a stepped clock once produced negative heal MTTRs —
                        as a permanent rule).
+  config-single-url    config-plane traffic must go through the failover
+                       client (elastic/config_client.py): a raw urlopen /
+                       Request against a hard-coded `.../config` or KV-plane
+                       URL, or a `ConfigClient(<single literal URL>)`,
+                       pins one replica and silently loses writes when the
+                       leader moves.  The replication internals (server,
+                       client, ensemble supervisor) are exempt.
 
 Findings report through the shared Finding machinery; intentional
 exceptions live in ALLOWLIST below, keyed `rule:relpath:function`, each
@@ -40,6 +47,7 @@ from .findings import (
     ERROR,
     Finding,
     RULE_BARE_PUT,
+    RULE_CONFIG_SINGLE_URL,
     RULE_JOURNAL_KIND,
     RULE_LOCK_ORDER,
     RULE_THREAD_LIFECYCLE,
@@ -63,6 +71,11 @@ JOURNAL_CALLEES = {"journal_event", "journal", "_journal", "_transition"}
 #: files the scan skips entirely
 SKIP_PARTS = ("torch",)
 SKIP_FILES = ("testing/bad_host.py",)
+
+#: replication internals allowed to speak raw HTTP to config-plane URLs
+CONFIG_PLANE_INTERNALS = ("elastic/config_server.py",
+                          "elastic/config_client.py",
+                          "elastic/ensemble.py")
 
 
 def _fn(rule: str, rel: str, node: ast.AST, func: str, msg: str) -> Finding:
@@ -132,6 +145,21 @@ def _collect_scope(fnode) -> _FuncScope:
                 if len(keys) == len(node.value.keys):
                     scope.dict_keys.setdefault(name, []).extend(keys)
     return scope
+
+
+def _url_fragments(node: ast.AST) -> List[str]:
+    """The constant string pieces of a URL expression: a literal, the
+    constant parts of an f-string, or either side of `+` concatenation.
+    The join of the fragments is enough to recognise a hard-coded
+    config-plane endpoint without resolving any interpolated values."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, ast.JoinedStr):
+        return [v.value for v in node.values
+                if isinstance(v, ast.Constant) and isinstance(v.value, str)]
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        return _url_fragments(node.left) + _url_fragments(node.right)
+    return []
 
 
 def _lock_key(expr: ast.AST, rel: str, cls: str) -> Optional[str]:
@@ -307,6 +335,30 @@ def lint_source(source: str, rel: str,
                         RULE_THREAD_LIFECYCLE, rel, node, func,
                         "threading.Thread neither daemon=True nor joined "
                         "anywhere in this module — teardown can hang on it"))
+
+            # -- config-single-url -------------------------------------
+            internal = any(rel.endswith(p) for p in CONFIG_PLANE_INTERNALS)
+            if not internal and node.args \
+                    and not _suppressed(RULE_CONFIG_SINGLE_URL, rel, func,
+                                        allow):
+                lit = "".join(_url_fragments(node.args[0]))
+                if callee == "ConfigClient" \
+                        and "://" in lit and "," not in lit:
+                    out.append(_fn(
+                        RULE_CONFIG_SINGLE_URL, rel, node, func,
+                        "ConfigClient constructed on a hard-coded single "
+                        "URL — pass the replica list from KFT_CONFIG_URLS "
+                        "(comma-separated) so conditional PUTs survive a "
+                        "leader failover"))
+                elif callee in ("urlopen", "Request") \
+                        and ("/kv/" in lit or "/kv?" in lit
+                             or ("://" in lit and "/config" in lit)):
+                    out.append(_fn(
+                        RULE_CONFIG_SINGLE_URL, rel, node, func,
+                        "raw HTTP to a hard-coded config-plane URL "
+                        "bypasses the failover client — use ConfigClient "
+                        "(elastic/config_client.py), which follows leader "
+                        "redirects and rejects stale-epoch reads"))
 
         # -- wall-clock-duration ---------------------------------------
         if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub):

@@ -491,6 +491,17 @@ def main(argv=None) -> int:
                          "in-process slow@ sleep; auto = network when "
                          "root+netns are available, else the in-process "
                          "fallback (docs/fault_tolerance.md)")
+    ap.add_argument("--coordinator-drill", action="store_true",
+                    help="run the replicated-control-plane drill instead: "
+                         "healer/autoscaler/reconvene/KV traffic against a "
+                         "3-replica config ensemble through a leader "
+                         "SIGKILL and a leader partition (SIGSTOP) — "
+                         "asserts zero dropped requests, no lost/double-"
+                         "applied conditional PUT, bounded unavailability, "
+                         "journaled elections, and replica convergence "
+                         "(docs/fault_tolerance.md)")
+    ap.add_argument("--replicas", type=int, default=3,
+                    help="coordinator drill: ensemble size")
     ap.add_argument("--serve-drill", action="store_true",
                     help="run the serving drill instead: kill a serving "
                          "rank mid-stream, assert zero dropped requests + "
@@ -585,6 +596,31 @@ def main(argv=None) -> int:
               f"p50 fractions compute/data/wait = "
               f"{att.get('compute_frac_p50')}/{att.get('data_frac_p50')}/"
               f"{att.get('collective_wait_frac_p50')}")
+        return 0
+
+    if args.coordinator_drill:
+        from .controlplane import run_coordinator_drill
+
+        summary = run_coordinator_drill(replicas=args.replicas,
+                                        timeout_s=args.timeout)
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(summary, f, indent=2)
+        if not summary["ok"]:
+            print("COORDINATOR DRILL FAILED: "
+                  + "; ".join(summary["failures"]), file=sys.stderr)
+            return 1
+        print("COORDINATOR DRILL OK: "
+              f"{summary['replicas']} replicas through a leader kill + a "
+              "leader partition, 0 dropped requests, "
+              f"{summary['cas_commits']} conditional PUTs committed "
+              f"({summary['cas_losses']} honest CAS losses, 0 lost updates), "
+              f"{summary['kv_commits']} KV writes, version "
+              f"{summary['v0']} -> {summary['final_version']}, "
+              f"max commit gap {summary['max_commit_gap_s']}s, "
+              f"{summary['elections_journaled']} leader_elected journaled, "
+              f"{summary['respawns']} respawns, converged in "
+              f"{summary['wall_s']}s")
         return 0
 
     if args.trace_drill:

@@ -103,6 +103,18 @@ workers via netns routes / tc, never in-process):
                                     its workers at once — correlated whole-
                                     host loss; exactly one survivor-side
                                     shrink CAS must remove all K ranks
+  kill_coordinator@step=N[:replica=R]
+                                    SIGKILL one replica of the replicated
+                                    config ensemble once the fleet reaches
+                                    step N (replica=-1 / absent = whichever
+                                    replica currently holds the leader
+                                    lease).  The ensemble must fail over —
+                                    a new epoch's leader elected, the dead
+                                    replica respawned and snapshot-caught-
+                                    up — with zero dropped client requests
+                                    and zero lost conditional-PUTs
+                                    (docs/fault_tolerance.md "Replicated
+                                    control plane")
 
 Durations accept a trailing "s" or "ms" ("3s", "250ms", bare numbers are
 seconds).  Ranks refer to the worker's LAUNCH rank (its rank when the
@@ -121,9 +133,9 @@ FAULT_PLAN_ENV = "KFT_FAULT_PLAN"
 
 _KINDS = ("crash", "hang", "slow", "flap", "corrupt_ckpt", "crash_in_save",
           "crash_serve", "slow_serve", "burst", "partition", "degrade_link",
-          "kill_host")
+          "kill_host", "kill_coordinator")
 SERVE_PHASES = ("prefill", "decode", "kv_ship")
-NETWORK_KINDS = ("partition", "degrade_link", "kill_host")
+NETWORK_KINDS = ("partition", "degrade_link", "kill_host", "kill_coordinator")
 DEFAULT_CRASH_CODE = 41
 DEFAULT_CRASH_IN_SAVE_CODE = 43
 DEFAULT_CRASH_SERVE_CODE = 45
@@ -162,6 +174,7 @@ class Fault:
     rps: float = 0.0                # burst: open-loop request rate
     # network faults (pod harness; hosts/host name netns "hosts", not ranks)
     host: str = ""                  # degrade_link/kill_host target host
+    replica: int = -1               # kill_coordinator: config replica; -1 = leader
     groups: Tuple[Tuple[str, ...], ...] = ()  # partition: the two host sides
     heal_after: float = 0.0         # partition: seconds until partition heals
     latency_ms: float = 0.0         # degrade_link: added one-way delay
@@ -291,6 +304,15 @@ def _parse_one(spec: str) -> Fault:
         return Fault(
             kind="kill_host", step=int(kv.pop("step", 0)),
             host=kv.pop("host"), **_reject_leftovers(kv, spec),
+        )
+
+    if kind == "kill_coordinator":
+        if "step" not in kv:
+            raise ValueError(f"kill_coordinator fault needs step=: {spec!r}")
+        return Fault(
+            kind="kill_coordinator", step=int(kv.pop("step")),
+            replica=int(kv.pop("replica", -1)),
+            **_reject_leftovers(kv, spec),
         )
 
     if "step" not in kv or "rank" not in kv:

@@ -12,11 +12,27 @@ out inside the client instead of surfacing as `OSError` at every call site.
 Semantic responses (404 no-config, 409 rejected PUT) are never retried.
 `poll_cluster` is the fire-and-forget variant the poll loops use: an outage
 that outlives the retry budget collapses to None ("no new config visible").
+
+Replicated control plane (docs/fault_tolerance.md): `url` may be a
+comma-separated list of replica URLs (the `KFT_CONFIG_URLS` form).  The
+client talks to one active endpoint at a time; on a transport error or 5xx
+it rotates to the next, and on a 421 not-leader redirect it follows the
+leader hint in the body — both inside the existing retry budget, so call
+sites see exactly the single-server behavior, just with the outage window
+of a leader failover instead of a dead coordinator.  Every response's
+`leader_epoch` stamp is tracked: a read answered from an epoch OLDER than
+one this client has already seen is discarded and retried (a just-deposed
+leader inside its lease-expiry window can serve one last stale read; the
+epoch check turns that into a retry, never an acted-on regression).  A 409
+CAS rejection, by contrast, is only ever produced by a leader holding a
+majority-fresh lease, so it is always a genuine version conflict — the
+replicated server answers 421, never 409, when it cannot prove leadership.
 """
 from __future__ import annotations
 
 import json
 import random
+import threading
 import time
 import urllib.error
 import urllib.parse
@@ -29,25 +45,83 @@ from ..utils import get_logger
 log = get_logger("kungfu.elastic")
 
 
+class StaleLeaderRead(OSError):
+    """A response carried a leader_epoch older than one already observed:
+    the answering replica lost leadership and must not be believed.
+    OSError so the retry/rotate machinery (and poll_cluster's fire-and-
+    forget collapse) treats it exactly like a transport fault."""
+
+
 class ConfigClient:
     def __init__(self, url: str, timeout_s: float = 5.0, retries: int = 5,
                  backoff_s: float = 0.1, backoff_max_s: float = 2.0,
                  retry_deadline_s: float = 10.0):
         if not url:
             raise ValueError("config server URL is empty")
-        self.url = url.rstrip("/")
+        self._urls = [u.strip().rstrip("/") for u in url.split(",") if u.strip()]
+        if not self._urls:
+            raise ValueError("config server URL is empty")
+        self._active = 0
+        self._max_epoch = 0
+        self._ep_lock = threading.Lock()
         self.timeout_s = timeout_s
         self.retries = retries
         self.backoff_s = backoff_s
         self.backoff_max_s = backoff_max_s
         self.retry_deadline_s = retry_deadline_s
 
+    @property
+    def url(self) -> str:
+        """The currently-active endpoint (request URLs build off this, so
+        failover is transparent to every call site)."""
+        return self._urls[self._active]
+
+    @property
+    def urls_spec(self) -> str:
+        """The full endpoint list as the comma form KFT_CONFIG_URLS takes —
+        what a launcher passes down to workers (never just the currently
+        active endpoint: the worker must survive its own failovers)."""
+        return ",".join(self._urls)
+
+    def _rotate(self) -> None:
+        if len(self._urls) > 1:
+            self._active = (self._active + 1) % len(self._urls)
+
+    def _follow_hint(self, e: urllib.error.HTTPError) -> None:
+        """A 421 not-leader body carries {"leader": url|null}: jump straight
+        to the hinted leader when it is one of ours, else rotate."""
+        hint = None
+        try:
+            hint = (json.loads(e.read().decode() or "{}") or {}).get("leader")
+        except (ValueError, OSError):
+            pass
+        if hint and hint.rstrip("/") in self._urls:
+            self._active = self._urls.index(hint.rstrip("/"))
+        else:
+            self._rotate()
+
+    def _seen_epoch(self, doc, enforce: bool = True):
+        """Track the highest leader_epoch observed; with `enforce`, reject
+        (retry) any response from an older epoch.  Returns `doc`."""
+        if isinstance(doc, dict) and doc.get("leader_epoch") is not None:
+            epoch = int(doc["leader_epoch"])
+            with self._ep_lock:
+                if epoch >= self._max_epoch:
+                    self._max_epoch = epoch
+                elif enforce:
+                    raise StaleLeaderRead(
+                        f"stale leader read: epoch {epoch} < {self._max_epoch}")
+        return doc
+
     def _with_retry(self, fn, what: str):
-        """Run `fn` with bounded retry on transport errors and 5xx.
+        """Run `fn` with bounded retry on transport errors, 5xx, 421
+        not-leader redirects, and stale-epoch reads.
 
         Exponential backoff with full jitter (delay uniform in (0, cap]);
         total retrying is capped by both the attempt count and the
-        wall-clock deadline, so a dead server fails in bounded time.
+        wall-clock deadline, so a dead server fails in bounded time.  Every
+        retryable failure also rotates the active endpoint (or follows the
+        421 leader hint), which is what rides out a leader failover.
         """
         t0 = time.monotonic()
         cap = self.backoff_s
@@ -55,10 +129,19 @@ class ConfigClient:
             try:
                 return fn()
             except urllib.error.HTTPError as e:
-                if e.code < 500:  # semantic answer (404/409/...): caller's problem
+                if e.code == 421:  # not the leader: follow the hint, retry
+                    self._follow_hint(e)
+                    err: OSError = e
+                elif e.code < 500:  # semantic answer (404/409/...): caller's problem
                     raise
-                err: OSError = e
+                else:
+                    self._rotate()
+                    err = e
+            except StaleLeaderRead as e:
+                self._rotate()
+                err = e
             except (TimeoutError, OSError) as e:  # URLError, refused, reset, timeout
+                self._rotate()
                 err = e
             delay = cap * (0.5 + 0.5 * random.random())
             if (attempt == self.retries
@@ -73,7 +156,7 @@ class ConfigClient:
 
         def _get():
             with urllib.request.urlopen(self.url, timeout=self.timeout_s) as r:
-                return json.loads(r.read().decode())
+                return self._seen_epoch(json.loads(r.read().decode()))
 
         try:
             doc = self._with_retry(_get, "config GET")
@@ -103,7 +186,10 @@ class ConfigClient:
             with urllib.request.urlopen(
                 self.url + "/health", timeout=self.timeout_s
             ) as r:
-                return json.loads(r.read().decode())
+                # followers answer /health locally with their own (possibly
+                # trailing) epoch — record, never reject, liveness data
+                return self._seen_epoch(json.loads(r.read().decode()),
+                                        enforce=False)
 
         try:
             return self._with_retry(_get, "config health GET")
@@ -127,6 +213,8 @@ class ConfigClient:
                 headers={"Content-Type": "application/json"},
             )
             with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                self._seen_epoch(json.loads(r.read().decode() or "{}"),
+                                 enforce=False)
                 return 200 <= r.status < 300
 
         try:
@@ -153,6 +241,8 @@ class ConfigClient:
                 headers={"Content-Type": "application/json"},
             )
             with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                self._seen_epoch(json.loads(r.read().decode() or "{}"),
+                                 enforce=False)
                 return 200 <= r.status < 300
 
         try:
@@ -188,7 +278,7 @@ class ConfigClient:
         def _get():
             with urllib.request.urlopen(f"{self.url}/kv/{key}",
                                         timeout=self.timeout_s) as r:
-                return json.loads(r.read().decode())
+                return self._seen_epoch(json.loads(r.read().decode()))
 
         try:
             return self._with_retry(_get, f"kv GET {key}")
@@ -206,7 +296,7 @@ class ConfigClient:
         def _get():
             url = f"{self.url}/kv?prefix={urllib.parse.quote(prefix)}"
             with urllib.request.urlopen(url, timeout=self.timeout_s) as r:
-                return json.loads(r.read().decode())
+                return self._seen_epoch(json.loads(r.read().decode()))
 
         try:
             return self._with_retry(_get, f"kv LIST {prefix}")

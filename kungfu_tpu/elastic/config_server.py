@@ -40,14 +40,48 @@ Pod-scale extensions (docs/fault_tolerance.md "network failure model"):
     waiting in recovery only act on a strictly newer version, and after a
     partition heals the membership is (correctly) unchanged, so something
     must move the version without moving the document.
+
+Replicated control plane (docs/fault_tolerance.md "Replicated control
+plane"): with `-replica-id I -peers url0,url1,...` N of these processes
+form a leader-leased, log-replicated ensemble:
+
+  - one epoch-numbered leader holds a heartbeat-renewed lease; every
+    mutation (conditional PUT, reconvene bump, POST, DELETE, KV PUT/DELETE)
+    is appended to a replicated operation log and acknowledged by a
+    majority BEFORE the leader applies it and replies OK, so any majority
+    of replicas can lose the rest without losing a committed write
+    (RPO 0 for acknowledged writes);
+  - followers redirect document and KV traffic to the leader with a 421 +
+    leader hint (the failover client follows it transparently); /health and
+    /raft/status answer locally on every replica (liveness plane);
+  - a leader that cannot renew its lease from a majority STOPS answering
+    the document plane (421, never a fabricated 409) — a conditional PUT
+    can only be rejected by a leader that just proved its authority, so a
+    409 is always a genuine CAS loss;
+  - every response carries a `leader_epoch` stamp so a client that just
+    failed over can detect and discard a stale-leader read;
+  - internal `/raft/vote` + `/raft/append` endpoints carry elections, lease
+    renewal, log replication, and snapshot catch-up (a respawned replica
+    re-joins from the leader's applied snapshot).  Single-replica servers
+    run the same code path with a fixed epoch of 1 and no network rounds —
+    the wire contract is identical either way.
+
+Timing knobs (operators rarely touch these; docs/fault_tolerance.md):
+`KFT_RAFT_HB_S` heartbeat/lease-renewal interval (default 0.15 s) and
+`KFT_RAFT_ELECT_S` base election timeout (default 0.6 s; replica i waits
+an extra 0.25*i so the lowest live replica wins deterministically).
 """
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import threading
+import time
+import urllib.parse
+import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from ..plan import Cluster
 from ..utils import get_logger
@@ -99,22 +133,23 @@ class _State:
 
     # -- KV liveness plane -----------------------------------------------------------
 
-    def kv_put(self, key: str, value) -> None:
-        import time as _time
-
+    def kv_put(self, key: str, value, t_server: Optional[float] = None) -> None:
+        # replicated mode passes the LEADER's append-time stamp so every
+        # replica applies a byte-identical entry (liveness judgments keep
+        # comparing one clock either way)
+        if t_server is None:
+            t_server = round(time.time(), 6)
         with self.lock:
-            self.kv[key] = {"value": value, "t_server": round(_time.time(), 6)}
+            self.kv[key] = {"value": value, "t_server": t_server}
 
     def kv_get(self, key: str) -> Optional[dict]:
         with self.lock:
             return self.kv.get(key)
 
     def kv_list(self, prefix: str) -> dict:
-        import time as _time
-
         with self.lock:
             return {
-                "now": round(_time.time(), 6),
+                "now": round(time.time(), 6),
                 "entries": {k: dict(v) for k, v in self.kv.items()
                             if k.startswith(prefix)},
             }
@@ -151,17 +186,445 @@ class _State:
                 "cleared": self.cleared,
             }
 
+    # -- replicated state machine ----------------------------------------------------
+
+    def apply(self, op: list) -> Tuple[bool, str]:
+        """Apply one replicated log entry.  Deterministic: identical logs
+        applied in order produce identical state AND identical results on
+        every replica (the leader replies with ITS apply result)."""
+        kind = op[0]
+        if kind == "noop":
+            return True, "noop"  # the new leader's commit-point probe
+        if kind == "put":
+            return self.put(Cluster.from_json(op[1]),
+                            op[2] if op[2] is None else int(op[2]),
+                            reconvene=bool(op[3]))
+        if kind == "post":
+            return self.post(Cluster.from_json(op[1]))
+        if kind == "delete":
+            self.delete()
+            return True, "ok"
+        if kind == "kv_put":
+            self.kv_put(op[1], op[2], t_server=op[3])
+            return True, "ok"
+        if kind == "kv_delete":
+            self.kv_delete(op[1])
+            return True, "ok"
+        return False, f"unknown op {kind!r}"
+
+    def snapshot(self) -> dict:
+        """The applied state, for follower catch-up / log compaction."""
+        with self.lock:
+            return {
+                "cluster": self.cluster.to_json() if self.cluster is not None else None,
+                "version": self.version,
+                "cleared": self.cleared,
+                "kv": {k: dict(v) for k, v in self.kv.items()},
+            }
+
+    def install(self, snap: dict) -> None:
+        with self.lock:
+            c = snap.get("cluster")
+            self.cluster = Cluster.from_json(c) if c is not None else None
+            self.version = int(snap.get("version", 0))
+            self.cleared = bool(snap.get("cleared", False))
+            self.kv = {k: dict(v) for k, v in (snap.get("kv") or {}).items()}
+
+
+def _url_root(url: str) -> str:
+    """http://h:p/config -> http://h:p (the /raft RPC root)."""
+    parts = urllib.parse.urlsplit(url)
+    return f"{parts.scheme}://{parts.netloc}"
+
+
+class _Replicator:
+    """Leader lease + replicated operation log across N config replicas.
+
+    Raft-shaped, sized for a control plane of 3-5 replicas: epoch-numbered
+    elections (vote granted only to candidates with an up-to-date log), a
+    single leader that appends every mutation to its log and waits for a
+    majority ack before applying and replying, heartbeat-renewed lease
+    (a leader that cannot reach a majority within the lease window stops
+    serving — it can never fabricate a 409 from stale state), and
+    snapshot-based catch-up for respawned or diverged replicas.  A
+    single-replica server runs the same code with majority 1, epoch 1 and
+    no network rounds.
+    """
+
+    def __init__(self, state: _State, replica_id: int, peers: List[str]):
+        self.state = state
+        self.id = replica_id
+        self.peers = [u.rstrip("/") for u in peers]  # client URLs, index = id
+        self.n = max(1, len(self.peers))
+        self._rlock = threading.Lock()     # raft metadata (outer of state.lock)
+        self._write_lock = threading.Lock()  # serializes client mutations
+        self.single = self.n == 1
+        self.epoch = 1 if self.single else 0
+        self.voted_epoch = 0
+        self.role = "leader" if self.single else "follower"
+        self.leader_id: Optional[int] = replica_id if self.single else None
+        self.base = 0                      # log[0] is global index `base`
+        self.base_epoch = 0
+        self.log: List[dict] = []          # {"epoch": int, "op": [...]}
+        self.commit = 0                    # entries [0, commit) are applied
+        self.epoch_start = 0               # first index of the current term
+        self.match: Dict[int, Optional[int]] = {}
+        self.results: Dict[int, Tuple[bool, str]] = {}
+        self.hb_s = float(os.environ.get("KFT_RAFT_HB_S", "") or 0.15)
+        elect = float(os.environ.get("KFT_RAFT_ELECT_S", "") or 0.6)
+        # deterministic failover: replica i waits elect + 0.25*i before
+        # campaigning, so the lowest-id live replica always wins the race
+        self.elect_s = elect + 0.25 * replica_id
+        self.lease_valid_s = float("inf") if self.single else 0.75 * elect
+        self.step_down_s = 2.0 * elect
+        self.rpc_timeout_s = max(0.25, 2.0 * self.hb_s)
+        now = time.monotonic()
+        self.lease_until = now + self.elect_s
+        self.last_quorum = now
+        self.paused = False                # drills: freeze the ticker only
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle --------------------------------------------------------------------
+
+    def start(self) -> "_Replicator":
+        if not self.single and self._thread is None:
+            self._thread = threading.Thread(target=self._tick_loop, daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # -- introspection ----------------------------------------------------------------
+
+    def _majority(self) -> int:
+        return self.n // 2 + 1
+
+    def _end(self) -> int:
+        return self.base + len(self.log)
+
+    def _last_epoch(self) -> int:
+        return self.log[-1]["epoch"] if self.log else self.base_epoch
+
+    def _hint_locked(self) -> Optional[str]:
+        if self.leader_id is None or self.leader_id == self.id:
+            return None
+        return self.peers[self.leader_id]
+
+    def epoch_now(self) -> int:
+        with self._rlock:
+            return self.epoch
+
+    def not_leader_body(self) -> dict:
+        with self._rlock:
+            return {"error": "not_leader", "leader": self._hint_locked(),
+                    "leader_epoch": self.epoch}
+
+    def serving(self) -> bool:
+        """True iff this replica may answer the document/KV plane: it is
+        the leader, its lease is majority-fresh, and it has committed an
+        entry of its own epoch (the no-op probe), so its applied state is
+        current.  A deposed or isolated leader fails this and redirects —
+        never answers from stale state."""
+        with self._rlock:
+            return (self.role == "leader"
+                    and time.monotonic() - self.last_quorum <= self.lease_valid_s
+                    and self.commit >= self.epoch_start)
+
+    def status(self) -> dict:
+        with self._rlock:
+            return {
+                "replica": self.id,
+                "role": self.role,
+                "epoch": self.epoch,
+                "leader": self.leader_id,
+                "leader_url": self._hint_locked() or (
+                    self.peers[self.id] if self.role == "leader" else None),
+                "log_index": self._end(),
+                "commit": self.commit,
+                "replicas": self.n,
+            }
+
+    # -- client mutations -------------------------------------------------------------
+
+    def submit(self, op: list, timeout_s: float = 5.0):
+        """Append `op`, replicate to a majority, apply, return the result.
+
+        Returns ("ok", (applied_ok, msg)) once the entry is majority-acked
+        and applied; ("not_leader", hint_body) when this replica cannot
+        prove leadership (the client retries elsewhere — NEVER a 409); or
+        ("unavailable", reason) when no quorum answered inside timeout_s.
+        """
+        with self._write_lock:
+            with self._rlock:
+                if (self.role != "leader"
+                        or time.monotonic() - self.last_quorum > self.lease_valid_s
+                        or self.commit < self.epoch_start):
+                    return "not_leader", None
+                epoch = self.epoch
+                self.log.append({"epoch": epoch, "op": op})
+                target = self._end()
+            if self.single:
+                with self._rlock:
+                    self._advance_locked(target)
+                    return "ok", self.results.pop(target, (False, "lost"))
+            deadline = time.monotonic() + timeout_s
+            while True:
+                self._heartbeat()
+                with self._rlock:
+                    if self.epoch != epoch or self.role != "leader":
+                        return "not_leader", None
+                    if self.commit >= target:
+                        return "ok", self.results.pop(target, (False, "lost"))
+                if time.monotonic() >= deadline:
+                    return "unavailable", "no replication quorum"
+                time.sleep(0.005)
+
+    # -- RPC handlers (called from the HTTP server threads) ---------------------------
+
+    def on_vote(self, body: dict) -> dict:
+        epoch = int(body["epoch"])
+        with self._rlock:
+            if epoch > self.epoch:
+                self._become_follower_locked(epoch, None)
+            up_to_date = (
+                (int(body.get("last_epoch", 0)), int(body.get("log_index", 0)))
+                >= (self._last_epoch(), self._end()))
+            granted = (epoch == self.epoch and self.voted_epoch < epoch
+                       and up_to_date)
+            if granted:
+                self.voted_epoch = epoch
+                # granting a vote re-arms our own election timer: we must
+                # not immediately campaign against the candidate we backed
+                self.lease_until = time.monotonic() + self.elect_s
+            return {"granted": granted, "epoch": self.epoch}
+
+    def on_append(self, body: dict) -> dict:
+        epoch = int(body["epoch"])
+        with self._rlock:
+            if epoch < self.epoch:
+                # a deposed leader: tell it the new epoch so it steps down
+                return {"ok": False, "epoch": self.epoch,
+                        "log_index": self.commit}
+            if epoch > self.epoch or self.role != "follower":
+                self._become_follower_locked(epoch, int(body["leader"]))
+            self.leader_id = int(body["leader"])
+            self.lease_until = time.monotonic() + self.elect_s
+            if "snapshot" in body:
+                # catch-up: adopt the leader's applied state wholesale
+                self.state.install(body["snapshot"])
+                self.base = int(body["base"])
+                self.base_epoch = int(body["base_epoch"])
+                self.log = list(body["entries"])
+                self.commit = self.base
+                self.results.clear()
+            else:
+                prev = int(body["prev"])
+                if prev != self._end():
+                    # diverged or lagging: ask the leader for a snapshot
+                    return {"ok": False, "epoch": self.epoch,
+                            "log_index": self.commit, "need_sync": True}
+                self.log.extend(body["entries"])
+            self._advance_locked(min(int(body["commit"]), self._end()))
+            return {"ok": True, "epoch": self.epoch, "log_index": self._end()}
+
+    # -- internals --------------------------------------------------------------------
+
+    def _become_follower_locked(self, epoch: int, leader: Optional[int]) -> None:
+        was_leader = self.role == "leader"
+        if epoch > self.epoch:
+            self.epoch = epoch
+        self.role = "follower"
+        self.leader_id = leader
+        self.match = {}
+        if was_leader:
+            from ..monitor.journal import journal_event
+
+            journal_event("leader_lost", leader_epoch=self.epoch,
+                          replica=self.id)
+            log.info("replica %d stepped down at epoch %d", self.id, self.epoch)
+
+    def _advance_locked(self, to: int) -> None:
+        while self.commit < to:
+            entry = self.log[self.commit - self.base]
+            self.results[self.commit + 1] = self.state.apply(entry["op"])
+            self.commit += 1
+        # bound the result stash (only the in-flight write reads it)
+        if len(self.results) > 64:
+            for idx in sorted(self.results)[:-16]:
+                self.results.pop(idx, None)
+        self._compact_locked()
+
+    def _compact_locked(self, keep: int = 64) -> None:
+        """Drop committed log prefix once it is long: followers that far
+        behind re-join via snapshot anyway."""
+        if len(self.log) > 4 * keep and self.commit - self.base > keep:
+            cut = self.commit - self.base - keep
+            self.base_epoch = self.log[cut - 1]["epoch"]
+            self.log = self.log[cut:]
+            self.base += cut
+
+    def _tick_loop(self) -> None:
+        last_hb = 0.0
+        while not self._stop.wait(0.02):
+            if self.paused:
+                continue
+            with self._rlock:
+                role = self.role
+                lease_until = self.lease_until
+            now = time.monotonic()
+            if role == "leader":
+                if now - last_hb >= self.hb_s:
+                    last_hb = now
+                    self._heartbeat()
+            elif now >= lease_until:
+                self._campaign()
+                last_hb = 0.0
+
+    def _campaign(self) -> None:
+        with self._rlock:
+            self.epoch += 1
+            epoch = self.epoch
+            self.voted_epoch = epoch
+            self.role = "candidate"
+            self.leader_id = None
+            self.lease_until = time.monotonic() + self.elect_s
+            body = {"epoch": epoch, "candidate": self.id,
+                    "log_index": self._end(), "last_epoch": self._last_epoch()}
+        replies = self._broadcast("vote", {r: body for r in self._others()})
+        votes = 1
+        max_epoch = epoch
+        for r in replies.values():
+            if r is None:
+                continue
+            max_epoch = max(max_epoch, int(r.get("epoch", 0)))
+            if r.get("granted"):
+                votes += 1
+        with self._rlock:
+            if self.epoch != epoch or self.role != "candidate":
+                return
+            if max_epoch > epoch:
+                self._become_follower_locked(max_epoch, None)
+                return
+            if votes < self._majority():
+                self.role = "follower"  # retry after the next timeout
+                return
+            self.role = "leader"
+            self.leader_id = self.id
+            self.match = {r: None for r in self._others()}
+            # no-op probe: only after an entry of OUR epoch commits do we
+            # know the true commit point and may serve reads/writes
+            self.log.append({"epoch": epoch, "op": ["noop"]})
+            self.epoch_start = self._end()
+            # lease starts invalid: the first majority heartbeat below
+            # (not the election itself) proves our authority
+            self.last_quorum = time.monotonic() - 2 * self.lease_valid_s
+        from ..monitor.journal import journal_event
+
+        journal_event("leader_elected", leader_epoch=epoch, replica=self.id)
+        log.info("replica %d elected leader at epoch %d", self.id, epoch)
+        self._heartbeat()
+
+    def _heartbeat(self) -> None:
+        with self._rlock:
+            if self.role != "leader":
+                return
+            epoch = self.epoch
+            payloads: Dict[int, dict] = {}
+            for rid in self._others():
+                m = self.match.get(rid)
+                head = {"epoch": epoch, "leader": self.id, "commit": self.commit}
+                if m is None or m < self.base:
+                    # snapshot catch-up from the applied (== committed) state
+                    payloads[rid] = dict(
+                        head, snapshot=self.state.snapshot(), base=self.commit,
+                        base_epoch=(self.log[self.commit - self.base - 1]["epoch"]
+                                    if self.commit > self.base else self.base_epoch),
+                        entries=self.log[self.commit - self.base:])
+                else:
+                    payloads[rid] = dict(
+                        head, prev=m, entries=self.log[m - self.base:])
+        replies = self._broadcast("append", payloads)
+        with self._rlock:
+            if self.epoch != epoch or self.role != "leader":
+                return
+            acks = 1
+            for rid, r in replies.items():
+                if r is None:
+                    continue
+                if int(r.get("epoch", 0)) > self.epoch:
+                    self._become_follower_locked(int(r["epoch"]), None)
+                    self.lease_until = time.monotonic() + self.elect_s
+                    return
+                if r.get("ok"):
+                    acks += 1
+                    self.match[rid] = int(r["log_index"])
+                elif r.get("need_sync"):
+                    self.match[rid] = None
+            if acks >= self._majority():
+                self.last_quorum = time.monotonic()
+                # commit rule: the highest index replicated on a majority
+                # whose entry carries the CURRENT epoch
+                for idx in range(self._end(), self.commit, -1):
+                    if self.log[idx - 1 - self.base]["epoch"] != self.epoch:
+                        break
+                    have = 1 + sum(1 for m in self.match.values()
+                                   if m is not None and m >= idx)
+                    if have >= self._majority():
+                        self._advance_locked(idx)
+                        break
+            elif time.monotonic() - self.last_quorum > self.step_down_s:
+                # isolated: stop pretending; clients go find the new leader
+                self._become_follower_locked(self.epoch, None)
+                self.lease_until = time.monotonic() + self.elect_s
+
+    def _others(self) -> List[int]:
+        return [r for r in range(self.n) if r != self.id]
+
+    def _broadcast(self, rpc: str, payloads: Dict[int, dict]) -> Dict[int, Optional[dict]]:
+        """POST one /raft/<rpc> to each addressed peer in parallel; None
+        for peers that failed to answer inside the RPC timeout."""
+        out: Dict[int, Optional[dict]] = {rid: None for rid in payloads}
+        if not payloads:
+            return out
+        done = threading.Event()
+        pending = [len(payloads)]
+        plock = threading.Lock()
+
+        def _one(rid: int, body: dict) -> None:
+            try:
+                data = json.dumps(body).encode()
+                req = urllib.request.Request(
+                    f"{_url_root(self.peers[rid])}/raft/{rpc}", data=data,
+                    method="POST", headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=self.rpc_timeout_s) as r:
+                    out[rid] = json.loads(r.read().decode())
+            except (OSError, ValueError):
+                out[rid] = None
+            with plock:
+                pending[0] -= 1
+                if pending[0] == 0:
+                    done.set()
+
+        for rid, body in payloads.items():
+            threading.Thread(target=_one, args=(rid, body), daemon=True).start()
+        done.wait(self.rpc_timeout_s + 0.2)
+        return out
+
 
 class ConfigServer:
     """Threaded config server; use .start()/.stop() embedded, or serve_forever."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 9100,
-                 init: Optional[Cluster] = None, chaos=None):
+                 init: Optional[Cluster] = None, chaos=None,
+                 replica_id: int = 0, peers: Optional[List[str]] = None):
         from ..chaos import server_chaos_from_env
 
         self.state = _State(init)
         state = self.state
         stop_cb = self.stop
+        this = self
         # scripted outage windows (KFT_FAULT_PLAN flap@config_server=...)
         chaos = chaos if chaos is not None else server_chaos_from_env()
 
@@ -182,6 +645,35 @@ class ConfigServer:
                     return True
                 return False
 
+            def _not_leader(self) -> None:
+                # 421 Misdirected Request + leader hint: the failover
+                # client follows it; a CAS client NEVER sees this as a 409
+                self._send(421, json.dumps(this.node.not_leader_body()).encode())
+
+            def _epoch(self) -> int:
+                return this.node.epoch_now()
+
+            def _read_body(self):
+                """(ok, parsed) — ok False means a 400 was already sent."""
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    return True, json.loads(self.rfile.read(n).decode() or "null")
+                except (ValueError, OSError) as e:
+                    self._send(400, json.dumps({"error": str(e)}).encode())
+                    return False, None
+
+            def _reply_submit(self, status, result) -> None:
+                if status == "not_leader":
+                    self._not_leader()
+                    return
+                if status == "unavailable":
+                    self._send(503, json.dumps(
+                        {"error": result, "leader_epoch": self._epoch()}).encode())
+                    return
+                ok, msg = result
+                self._send(200 if ok else 409, json.dumps(
+                    {"msg": msg, "leader_epoch": self._epoch()}).encode())
+
             def _kv_key(self) -> Optional[str]:
                 """The KV key for a `<anything>/kv/<key>` or `/kv?prefix=`
                 path, or None when this is not a KV request."""
@@ -197,30 +689,49 @@ class ConfigServer:
                     self._send(200, b"{}")
                     threading.Thread(target=stop_cb, daemon=True).start()
                     return
+                if self.path.startswith("/raft/"):
+                    # replication introspection: local on every replica
+                    self._send(200, json.dumps(this.node.status()).encode())
+                    return
                 key = self._kv_key()
                 if key is not None:
                     # KV is the liveness plane: served inside flap windows
                     # (a flap that faked every runner heartbeat stale would
-                    # turn a control-plane brownout into a heal storm)
+                    # turn a control-plane brownout into a heal storm) but
+                    # ONLY by the leader — t_server stamps come from one
+                    # clock, and a follower's lagging view must not judge
+                    if not this.node.serving():
+                        self._not_leader()
+                        return
                     if key == "":
-                        from urllib.parse import parse_qs, urlsplit
-
-                        q = parse_qs(urlsplit(self.path).query)
+                        q = urllib.parse.parse_qs(
+                            urllib.parse.urlsplit(self.path).query)
                         prefix = (q.get("prefix") or [""])[0]
-                        self._send(200, json.dumps(state.kv_list(prefix)).encode())
+                        body = state.kv_list(prefix)
+                        body["leader_epoch"] = self._epoch()
+                        self._send(200, json.dumps(body).encode())
                         return
                     got = state.kv_get(key)
                     if got is None:
                         self._send(404, b'{"error": "no such key"}')
                         return
-                    self._send(200, json.dumps(got).encode())
+                    body = dict(got)
+                    body["leader_epoch"] = self._epoch()
+                    self._send(200, json.dumps(body).encode())
                     return
                 if self.path.rstrip("/").endswith("/health"):
                     # liveness endpoint: served even inside a chaos flap
-                    # window — the flap models document-plane overload, and
-                    # pollers (autoscaler, external LBs) must still get the
-                    # cheap version answer without a full-document GET
-                    self._send(200, json.dumps(state.health()).encode())
+                    # window AND on followers — the flap models document-
+                    # plane overload, and pollers (autoscaler, external
+                    # LBs) must still get the cheap version answer
+                    body = state.health()
+                    body.update(this.node.status() if not this.node.single
+                                else {"role": "leader", "replica": 0})
+                    body["leader_epoch"] = self._epoch()
+                    self._send(200, json.dumps(body).encode())
+                    return
+                if not this.node.serving():
+                    self._not_leader()
                     return
                 if self._flapped():
                     return
@@ -229,39 +740,33 @@ class ConfigServer:
                     self._send(404, b'{"error": "no config"}')
                     return
                 cluster, version = got
-                body = json.dumps({"cluster": cluster.to_json(), "version": version}).encode()
+                body = json.dumps({"cluster": cluster.to_json(), "version": version,
+                                   "leader_epoch": self._epoch()}).encode()
                 self._send(200, body)
-
-            def _read_cluster(self) -> Optional[Tuple[Cluster, Optional[int]]]:
-                try:
-                    n = int(self.headers.get("Content-Length", "0"))
-                    doc = json.loads(self.rfile.read(n).decode())
-                    payload = doc.get("cluster", doc)
-                    version = doc.get("version") if isinstance(doc, dict) else None
-                    return Cluster.from_json(payload), (
-                        int(version) if version is not None else None
-                    )
-                except Exception as e:
-                    self._send(400, json.dumps({"error": str(e)}).encode())
-                    return None
 
             def do_PUT(self):
                 key = self._kv_key()
                 if key:
-                    try:
-                        n = int(self.headers.get("Content-Length", "0"))
-                        value = json.loads(self.rfile.read(n).decode() or "null")
-                    except ValueError as e:
-                        self._send(400, json.dumps({"error": str(e)}).encode())
+                    ok, value = self._read_body()
+                    if not ok:
                         return
-                    state.kv_put(key, value)
-                    self._send(200, b"{}")
+                    if not this.node.serving():
+                        self._not_leader()
+                        return
+                    status, result = this.node.submit(
+                        ["kv_put", key, value, round(time.time(), 6)])
+                    if status == "ok":
+                        self._send(200, json.dumps(
+                            {"leader_epoch": self._epoch()}).encode())
+                    else:
+                        self._reply_submit(status, result)
                     return
                 if self._flapped():
                     return
+                ok, doc = self._read_body()
+                if not ok:
+                    return
                 try:
-                    n = int(self.headers.get("Content-Length", "0"))
-                    doc = json.loads(self.rfile.read(n).decode())
                     payload = doc.get("cluster", doc)
                     version = doc.get("version") if isinstance(doc, dict) else None
                     reconvene = bool(isinstance(doc, dict) and doc.get("reconvene"))
@@ -269,31 +774,88 @@ class ConfigServer:
                 except Exception as e:
                     self._send(400, json.dumps({"error": str(e)}).encode())
                     return
+                if not this.node.serving():
+                    self._not_leader()
+                    return
+                try:
+                    # validate BEFORE the log append so malformed clusters
+                    # never replicate; same 409 text as state.put produces
+                    c.validate()
+                except ValueError as e:
+                    self._send(409, json.dumps(
+                        {"msg": f"invalid cluster: {e}",
+                         "leader_epoch": self._epoch()}).encode())
+                    return
                 expect_version = int(version) if version is not None else None
-                ok, msg = state.put(c, expect_version, reconvene=reconvene)
-                self._send(200 if ok else 409, json.dumps({"msg": msg}).encode())
+                status, result = this.node.submit(
+                    ["put", c.to_json(), expect_version, reconvene])
+                self._reply_submit(status, result)
 
             def do_POST(self):
+                if self.path.startswith("/raft/"):
+                    ok, body = self._read_body()
+                    if not ok:
+                        return
+                    if self.path.rstrip("/").endswith("/vote"):
+                        self._send(200, json.dumps(this.node.on_vote(body)).encode())
+                    elif self.path.rstrip("/").endswith("/append"):
+                        self._send(200, json.dumps(this.node.on_append(body)).encode())
+                    else:
+                        self._send(404, b'{"error": "no such rpc"}')
+                    return
                 if self._flapped():
                     return
-                got = self._read_cluster()
-                if got is None:
+                ok, doc = self._read_body()
+                if not ok:
                     return
-                ok, msg = state.post(got[0])
-                self._send(200 if ok else 409, json.dumps({"msg": msg}).encode())
+                try:
+                    c = Cluster.from_json(doc.get("cluster", doc))
+                except Exception as e:
+                    self._send(400, json.dumps({"error": str(e)}).encode())
+                    return
+                if not this.node.serving():
+                    self._not_leader()
+                    return
+                try:
+                    c.validate()
+                except ValueError as e:
+                    self._send(409, json.dumps(
+                        {"msg": f"invalid cluster: {e}",
+                         "leader_epoch": self._epoch()}).encode())
+                    return
+                status, result = this.node.submit(["post", c.to_json()])
+                self._reply_submit(status, result)
 
             def do_DELETE(self):
                 key = self._kv_key()
                 if key:
-                    state.kv_delete(key)
-                    self._send(200, b"{}")
+                    if not this.node.serving():
+                        self._not_leader()
+                        return
+                    status, result = this.node.submit(["kv_delete", key])
+                    if status == "ok":
+                        self._send(200, json.dumps(
+                            {"leader_epoch": self._epoch()}).encode())
+                    else:
+                        self._reply_submit(status, result)
                     return
-                state.delete()
-                self._send(200, b"{}")
+                if not this.node.serving():
+                    self._not_leader()
+                    return
+                status, result = this.node.submit(["delete"])
+                if status == "ok":
+                    self._send(200, json.dumps(
+                        {"leader_epoch": self._epoch()}).encode())
+                else:
+                    self._reply_submit(status, result)
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.host = host
         self.port = self._httpd.server_address[1]
+        self.replica_id = replica_id
+        self.node = _Replicator(
+            self.state, replica_id,
+            peers if peers else [self.url])
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -301,13 +863,25 @@ class ConfigServer:
         return f"http://{self.host}:{self.port}/config"
 
     def start(self) -> "ConfigServer":
+        self.node.start()
         self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
         self._thread.start()
         log.info("config server at %s", self.url)
         return self
 
     def stop(self) -> None:
+        self.node.stop()
         self._httpd.shutdown()
+        if self._thread:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def kill(self) -> None:
+        """Abrupt in-process death for failover tests: no step-down, no
+        graceful drain — the socket just goes away, like SIGKILL."""
+        self.node.stop()
+        self._httpd.shutdown()
+        self._httpd.server_close()
         if self._thread:
             self._thread.join(timeout=5)
             self._thread = None
@@ -318,13 +892,30 @@ def main(argv=None):
     ap.add_argument("-port", type=int, default=9100)
     ap.add_argument("-host", default="0.0.0.0")
     ap.add_argument("-init", default="", help="path to initial cluster JSON")
+    ap.add_argument("-replica-id", dest="replica_id", type=int, default=0,
+                    help="this replica's index into -peers (replicated mode)")
+    ap.add_argument("-peers", default="",
+                    help="comma-separated client URLs of EVERY ensemble "
+                         "replica, in replica-id order (includes this one); "
+                         "empty = single-server mode")
     args = ap.parse_args(argv)
     init = None
     if args.init:
         with open(args.init) as f:
             init = Cluster.from_json(json.load(f))
-    srv = ConfigServer(args.host, args.port, init)
-    log.info("serving on %s", srv.url)
+    peers = [u.strip() for u in args.peers.split(",") if u.strip()] or None
+    if peers is not None and not (0 <= args.replica_id < len(peers)):
+        ap.error(f"-replica-id {args.replica_id} out of range for {len(peers)} peers")
+    if peers is not None:
+        from ..monitor.journal import set_journal_context
+
+        set_journal_context(rank=f"config-{args.replica_id}",
+                            identity=f"config-{args.replica_id}")
+    srv = ConfigServer(args.host, args.port, init,
+                       replica_id=args.replica_id, peers=peers)
+    srv.node.start()
+    log.info("serving on %s (replica %d of %d)", srv.url, args.replica_id,
+             srv.node.n)
     try:
         srv._httpd.serve_forever()
     except KeyboardInterrupt:

@@ -1,0 +1,259 @@
+"""Config-server ensemble supervisor: spawn, watch, respawn N replicas.
+
+The replication protocol lives in config_server.py; this module owns the
+PROCESS side of the replicated control plane (docs/fault_tolerance.md
+"Replicated control plane"):
+
+  - pre-allocates one port per replica and spawns each as
+    `python -m kungfu_tpu.elastic.config_server -replica-id I -peers ...`,
+    every replica knowing the full peer list from birth;
+  - supervises them: a dead replica is respawned with the SAME replica id
+    and port (journal `replica_respawned`) and catches up from the
+    leader's snapshot — the ensemble heals itself the way the launcher
+    heals workers;
+  - observes the ensemble for the monitor plane: gauges
+    `config_leader_epoch`, `config_replicas_up`, `config_replication_lag`
+    (leader log head minus the slowest live replica's commit) and a
+    `leader_elected` counter event every time the observed epoch moves —
+    which feeds the shipped `rate:leader_elected` coordinator_flapping
+    SLO rule.
+
+Embedders (launcher `-config-replicas`, serving supervisor, drills) get
+`urls_spec` — the comma form every ConfigClient accepts via
+KFT_CONFIG_URLS — and `client()` for a ready-made failover client.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+from typing import List, Optional
+
+from ..plan import Cluster
+from ..utils import get_logger
+
+log = get_logger("kungfu.ensemble")
+
+
+def free_ports(n: int, host: str = "127.0.0.1") -> List[int]:
+    """Reserve n distinct free TCP ports (bind-then-close; the tiny race
+    against other processes is acceptable for test/drill ensembles)."""
+    socks, ports = [], []
+    try:
+        for _ in range(n):
+            s = socket.socket()
+            s.bind((host, 0))
+            socks.append(s)
+            ports.append(s.getsockname()[1])
+    finally:
+        for s in socks:
+            s.close()
+    return ports
+
+
+class ConfigEnsemble:
+    """N-replica config-server ensemble with respawn supervision."""
+
+    def __init__(self, replicas: int = 3, host: str = "127.0.0.1",
+                 init: Optional[Cluster] = None,
+                 ports: Optional[List[int]] = None,
+                 respawn: bool = True, env: Optional[dict] = None):
+        if replicas < 1:
+            raise ValueError(f"need at least 1 replica, got {replicas}")
+        self.host = host
+        self.n = replicas
+        self.ports = list(ports) if ports else free_ports(replicas, host)
+        if len(self.ports) != replicas:
+            raise ValueError(f"{len(self.ports)} ports for {replicas} replicas")
+        self.urls = [f"http://{host}:{p}/config" for p in self.ports]
+        self.respawn = respawn
+        self._env = dict(os.environ if env is None else env)
+        self._procs: List[Optional[subprocess.Popen]] = [None] * replicas
+        self._no_respawn = set()  # replica ids intentionally down
+        self._paused = set()
+        self._init_path = ""
+        if init is not None:
+            fd, self._init_path = tempfile.mkstemp(
+                prefix="kft-ensemble-", suffix=".json")
+            with os.fdopen(fd, "w") as f:
+                json.dump(init.to_json(), f)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._seen_epoch = 0
+        self.respawns = 0
+
+    @property
+    def urls_spec(self) -> str:
+        """Comma form for KFT_CONFIG_URLS / ConfigClient."""
+        return ",".join(self.urls)
+
+    def client(self, **kw):
+        from .config_client import ConfigClient
+
+        return ConfigClient(self.urls_spec, **kw)
+
+    # -- lifecycle --------------------------------------------------------------------
+
+    def _spawn(self, replica: int) -> subprocess.Popen:
+        cmd = [sys.executable, "-m", "kungfu_tpu.elastic.config_server",
+               "-host", self.host, "-port", str(self.ports[replica]),
+               "-replica-id", str(replica), "-peers", self.urls_spec]
+        if self._init_path:
+            cmd += ["-init", self._init_path]
+        return subprocess.Popen(
+            cmd, env=self._env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            start_new_session=True)
+
+    def start(self, wait_s: float = 15.0) -> "ConfigEnsemble":
+        with self._lock:
+            for i in range(self.n):
+                self._procs[i] = self._spawn(i)
+        if self.leader(wait_s=wait_s) is None:
+            self.stop()
+            raise RuntimeError(f"no leader elected within {wait_s}s")
+        self._thread = threading.Thread(target=self._watch, daemon=True)
+        self._thread.start()
+        log.info("config ensemble up: %s", self.urls_spec)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        with self._lock:
+            procs = list(self._procs)
+        for p in procs:
+            if p is not None and p.poll() is None:
+                p.terminate()
+        for p in procs:
+            if p is not None:
+                try:
+                    p.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+        if self._init_path:
+            try:
+                os.unlink(self._init_path)
+            except OSError:
+                pass
+
+    # -- fault injection (drills) -----------------------------------------------------
+
+    def kill_replica(self, replica: int, respawn: Optional[bool] = None) -> None:
+        """SIGKILL one replica (abrupt, like a host loss).  The supervisor
+        respawns it unless respawn=False."""
+        with self._lock:
+            if respawn is False:
+                self._no_respawn.add(replica)
+            elif respawn is True:
+                self._no_respawn.discard(replica)
+            p = self._procs[replica]
+        if p is not None and p.poll() is None:
+            p.kill()
+        log.info("killed config replica %d", replica)
+
+    def kill_leader(self, respawn: Optional[bool] = None) -> Optional[int]:
+        led = self.leader(wait_s=5.0)
+        if led is None:
+            return None
+        self.kill_replica(led, respawn=respawn)
+        return led
+
+    def pause_replica(self, replica: int) -> None:
+        """SIGSTOP: the process lives but goes silent — the partitioned-
+        coordinator model (its lease expires; on resume it must step down,
+        never serve from stale state)."""
+        with self._lock:
+            p = self._procs[replica]
+            self._paused.add(replica)
+        if p is not None and p.poll() is None:
+            os.kill(p.pid, signal.SIGSTOP)
+
+    def resume_replica(self, replica: int) -> None:
+        with self._lock:
+            p = self._procs[replica]
+            self._paused.discard(replica)
+        if p is not None and p.poll() is None:
+            os.kill(p.pid, signal.SIGCONT)
+
+    # -- observation ------------------------------------------------------------------
+
+    def raft_status(self, replica: int, timeout_s: float = 1.0) -> Optional[dict]:
+        root = self.urls[replica].rsplit("/", 1)[0]
+        try:
+            with urllib.request.urlopen(f"{root}/raft/status",
+                                        timeout=timeout_s) as r:
+                return json.loads(r.read().decode())
+        except (OSError, ValueError):
+            return None
+
+    def statuses(self) -> List[Optional[dict]]:
+        return [self.raft_status(i) for i in range(self.n)]
+
+    def leader(self, wait_s: float = 0.0) -> Optional[int]:
+        """Replica id of the highest-epoch replica claiming leadership, or
+        None; with wait_s, poll until one appears."""
+        deadline = time.monotonic() + wait_s
+        while True:
+            best, best_epoch = None, -1
+            for i, st in enumerate(self.statuses()):
+                if (st is not None and st.get("role") == "leader"
+                        and int(st.get("epoch", 0)) > best_epoch):
+                    best, best_epoch = i, int(st.get("epoch", 0))
+            if best is not None or time.monotonic() >= deadline:
+                return best
+            time.sleep(0.05)
+
+    # -- supervision ------------------------------------------------------------------
+
+    def _watch(self) -> None:
+        from ..monitor.counters import global_counters
+        from ..monitor.journal import journal_event
+
+        counters = global_counters()
+        while not self._stop.wait(0.2):
+            with self._lock:
+                procs = list(self._procs)
+                skip = set(self._no_respawn)
+            up = 0
+            for i, p in enumerate(procs):
+                if p is None or p.poll() is not None:
+                    if self.respawn and i not in skip and not self._stop.is_set():
+                        with self._lock:
+                            self._procs[i] = self._spawn(i)
+                        self.respawns += 1
+                        journal_event("replica_respawned", replica=i)
+                        log.info("respawned config replica %d", i)
+                else:
+                    up += 1
+            counters.set_gauge("config_replicas_up", float(up))
+            lead_epoch, head, lag = 0, 0, 0.0
+            commits = []
+            for st in self.statuses():
+                if st is None:
+                    continue
+                if st.get("role") == "leader" and int(st["epoch"]) >= lead_epoch:
+                    lead_epoch = int(st["epoch"])
+                    head = int(st.get("log_index", 0))
+                commits.append(int(st.get("commit", 0)))
+            if lead_epoch:
+                if commits:
+                    lag = float(head - min(commits))
+                counters.set_gauge("config_leader_epoch", float(lead_epoch))
+                counters.set_gauge("config_replication_lag", lag)
+                if lead_epoch > self._seen_epoch:
+                    if self._seen_epoch:
+                        # feed rate:leader_elected (coordinator_flapping SLO)
+                        counters.inc_event("leader_elected",
+                                           lead_epoch - self._seen_epoch)
+                    self._seen_epoch = lead_epoch

@@ -11,6 +11,9 @@ srcs/go/kungfu/env/config.go:24-56), renamed KFT_*:
   KFT_PARENT_ID            "host:port" of the spawning runner
   KFT_ALLREDUCE_STRATEGY   strategy name (plan/strategy.py)
   KFT_CONFIG_SERVER        URL of the elastic config service
+  KFT_CONFIG_URLS          comma-separated replica URLs of a replicated
+                           config ensemble (wins over KFT_CONFIG_SERVER;
+                           single-URL form is identical to it)
   KFT_JOB_START / KFT_PROC_START  timestamps for event tracing
 
 Tuning tier (KFT_CONFIG_*, reference srcs/go/kungfu/config/config.go:24-67):
@@ -35,6 +38,7 @@ INIT_CLUSTER_VERSION = "KFT_INIT_CLUSTER_VERSION"
 PARENT_ID = "KFT_PARENT_ID"
 ALLREDUCE_STRATEGY = "KFT_ALLREDUCE_STRATEGY"
 CONFIG_SERVER = "KFT_CONFIG_SERVER"
+CONFIG_URLS = "KFT_CONFIG_URLS"
 JOB_START = "KFT_JOB_START"
 PROC_START = "KFT_PROC_START"
 
@@ -104,7 +108,7 @@ def parse_config_from_env(env: Optional[Dict[str, str]] = None) -> Config:
             runners=PeerList(),
             single_machine=True,
             strategy=Strategy.parse(e.get(ALLREDUCE_STRATEGY, DEFAULT_STRATEGY.name)),
-            config_server=e.get(CONFIG_SERVER, ""),
+            config_server=e.get(CONFIG_URLS) or e.get(CONFIG_SERVER, ""),
         )
     return Config(
         self_id=PeerID.parse(e[SELF_SPEC]),
@@ -112,7 +116,7 @@ def parse_config_from_env(env: Optional[Dict[str, str]] = None) -> Config:
         runners=_parse_peers(e.get(INIT_RUNNERS, "")),
         cluster_version=int(e.get(INIT_CLUSTER_VERSION, "0")),
         strategy=Strategy.parse(e.get(ALLREDUCE_STRATEGY, DEFAULT_STRATEGY.name)),
-        config_server=e.get(CONFIG_SERVER, ""),
+        config_server=e.get(CONFIG_URLS) or e.get(CONFIG_SERVER, ""),
         parent=PeerID.parse(e[PARENT_ID]) if e.get(PARENT_ID) else None,
     )
 
@@ -136,7 +140,13 @@ def worker_env(
     if parent is not None:
         env[PARENT_ID] = str(parent)
     if config_server:
+        # `config_server` may be the comma KFT_CONFIG_URLS form (replicated
+        # ensemble); workers parse either var through the same splitter, so
+        # the single-URL contract is unchanged and the list rides the
+        # canonical var too
         env[CONFIG_SERVER] = config_server
+        if "," in config_server:
+            env[CONFIG_URLS] = config_server
     # forward the tuning tier (job/job.go:93-100); never clobber the
     # explicitly-set worker contract above (KFT_CONFIG_SERVER shares the prefix)
     for k, v in os.environ.items():

@@ -133,6 +133,10 @@ EVENT_KINDS: Dict[str, tuple] = {
     "overload_clamp": ("req_id", "tenant"),
     "overload_deadline_extended": ("req_id", "tenant"),
     "overload_rung_changed": ("from_rung", "to_rung"),
+    # replicated control plane (elastic/config_server.py, elastic/ensemble.py)
+    "leader_elected": ("leader_epoch", "replica"),
+    "leader_lost": ("leader_epoch", "replica"),
+    "replica_respawned": ("replica",),
     # chaos injection (chaos/inject.py)
     "chaos_crash": ("code",),
     "chaos_crash_serve": ("code",),

@@ -162,6 +162,14 @@ DEFAULT_RULES: List[SLORule] = [
                         "through new XLA signatures re-pays full compiles "
                         "on its hot path (monitor/programs.py; the rule "
                         "stays no_data on fleets that never storm)"),
+    SLORule("coordinator_flapping", "rate:leader_elected", "<=", 0.1,
+            sustain_s=10.0, severity="page",
+            description="config-plane leader elections stay rare (< ~1 per "
+                        "10 s sustained): repeated failovers mean the "
+                        "ensemble is flapping — lease/heartbeat tuning or a "
+                        "sick replica — not healing (elastic/ensemble.py "
+                        "feeds rate:leader_elected; stays no_data on "
+                        "single-server fleets)"),
 ]
 
 

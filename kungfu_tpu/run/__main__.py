@@ -105,6 +105,13 @@ def main(argv=None):
         help="embed a config server in this runner (reference builtin-config-server)",
     )
     ap.add_argument("-port", type=int, default=9100, help="builtin config server port")
+    ap.add_argument(
+        "-config-replicas", dest="config_replicas", type=int, default=1,
+        help="builtin config server replica count: >1 spawns a leader-leased "
+             "replicated ensemble (supervised, dead replicas respawned) and "
+             "hands workers the full KFT_CONFIG_URLS list "
+             "(docs/fault_tolerance.md \"Replicated control plane\")",
+    )
     ap.add_argument("-logdir", default="")
     ap.add_argument("-q", dest="quiet", action="store_true")
     ap.add_argument("-timeout", type=float, default=0.0, help="watch-mode timeout seconds")
@@ -155,10 +162,18 @@ def main(argv=None):
         set_journal_context(rank="launcher", identity="launcher")
 
     cs = None
+    ensemble = None
     config_url = args.config_server
     if args.builtin_cs or (args.watch and not config_url):
-        cs = ConfigServer(port=args.port, init=cluster).start()
-        config_url = cs.url
+        if args.config_replicas > 1:
+            from ..elastic.ensemble import ConfigEnsemble
+
+            ensemble = ConfigEnsemble(
+                replicas=args.config_replicas, init=cluster).start()
+            config_url = ensemble.urls_spec
+        else:
+            cs = ConfigServer(port=args.port, init=cluster).start()
+            config_url = cs.url
 
     heartbeat_dir = ""
     if args.heal and args.heartbeat_timeout > 0:
@@ -218,6 +233,8 @@ def main(argv=None):
             fleet.close()
         if cs is not None:
             cs.stop()
+        if ensemble is not None:
+            ensemble.stop()
     sys.exit(rc)
 
 

@@ -71,7 +71,9 @@ class ServeSupervisor:
             sys.executable, "-m", "kungfu_tpu.serving.worker",
             "--host", peer.host, "--port", str(peer.port),
             "--launch-rank", str(rank), "--incarnation", str(incarnation),
-            "--config-server", self.client.url,
+            # the FULL endpoint list, not the currently-active one: the
+            # worker must survive its own control-plane failovers
+            "--config-server", self.client.urls_spec,
             "--preset", a.preset, "--slots", str(a.slots),
             "--queue-capacity", str(a.worker_queue_capacity),
             "--seed", str(a.seed),
@@ -198,7 +200,12 @@ def main(argv=None) -> int:
     ap.add_argument("--port", type=int, default=0, help="router front door")
     ap.add_argument("--config-port", type=int, default=0)
     ap.add_argument("--config-server", default="",
-                    help="join an external config server instead of embedding")
+                    help="join an external config server instead of embedding "
+                         "(accepts the comma KFT_CONFIG_URLS form)")
+    ap.add_argument("--config-replicas", type=int, default=1,
+                    help="embedded config plane replica count: >1 spawns a "
+                         "leader-leased replicated ensemble with respawn "
+                         "supervision (docs/fault_tolerance.md)")
     ap.add_argument("--queue-capacity", type=int, default=256)
     ap.add_argument("--worker-queue-capacity", type=int, default=64)
     ap.add_argument("--platform", default="", help="force worker backend (cpu)")
@@ -225,13 +232,20 @@ def main(argv=None) -> int:
         cluster = cluster.assign_tiers(args.prefill_ranks)
 
     cs: Optional[ConfigServer] = None
+    ensemble = None
     if args.config_server:
         client = ConfigClient(args.config_server)
+    elif args.config_replicas > 1:
+        from ..elastic.ensemble import ConfigEnsemble
+
+        ensemble = ConfigEnsemble(replicas=args.config_replicas,
+                                  init=cluster).start()
+        client = ensemble.client()
     else:
         cs = ConfigServer(host="127.0.0.1", port=args.config_port,
                           init=cluster).start()
         client = ConfigClient(cs.url)
-    print(f"CONFIG_URL: {client.url}", flush=True)
+    print(f"CONFIG_URL: {client.urls_spec}", flush=True)
 
     from ..monitor.counters import counters_if_enabled
     from .router import Autoscaler, Router
@@ -322,6 +336,8 @@ def main(argv=None) -> int:
             fleet.close()
         if cs is not None:
             cs.stop()
+        if ensemble is not None:
+            ensemble.stop()
     return rc
 
 

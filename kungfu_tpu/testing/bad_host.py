@@ -53,6 +53,20 @@ class BadThread:
             time.sleep(60)
 
 
+def bad_pinned_client(ConfigClient):
+    """config-single-url: a client pinned to one hard-coded replica —
+    every conditional PUT dies with the leader instead of failing over."""
+    return ConfigClient("http://10.0.0.7:18080/config")
+
+
+def bad_raw_kv_write(urlopen, Request, payload):
+    """config-single-url: raw HTTP straight at the KV plane — bypasses
+    the failover client's leader redirect and stale-epoch rejection."""
+    req = Request("http://10.0.0.7:18080/config/kv/tenants/config",
+                  data=payload, method="PUT")
+    return urlopen(req, timeout=3)
+
+
 class BadLockOrder:
     """lock-order: two paths acquiring the same pair of locks in
     opposite orders — the classic ABBA deadlock."""

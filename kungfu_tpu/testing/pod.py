@@ -146,6 +146,7 @@ class PodSpec:
     suspicion_s: float = 6.0
     init_timeout_s: float = 20.0
     check_every: int = 2
+    config_replicas: int = 1  # >1: replicated control plane on the gateway
 
     @property
     def world(self) -> int:
@@ -184,6 +185,8 @@ class Pod:
         self.logs: Dict[str, str] = {}
         self._partition_routes: List[Tuple[str, str]] = []  # (ns, dst_ip)
         self._client = None
+        self.ensemble = None  # ConfigEnsemble when spec.config_replicas > 1
+        self._cs_proc: Optional[subprocess.Popen] = None
         self.journal_dir = os.path.join(self.workdir, "journal")
         os.makedirs(self.journal_dir, exist_ok=True)
 
@@ -267,6 +270,13 @@ class Pod:
 
     @property
     def config_url(self) -> str:
+        """Single URL, or the comma KFT_CONFIG_URLS form when the control
+        plane is replicated — every consumer (launchers via -config-server,
+        our own client()) accepts either."""
+        if self.spec.config_replicas > 1:
+            return ",".join(
+                f"http://{self.spec.gateway}:{CS_PORT + i}/config"
+                for i in range(self.spec.config_replicas))
         return f"http://{self.spec.gateway}:{CS_PORT}/config"
 
     def client(self):
@@ -291,14 +301,23 @@ class Pod:
                                          dir=self.workdir) as f:
             json.dump(cluster.to_json(), f)
             init_path = f.name
-        cs = subprocess.Popen(
-            [sys.executable, "-m", "kungfu_tpu.elastic.config_server",
-             "-host", self.spec.gateway, "-port", str(CS_PORT),
-             "-init", init_path],
-            env=env, start_new_session=True, cwd=REPO,
-        )
-        self.procs.append(cs)
-        time.sleep(1.0)
+        if self.spec.config_replicas > 1:
+            from ..elastic.ensemble import ConfigEnsemble
+
+            self.ensemble = ConfigEnsemble(
+                replicas=self.spec.config_replicas, host=self.spec.gateway,
+                ports=[CS_PORT + i for i in range(self.spec.config_replicas)],
+                init=cluster, env=env,
+            ).start()
+        else:
+            self._cs_proc = subprocess.Popen(
+                [sys.executable, "-m", "kungfu_tpu.elastic.config_server",
+                 "-host", self.spec.gateway, "-port", str(CS_PORT),
+                 "-init", init_path],
+                env=env, start_new_session=True, cwd=REPO,
+            )
+            self.procs.append(self._cs_proc)
+            time.sleep(1.0)
         for i in range(self.spec.hosts):
             ns, ip = self._ns(i), self.spec.host_ip(i)
             log_path = os.path.join(self.workdir, f"launcher-{ns}.log")
@@ -376,6 +395,21 @@ class Pod:
         """Restore the host's base shape (or unshaped)."""
         self._apply_shape(self.host_index(host), self.spec.shape, replace=True)
 
+    def kill_coordinator(self, replica: int = -1) -> int:
+        """SIGKILL one config replica (replica=-1: whoever currently holds
+        the leader lease).  With a replicated control plane the ensemble
+        must fail over and respawn it; with a single server this IS the
+        SPOF demonstration — the coordinator just dies."""
+        if self.ensemble is not None:
+            if replica < 0:
+                killed = self.ensemble.kill_leader()
+                return -1 if killed is None else killed
+            self.ensemble.kill_replica(replica)
+            return replica
+        if self._cs_proc is not None and self._cs_proc.poll() is None:
+            self._cs_proc.kill()
+        return 0
+
     def kill_host(self, host: str) -> str:
         """SIGKILL a host's launcher AND all its workers at once (one
         process group) — correlated whole-host loss.  The namespace stays:
@@ -442,6 +476,9 @@ class Pod:
     # -- teardown ---------------------------------------------------------------------
 
     def teardown(self) -> None:
+        if self.ensemble is not None:
+            self.ensemble.stop()
+            self.ensemble = None
         for p in self.procs:
             if p.poll() is None:
                 try:
@@ -515,6 +552,8 @@ class PlanExecutor:
                          lambda h=host: self.pod.clear_degrade(h)))
             elif f.kind == "kill_host":
                 rec["host"] = self.pod.kill_host(f.host)
+            elif f.kind == "kill_coordinator":
+                rec["replica"] = self.pod.kill_coordinator(f.replica)
             self.applied.append(rec)
 
     def window(self, kind: str, end_kind: str) -> Optional[Tuple[float, float]]:

@@ -164,6 +164,16 @@ echo "== straggler drill: slow rank fingered, not killed (CPU) =="
 # instead of killing it — the job finishes at full size
 JAX_PLATFORMS=cpu python -m kungfu_tpu.chaos --straggler-drill --timeout 240
 
+echo "== coordinator drill: replicated control plane through leader kill + partition (CPU) =="
+# CAS-storm traffic (healer + two autoscalers + reconvene nudges + KV
+# heartbeats, all through the KFT_CONFIG_URLS failover client) against a
+# 3-replica config ensemble, through a leader SIGKILL and a leader
+# SIGSTOP partition: zero dropped requests, zero lost/double-applied
+# conditional PUTs, bounded unavailability, leader_elected journaled,
+# every replica converged on one committed log
+# (docs/fault_tolerance.md "Replicated control plane")
+JAX_PLATFORMS=cpu python -m kungfu_tpu.chaos --coordinator-drill --timeout 300
+
 echo "== pod drill smoke: 4 netns hosts, shaped links, kill_host + partition =="
 # the simulated-pod harness (docs/fault_tolerance.md "network failure
 # model"): schedule resize, then a whole-host SIGKILL that must heal as

@@ -64,6 +64,18 @@ class TestFaultPlan:
         open_ended = parse_fault_plan("slow@step=5:rank=1:ms=20").faults[0]
         assert open_ended.matches(10_000, 1)
 
+    def test_kill_coordinator_grammar(self):
+        f = parse_fault_plan("kill_coordinator@step=12").faults[0]
+        assert (f.kind, f.step, f.replica) == ("kill_coordinator", 12, -1)
+        f = parse_fault_plan("kill_coordinator@step=5:replica=2").faults[0]
+        assert f.replica == 2
+        # applied from outside the workers, in step order with the rest
+        plan = parse_fault_plan(
+            "kill_host@host=h2:step=9;kill_coordinator@step=4")
+        assert [x.kind for x in plan.network_faults()] == [
+            "kill_coordinator", "kill_host"]
+        assert not plan.worker_faults()
+
     @pytest.mark.parametrize("bad", [
         "boom@step=1:rank=0",           # unknown kind
         "crash@step=1",                 # missing rank
@@ -74,6 +86,8 @@ class TestFaultPlan:
         "flap@after=3",                 # flap needs config_server=
         "crash",                        # no @
         "flap@config_server=xyz",       # bad duration
+        "kill_coordinator@replica=1",   # missing step
+        "kill_coordinator@step=1:rank=0",  # replica, not rank
     ])
     def test_malformed_plans_raise(self, bad):
         with pytest.raises(ValueError):

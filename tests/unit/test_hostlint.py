@@ -37,6 +37,7 @@ class TestRulesFire:
         analysis.RULE_LOCK_ORDER,
         analysis.RULE_THREAD_LIFECYCLE,
         analysis.RULE_WALL_CLOCK,
+        analysis.RULE_CONFIG_SINGLE_URL,
     ])
     def test_rule_fires_on_bad_corpus(self, bad_findings, rule):
         assert any(f.rule == rule for f in bad_findings), (

@@ -1,0 +1,316 @@
+"""Replicated control plane (elastic/config_server.py + ensemble.py).
+
+Covers the wire-contract invariants docs/fault_tolerance.md promises:
+every response carries an additive `leader_epoch` stamp while the legacy
+bodies stay bit-exact; followers answer 421 (never a fabricated 409) with
+a leader hint the comma-list client follows; a killed leader's ensemble
+re-elects and the client rides the failover inside its retry budget; and
+the CAS-storm property — healer + two autoscalers + reconvene nudges
+racing through a leader kill — loses no update and double-applies none.
+"""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+pytestmark = pytest.mark.replication
+
+
+def _cluster(n=3):
+    from kungfu_tpu.plan import Cluster, HostList
+
+    return Cluster.from_hostlist(HostList.parse(f"127.0.0.1:{n}"), n)
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=2) as r:
+        return json.loads(r.read().decode())
+
+
+def _trio(init=None):
+    """Three in-process replicas knowing each other from birth."""
+    from kungfu_tpu.elastic.config_server import ConfigServer
+    from kungfu_tpu.elastic.ensemble import free_ports
+
+    ports = free_ports(3)
+    urls = [f"http://127.0.0.1:{p}/config" for p in ports]
+    servers = [ConfigServer(port=ports[i], init=init, replica_id=i,
+                            peers=urls).start() for i in range(3)]
+    return servers, urls
+
+
+def _leader_of(servers, wait_s=10.0):
+    deadline = time.monotonic() + wait_s
+    while time.monotonic() < deadline:
+        for s in servers:
+            st = s.node.status()
+            if st["role"] == "leader" and st["commit"] >= 1:
+                return st["replica"]
+        time.sleep(0.05)
+    return None
+
+
+def _stop_all(servers):
+    for s in servers:
+        try:
+            s.stop()
+        except Exception:
+            pass
+
+
+class TestSingleReplicaEpochStamp:
+    """Satellite: the single-server mode runs the same code path —
+    majority of one, epoch 1, additive leader_epoch on every response,
+    legacy bodies otherwise bit-exact."""
+
+    def test_document_and_health_stamped(self):
+        from kungfu_tpu.elastic.config_server import ConfigServer
+
+        srv = ConfigServer(port=0, init=_cluster()).start()
+        try:
+            doc = _get_json(srv.url)
+            assert doc["leader_epoch"] == 1
+            assert doc["version"] == 0 and "cluster" in doc
+            health = _get_json(srv.url + "/health")
+            assert health["leader_epoch"] == 1
+            assert health["role"] == "leader" and health["replica"] == 0
+        finally:
+            srv.stop()
+
+    def test_put_responses_stamped_and_409_text_exact(self):
+        from kungfu_tpu.elastic.config_server import ConfigServer
+
+        srv = ConfigServer(port=0, init=_cluster()).start()
+        try:
+            body = json.dumps({"cluster": _cluster(2).to_json(),
+                               "version": 0}).encode()
+            req = urllib.request.Request(srv.url, data=body, method="PUT")
+            with urllib.request.urlopen(req, timeout=2) as r:
+                out = json.loads(r.read().decode())
+            assert out["msg"] == "ok" and out["leader_epoch"] == 1
+            # replay the same conditional PUT: the legacy 409 text survives
+            req = urllib.request.Request(srv.url, data=body, method="PUT")
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req, timeout=2)
+            assert e.value.code == 409
+            rejected = json.loads(e.value.read().decode())
+            assert rejected["msg"] == "version conflict: expected 0, at 1"
+
+            kv = json.dumps({"x": 1}).encode()
+            req = urllib.request.Request(srv.url + "/kv/drill/a", data=kv,
+                                         method="PUT")
+            with urllib.request.urlopen(req, timeout=2) as r:
+                assert json.loads(r.read().decode())["leader_epoch"] == 1
+            got = _get_json(srv.url + "/kv/drill/a")
+            assert got["value"] == {"x": 1} and got["leader_epoch"] == 1
+        finally:
+            srv.stop()
+
+    def test_raft_status_single(self):
+        from kungfu_tpu.elastic.config_server import ConfigServer
+
+        srv = ConfigServer(port=0, init=_cluster()).start()
+        try:
+            st = _get_json(srv.url.rsplit("/", 1)[0] + "/raft/status")
+            assert st["role"] == "leader" and st["epoch"] == 1
+            assert st["replicas"] == 1
+        finally:
+            srv.stop()
+
+
+class TestTrioBasics:
+    def test_lowest_replica_wins_and_client_cas_works(self):
+        from kungfu_tpu.elastic.config_client import ConfigClient
+
+        servers, urls = _trio(init=_cluster())
+        try:
+            assert _leader_of(servers) == 0  # the staggered election
+            client = ConfigClient(",".join(urls), retries=6,
+                                  retry_deadline_s=10.0)
+            c, v = client.wait_for_config(timeout_s=10.0)
+            assert c.size() == 3
+            assert client.put_cluster(c.resize(2), version=v)
+            assert not client.put_cluster(c.resize(4), version=v)  # conflict
+            c2, v2 = client.get_cluster()
+            assert c2.size() == 2 and v2 == v + 1
+        finally:
+            _stop_all(servers)
+
+    def test_follower_answers_421_with_leader_hint(self):
+        servers, urls = _trio(init=_cluster())
+        try:
+            lead = _leader_of(servers)
+            follower = next(u for i, u in enumerate(urls) if i != lead)
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(follower, timeout=2)
+            assert e.value.code == 421
+            body = json.loads(e.value.read().decode())
+            assert body["error"] == "not_leader"
+            assert body["leader"] == urls[lead]
+        finally:
+            _stop_all(servers)
+
+    def test_client_follows_hint_from_follower(self):
+        from kungfu_tpu.elastic.config_client import ConfigClient
+
+        servers, urls = _trio(init=_cluster())
+        try:
+            lead = _leader_of(servers)
+            # active endpoint deliberately set to a follower
+            rotated = [u for i, u in enumerate(urls) if i != lead] \
+                + [urls[lead]]
+            client = ConfigClient(",".join(rotated), retries=6,
+                                  retry_deadline_s=10.0)
+            c, v = client.get_cluster()
+            assert client.put_cluster(c.resize(2), version=v)
+            assert client.url == urls[lead]  # jumped straight to the hint
+        finally:
+            _stop_all(servers)
+
+    def test_leader_kill_fails_over_and_epoch_moves(self):
+        from kungfu_tpu.elastic.config_client import ConfigClient
+
+        servers, urls = _trio(init=_cluster())
+        try:
+            lead = _leader_of(servers)
+            client = ConfigClient(",".join(urls), retries=10,
+                                  retry_deadline_s=20.0)
+            _, v = client.wait_for_config(timeout_s=10.0)
+            epoch0 = servers[lead].node.status()["epoch"]
+            servers[lead].kill()
+            survivors = [s for i, s in enumerate(servers) if i != lead]
+            new_lead = _leader_of(survivors, wait_s=15.0)
+            assert new_lead is not None and new_lead != lead
+            c, v1 = client.get_cluster()
+            assert v1 >= v
+            assert client.put_cluster(c.resize(2), version=v1)
+            st = [s for s in survivors
+                  if s.node.status()["role"] == "leader"][0].node.status()
+            assert st["epoch"] > epoch0
+        finally:
+            _stop_all(servers)
+
+    def test_kv_replicates_to_all(self):
+        servers, urls = _trio(init=_cluster())
+        try:
+            lead = _leader_of(servers)
+            kv = json.dumps({"beat": 7}).encode()
+            req = urllib.request.Request(urls[lead] + "/kv/hb/r0", data=kv,
+                                         method="PUT")
+            urllib.request.urlopen(req, timeout=2).close()
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                vals = [s.state.kv_get("hb/r0") for s in servers]
+                if all(v is not None and v["value"] == {"beat": 7}
+                       for v in vals):
+                    ts = {v["t_server"] for v in vals}
+                    assert len(ts) == 1  # leader-stamped, replayed verbatim
+                    return
+                time.sleep(0.05)
+            pytest.fail("kv entry did not replicate to every replica")
+        finally:
+            _stop_all(servers)
+
+
+class TestStaleEpochDiscard:
+    """Satellite: a failed-over client discards reads from a deposed
+    leader's older epoch instead of acting on them."""
+
+    def test_seen_epoch_enforces_monotonicity(self):
+        from kungfu_tpu.elastic.config_client import (
+            ConfigClient,
+            StaleLeaderRead,
+        )
+
+        client = ConfigClient("http://127.0.0.1:9,http://127.0.0.1:10")
+        client._seen_epoch({"leader_epoch": 5})
+        with pytest.raises(StaleLeaderRead):
+            client._seen_epoch({"leader_epoch": 4})
+        # liveness data records but never rejects
+        client._seen_epoch({"leader_epoch": 4}, enforce=False)
+        assert client._seen_epoch({"leader_epoch": 6})["leader_epoch"] == 6
+
+    def test_stale_read_is_oserror_for_poll_loops(self):
+        from kungfu_tpu.elastic.config_client import StaleLeaderRead
+
+        assert issubclass(StaleLeaderRead, OSError)
+
+
+class TestCasStorm:
+    """Satellite: the seeded-thread CAS storm through a leader kill —
+    monotonic versions, no lost update, no double-apply."""
+
+    def test_storm_through_leader_kill(self, monkeypatch):
+        import random
+
+        from kungfu_tpu.elastic.config_client import ConfigClient
+
+        monkeypatch.setenv("KFT_RAFT_ELECT_S", "0.3")
+        monkeypatch.setenv("KFT_RAFT_HB_S", "0.08")
+        random.seed(20260807)
+        servers, urls = _trio(init=_cluster())
+        stop = threading.Event()
+        wins, versions, drops = {}, {}, []
+        lock = threading.Lock()
+
+        def storm(name, reconvene=False):
+            client = ConfigClient(",".join(urls), timeout_s=2.0, retries=10,
+                                  backoff_s=0.02, backoff_max_s=0.3,
+                                  retry_deadline_s=15.0)
+            my_wins, my_versions = [], []
+            while not stop.is_set():
+                try:
+                    got = client.get_cluster()
+                    if got is not None:
+                        c, v = got
+                        my_versions.append(v)
+                        if reconvene:
+                            ok = client.reconvene_cluster(c, v)
+                        else:
+                            target = 4 if c.size() <= 3 else 3
+                            ok = client.put_cluster(c.resize(target),
+                                                    version=v)
+                        if ok:
+                            my_wins.append(v)
+                except OSError as e:
+                    drops.append(f"{name}: {e}")
+                stop.wait(0.01)
+            with lock:
+                wins[name] = my_wins
+                versions[name] = my_versions
+
+        threads = [
+            threading.Thread(target=storm, args=("healer",), daemon=True),
+            threading.Thread(target=storm, args=("scaler-a",), daemon=True),
+            threading.Thread(target=storm, args=("scaler-b",), daemon=True),
+            threading.Thread(target=storm, args=("nudge", True), daemon=True),
+        ]
+        try:
+            lead = _leader_of(servers)
+            assert lead is not None
+            for t in threads:
+                t.start()
+            time.sleep(1.0)
+            servers[lead].kill()  # mid-storm, no drain
+            time.sleep(2.5)
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+
+            assert not drops, drops
+            for name, vs in versions.items():
+                assert vs == sorted(vs), f"{name} saw versions regress"
+            all_wins = [v for ws in wins.values() for v in ws]
+            assert all_wins, "storm never committed a single CAS"
+            assert len(all_wins) == len(set(all_wins)), (
+                "lost update: one version won by two conditional PUTs",
+                sorted(wins.items()))
+            survivors = [s for i, s in enumerate(servers) if i != lead]
+            final = max(s.state.health()["version"] for s in survivors)
+            assert final >= len(all_wins)  # phantoms only push it higher
+        finally:
+            stop.set()
+            _stop_all(servers)

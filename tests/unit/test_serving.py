@@ -848,8 +848,11 @@ class TestConfigHealth:
         try:
             client = ConfigClient(srv.url)
             h = client.get_health()
+            # single replica: leader of epoch 1 from the first request on
+            # (docs/fault_tolerance.md "Replicated control plane")
             assert h == {"ok": True, "version": 0, "size": 2,
-                         "cleared": False}
+                         "cleared": False, "role": "leader",
+                         "replica": 0, "leader_epoch": 1}
             assert client.put_cluster(cluster.resize(3), version=0)
             h = client.get_health()
             assert (h["version"], h["size"]) == (1, 3)
